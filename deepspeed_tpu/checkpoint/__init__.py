@@ -1,0 +1,7 @@
+from .engine import (
+    CheckpointEngine,
+    OrbaxCheckpointEngine,
+    load_train_state,
+    read_latest_tag,
+    save_train_state,
+)

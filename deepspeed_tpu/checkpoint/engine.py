@@ -1,0 +1,127 @@
+"""Sharded checkpoint save/load (tensorstore/OCDBT via orbax).
+
+Analog of reference checkpoint machinery:
+- ``engine.save_checkpoint`` (engine.py:2881) / ``load_checkpoint`` (:2531)
+- pluggable ``CheckpointEngine`` (runtime/checkpoint_engine/checkpoint_engine.py)
+- async Nebula engine (nebula_checkpoint_engine.py) → orbax async save
+
+The reference writes per-rank files (``mp_rank_XX_model_states.pt``,
+``zero_pp_rank_X_…_optim_states.pt``) because every process owns opaque torch
+shards. On TPU the state is a single *logically global* pytree whose arrays
+are sharded over the mesh; orbax/tensorstore writes each host's shards into
+one coherent directory and can restore onto a *different* mesh — which
+already subsumes the reference's "universal checkpoint" dp/tp reshape for the
+state arrays (checkpoint/universal_checkpoint.py).
+
+Layout on disk:
+    <save_dir>/<tag>/state/       sharded arrays (orbax/OCDBT)
+    <save_dir>/<tag>/client_state.json
+    <save_dir>/latest             text file naming the newest tag
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+
+PyTree = Any
+
+LATEST_FILE = "latest"
+
+
+class CheckpointEngine:
+    """Pluggable engine ABC (reference checkpoint_engine.py parity)."""
+
+    def save(self, path: str, state: PyTree) -> None:
+        raise NotImplementedError
+
+    def load(self, path: str, abstract_state: PyTree) -> PyTree:
+        raise NotImplementedError
+
+    def commit(self) -> None:
+        pass
+
+
+class OrbaxCheckpointEngine(CheckpointEngine):
+    def __init__(self, async_save: bool = False):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.async_save = async_save
+        self._ckptr = ocp.StandardCheckpointer()
+
+    def save(self, path: str, state: PyTree) -> None:
+        self._ckptr.save(path, state, force=True)
+        if not self.async_save:
+            self._ckptr.wait_until_finished()
+
+    def load(self, path: str, abstract_state: PyTree) -> PyTree:
+        return self._ckptr.restore(path, abstract_state)
+
+    def commit(self) -> None:
+        self._ckptr.wait_until_finished()
+
+
+def _abstract_with_shardings(state: PyTree, shardings: PyTree) -> PyTree:
+    def mk(leaf, sh):
+        return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype, sharding=sh)
+
+    return jax.tree.map(mk, state, shardings)
+
+
+def save_train_state(
+    save_dir: str,
+    tag: str,
+    state: PyTree,
+    client_state: Optional[Dict] = None,
+    save_latest: bool = True,
+    async_save: bool = False,
+    engine: Optional[CheckpointEngine] = None,
+) -> str:
+    engine = engine or OrbaxCheckpointEngine(async_save=async_save)
+    base = os.path.join(os.path.abspath(save_dir), str(tag))
+    os.makedirs(base, exist_ok=True)
+    engine.save(os.path.join(base, "state"), state)
+    if jax.process_index() == 0:
+        with open(os.path.join(base, "client_state.json"), "w") as fh:
+            json.dump(client_state or {}, fh)
+        if save_latest:
+            with open(os.path.join(os.path.abspath(save_dir), LATEST_FILE), "w") as fh:
+                fh.write(str(tag))
+    return base
+
+
+def read_latest_tag(load_dir: str) -> Optional[str]:
+    p = os.path.join(load_dir, LATEST_FILE)
+    if os.path.exists(p):
+        with open(p) as fh:
+            return fh.read().strip()
+    return None
+
+
+def load_train_state(
+    load_dir: str,
+    tag: Optional[str],
+    like_state: PyTree,
+    shardings: PyTree,
+    load_optimizer_states: bool = True,
+    engine: Optional[CheckpointEngine] = None,
+) -> Tuple[PyTree, Dict]:
+    engine = engine or OrbaxCheckpointEngine()
+    tag = tag or read_latest_tag(load_dir)
+    if tag is None:
+        raise FileNotFoundError(f"no 'latest' file in {load_dir} and no tag given")
+    base = os.path.join(os.path.abspath(load_dir), str(tag))
+    abstract = _abstract_with_shardings(like_state, shardings)
+    restored = engine.load(os.path.join(base, "state"), abstract)
+    if not load_optimizer_states and hasattr(restored, "_replace") and hasattr(like_state, "opt_state"):
+        restored = restored._replace(opt_state=like_state.opt_state)
+    client_path = os.path.join(base, "client_state.json")
+    client_state: Dict = {}
+    if os.path.exists(client_path):
+        with open(client_path) as fh:
+            client_state = json.load(fh)
+    return restored, client_state

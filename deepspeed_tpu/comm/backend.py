@@ -1,0 +1,29 @@
+"""Pluggable communication backend shell.
+
+Analog of reference ``deepspeed/comm/backend.py`` (Backend ABC). The reference
+ships only a TorchBackend (NCCL/Gloo/MPI); here the default — and primary —
+backend is XLA collectives over ICI/DCN (``deepspeed_tpu/comm/xla.py``).
+"""
+
+from __future__ import annotations
+
+
+class Backend:
+    def __init__(self, name: str = "backend", rank: int = 0, size: int = 1):
+        self.name = name
+        self.world_group = None
+        self.world_size = size
+        self.world_rank = rank
+        self.initialized = False
+
+    def is_initialized(self) -> bool:
+        return self.initialized
+
+    def new_group(self, ranks):
+        raise NotImplementedError
+
+    def init_process_group(self):
+        self.initialized = True
+
+    def destroy_process_group(self):
+        self.initialized = False

@@ -1,0 +1,121 @@
+"""XLA-collective backend: named-mesh-axis collectives over ICI/DCN.
+
+TPU-native replacement for reference ``deepspeed/comm/torch.py`` (TorchBackend
+→ torch.distributed → NCCL). Every primitive here is a thin, *traceable*
+wrapper over ``jax.lax`` collectives and is meant to be called inside
+``shard_map``/``pjit`` where a named mesh axis is in scope. XLA lowers them to
+ICI (intra-slice) or DCN (cross-slice) collectives — the analog of NCCL ring
+algorithms, chosen by the compiler instead of hand-tuned.
+
+Primitive mapping (reference comm/comm.py op → here):
+
+- all_reduce           → ``jax.lax.psum`` / ``pmean`` / ``pmax`` / ``pmin``
+- all_gather(_base)    → ``jax.lax.all_gather``
+- reduce_scatter(_base)→ ``jax.lax.psum_scatter``
+- all_to_all_single    → ``jax.lax.all_to_all``
+- broadcast            → gather-from-root trick over the axis
+- send/recv (pipeline) → ``jax.lax.ppermute`` ring shifts
+- barrier              → trivially a psum of a scalar (rarely needed; XLA
+                         sequencing makes most barriers implicit)
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .backend import Backend
+
+AxisName = Union[str, Tuple[str, ...]]
+
+REDUCE_OPS = {"sum", "mean", "max", "min", "prod"}
+
+
+class XLABackend(Backend):
+    """Process-level init + traceable collectives. Analog of TorchBackend."""
+
+    def __init__(self):
+        super().__init__(name="xla")
+
+    def init_process_group(self, coordinator_address: Optional[str] = None, num_processes: Optional[int] = None, process_id: Optional[int] = None):
+        # Multi-host: jax.distributed.initialize is the NCCL-rendezvous analog
+        # (reference comm/comm.py:577 init_distributed). Single-host jobs skip it.
+        if num_processes is not None and num_processes > 1:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+        self.world_size = jax.process_count()
+        self.world_rank = jax.process_index()
+        self.initialized = True
+
+
+# ---------------------------------------------------------------------------
+# Traceable collectives (call inside shard_map / pjit with axis in scope)
+# ---------------------------------------------------------------------------
+
+def all_reduce(x, axis: AxisName, op: str = "sum"):
+    if op == "sum":
+        return lax.psum(x, axis)
+    if op == "mean":
+        return lax.pmean(x, axis)
+    if op == "max":
+        return lax.pmax(x, axis)
+    if op == "min":
+        return lax.pmin(x, axis)
+    if op == "prod":
+        return jnp.exp(lax.psum(jnp.log(x), axis))
+    raise ValueError(f"unknown reduce op {op}")
+
+
+def all_gather(x, axis: AxisName, *, gather_dim: int = 0, tiled: bool = True):
+    """Concatenate shards along ``gather_dim`` (reference all_gather_base)."""
+    return lax.all_gather(x, axis, axis=gather_dim, tiled=tiled)
+
+
+def reduce_scatter(x, axis: AxisName, *, scatter_dim: int = 0, tiled: bool = True):
+    """Sum across the axis then keep this rank's shard (reduce_scatter_base)."""
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_dim, tiled=tiled)
+
+
+def all_to_all(x, axis: AxisName, *, split_dim: int, concat_dim: int, tiled: bool = True):
+    """MoE dispatch collective (reference all_to_all_single, comm/comm.py:355)."""
+    return lax.all_to_all(x, axis, split_axis=split_dim, concat_axis=concat_dim, tiled=tiled)
+
+
+def broadcast(x, axis: AxisName, root: int = 0):
+    """Every rank gets root's value. Lowered as a one-hot psum (XLA optimizes
+    to an actual broadcast); analog of reference broadcast (comm.py:424)."""
+    idx = lax.axis_index(axis)
+    mask = (idx == root).astype(x.dtype)
+    return lax.psum(x * mask, axis)
+
+
+def ppermute(x, axis: AxisName, perm: Sequence[Tuple[int, int]]):
+    """Point-to-point pattern; the pipeline send/recv analog (pipe/p2p.py)."""
+    return lax.ppermute(x, axis, perm=perm)
+
+
+def ring_shift(x, axis: AxisName, shift: int = 1, axis_size: Optional[int] = None):
+    """Shift values around the ring: rank i → rank (i+shift) % N."""
+    n = axis_size if axis_size is not None else lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm=perm)
+
+
+def axis_index(axis: AxisName):
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: AxisName) -> int:
+    return lax.axis_size(axis)
+
+
+def barrier(axis: AxisName):
+    """Explicit sync point. Mostly unnecessary under XLA (data dependencies
+    order collectives), but kept for API parity (reference comm.py:456)."""
+    return lax.psum(jnp.zeros((), jnp.int32), axis)

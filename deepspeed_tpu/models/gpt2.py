@@ -1,0 +1,252 @@
+"""GPT-2 model family, TPU-first.
+
+This is the flagship training workload (BASELINE.md configs: GPT-2 125M ZeRO-1,
+GPT-2-XL 1.5B ZeRO-3). It is NOT a port of any torch modeling code — it is
+written for XLA:
+
+- **scan-over-layers**: all transformer blocks are stacked into one pytree
+  with a leading ``layers`` dim and executed with ``lax.scan`` → O(1) HLO
+  size regardless of depth, fast compiles, and a natural unit for pipeline
+  stage partitioning later.
+- **logical axis annotations** on every param (consumed by
+  ``ZeroShardingPolicy``): Megatron-style column-parallel QKV/FC1 (out-dim on
+  ``tp``) and row-parallel proj/FC2 (in-dim on ``tp``); ``vocab`` on ``tp``;
+  ZeRO then shards the biggest free dim over ``dp``. XLA inserts the TP
+  allreduces the reference does by hand inside fused kernels
+  (ops/transformer/inference/transformer_inference.py TP allreduce).
+- **remat** per block via ``jax.checkpoint`` (the activation-checkpointing
+  analog of runtime/activation_checkpointing/checkpointing.py).
+- attention runs through ``deepspeed_tpu.ops.attention`` which picks a Pallas
+  flash kernel on TPU or a reference jnp path elsewhere.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec
+
+from ..runtime.module import ModuleSpec
+
+PyTree = Any
+
+
+@dataclass
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    dropout: float = 0.0
+    layer_norm_epsilon: float = 1e-5
+    use_bias: bool = True
+    remat: bool = False
+    attn_impl: str = "auto"  # auto | pallas | jnp
+    dtype: Any = jnp.float32  # param init dtype (master)
+
+    @property
+    def head_dim(self) -> int:
+        return self.n_embd // self.n_head
+
+
+# name → config, sizes per the GPT-2 paper / HF checkpoints
+PRESETS: Dict[str, Dict] = {
+    "gpt2-tiny": dict(n_embd=64, n_layer=2, n_head=4, vocab_size=512, n_positions=128),
+    "gpt2": dict(n_embd=768, n_layer=12, n_head=12),
+    "gpt2-125m": dict(n_embd=768, n_layer=12, n_head=12),
+    "gpt2-medium": dict(n_embd=1024, n_layer=24, n_head=16),
+    "gpt2-large": dict(n_embd=1280, n_layer=36, n_head=20),
+    "gpt2-xl": dict(n_embd=1600, n_layer=48, n_head=25),
+}
+
+
+def get_config(name: str, **overrides) -> GPT2Config:
+    base = dict(PRESETS[name])
+    base.update(overrides)
+    return GPT2Config(**base)
+
+
+# ---------------------------------------------------------------------------
+# params
+# ---------------------------------------------------------------------------
+
+def init_params(cfg: GPT2Config, rng) -> PyTree:
+    """Initializer; runs under jit with sharded out_shardings (zero.Init analog)."""
+    E, L, V, P = cfg.n_embd, cfg.n_layer, cfg.vocab_size, cfg.n_positions
+    k = iter(jax.random.split(rng, 16))
+    std = 0.02
+    # residual-projection init scaled by 1/sqrt(2L) (GPT-2 scheme)
+    pstd = std / jnp.sqrt(2.0 * L)
+    dt = cfg.dtype
+
+    def normal(key, shape, s):
+        return (jax.random.normal(key, shape) * s).astype(dt)
+
+    params = {
+        "wte": normal(next(k), (V, E), std),
+        "wpe": normal(next(k), (P, E), std),
+        "ln_f": {"scale": jnp.ones((E,), dt), "bias": jnp.zeros((E,), dt)},
+        "blocks": {
+            "ln_1": {"scale": jnp.ones((L, E), dt), "bias": jnp.zeros((L, E), dt)},
+            "ln_2": {"scale": jnp.ones((L, E), dt), "bias": jnp.zeros((L, E), dt)},
+            "attn": {
+                "c_attn_w": normal(next(k), (L, E, 3 * E), std),
+                "c_attn_b": jnp.zeros((L, 3 * E), dt),
+                "c_proj_w": normal(next(k), (L, E, E), pstd),
+                "c_proj_b": jnp.zeros((L, E), dt),
+            },
+            "mlp": {
+                "c_fc_w": normal(next(k), (L, E, 4 * E), std),
+                "c_fc_b": jnp.zeros((L, 4 * E), dt),
+                "c_proj_w": normal(next(k), (L, 4 * E, E), pstd),
+                "c_proj_b": jnp.zeros((L, E), dt),
+            },
+        },
+    }
+    return params
+
+
+def logical_axes() -> PyTree:
+    """Logical-axis names per param (see zero/partitioning.DEFAULT_LOGICAL_RULES)."""
+    return {
+        "wte": ("vocab", "embed"),
+        "wpe": (None, "embed"),
+        "ln_f": {"scale": ("embed",), "bias": ("embed",)},
+        "blocks": {
+            "ln_1": {"scale": ("layers", "embed"), "bias": ("layers", "embed")},
+            "ln_2": {"scale": ("layers", "embed"), "bias": ("layers", "embed")},
+            "attn": {
+                "c_attn_w": ("layers", "embed", "qkv"),
+                "c_attn_b": ("layers", "qkv"),
+                "c_proj_w": ("layers", "heads", "embed"),
+                "c_proj_b": ("layers", "embed"),
+            },
+            "mlp": {
+                "c_fc_w": ("layers", "embed", "mlp"),
+                "c_fc_b": ("layers", "mlp"),
+                "c_proj_w": ("layers", "mlp", "embed"),
+                "c_proj_b": ("layers", "embed"),
+            },
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _layer_norm(x, scale, bias, eps):
+    m = jnp.mean(x, axis=-1, keepdims=True)
+    v = jnp.var(x, axis=-1, keepdims=True)
+    return (x - m) * lax.rsqrt(v + eps) * scale + bias
+
+
+def _dropout(x, rate: float, rng, train: bool):
+    if not train or rate <= 0.0 or rng is None:
+        return x
+    keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), jnp.zeros((), x.dtype))
+
+
+def _attention(cfg: GPT2Config, lp, h, train: bool, rng=None):
+    B, S, E = h.shape
+    H, D = cfg.n_head, cfg.head_dim
+    qkv = h @ lp["c_attn_w"] + lp["c_attn_b"]  # [B,S,3E]
+    q, k_, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(x):
+        return x.reshape(B, S, H, D)
+
+    q, k_, v = heads(q), heads(k_), heads(v)
+
+    from ..ops.attention import causal_attention
+
+    o = causal_attention(q, k_, v, impl=cfg.attn_impl)  # [B,S,H,D]
+    o = o.reshape(B, S, E)
+    out = o @ lp["c_proj_w"] + lp["c_proj_b"]
+    return out
+
+
+def _mlp(lp, h):
+    x = h @ lp["c_fc_w"] + lp["c_fc_b"]
+    x = jax.nn.gelu(x, approximate=True)
+    return x @ lp["c_proj_w"] + lp["c_proj_b"]
+
+
+def _block(cfg: GPT2Config, layer_params, h, train: bool, rng=None):
+    eps = cfg.layer_norm_epsilon
+    r1 = r2 = None
+    if rng is not None:
+        r1, r2 = jax.random.split(rng)
+    a = _attention(cfg, layer_params["attn"], _layer_norm(h, layer_params["ln_1"]["scale"], layer_params["ln_1"]["bias"], eps), train, r1)
+    h = h + _dropout(a, cfg.dropout, r1, train)
+    m = _mlp(layer_params["mlp"], _layer_norm(h, layer_params["ln_2"]["scale"], layer_params["ln_2"]["bias"], eps))
+    return h + _dropout(m, cfg.dropout, r2, train)
+
+
+def forward(
+    cfg: GPT2Config,
+    params: PyTree,
+    input_ids: jnp.ndarray,
+    train: bool = False,
+    rng=None,
+) -> jnp.ndarray:
+    """input_ids [B,S] → logits [B,S,V]. ``rng`` enables dropout when train."""
+    B, S = input_ids.shape
+    h = params["wte"][input_ids] + params["wpe"][:S][None, :, :]
+    use_dropout = train and cfg.dropout > 0.0 and rng is not None
+    if use_dropout:
+        h = _dropout(h, cfg.dropout, jax.random.fold_in(rng, -1), train)
+        layer_keys = jax.random.split(jax.random.fold_in(rng, 0), cfg.n_layer)
+
+        def body(carry, x):
+            layer_params, key = x
+            return _block(cfg, layer_params, carry, train, key), None
+
+        xs = (params["blocks"], layer_keys)
+    else:
+
+        def body(carry, layer_params):
+            return _block(cfg, layer_params, carry, train, None), None
+
+        xs = params["blocks"]
+
+    if cfg.remat:
+        body = jax.checkpoint(body, prevent_cse=False)
+    h, _ = lax.scan(body, h, xs)
+    h = _layer_norm(h, params["ln_f"]["scale"], params["ln_f"]["bias"], cfg.layer_norm_epsilon)
+    logits = h @ params["wte"].T  # tied embeddings
+    return logits
+
+
+def lm_loss(cfg: GPT2Config, params: PyTree, batch: Dict[str, jnp.ndarray], rng, train: bool) -> Tuple[jnp.ndarray, Dict]:
+    """Next-token cross-entropy. batch: {"input_ids": [B,S]} and optional
+    {"labels": [B,S]} (-100 = ignore, HF convention) / {"attention_mask"}."""
+    ids = batch["input_ids"]
+    logits = forward(cfg, params, ids, train=train, rng=rng)[:, :-1]
+    labels = batch.get("labels", ids)[:, 1:]
+    mask = (labels != -100).astype(jnp.float32)
+    if "attention_mask" in batch:
+        mask = mask * batch["attention_mask"][:, 1:].astype(jnp.float32)
+    labels = jnp.maximum(labels, 0)
+    logz = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+    gold = jnp.take_along_axis(logits.astype(jnp.float32), labels[..., None], axis=-1)[..., 0]
+    nll = (logz - gold) * mask
+    loss = jnp.sum(nll) / jnp.maximum(jnp.sum(mask), 1.0)
+    return loss, {"ntokens": jnp.sum(mask)}
+
+
+def make_module(cfg: GPT2Config) -> ModuleSpec:
+    return ModuleSpec(
+        init=lambda rng: init_params(cfg, rng),
+        loss_fn=lambda params, batch, rng, train: lm_loss(cfg, params, batch, rng, train),
+        apply_fn=lambda params, batch: forward(cfg, params, batch["input_ids"], train=False),
+        logical_axes=logical_axes(),
+        num_layers=cfg.n_layer,
+        extra={"config": cfg},
+    )

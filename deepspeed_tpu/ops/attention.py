@@ -1,0 +1,57 @@
+"""Attention dispatch: Pallas flash kernel on TPU, jnp reference elsewhere.
+
+The capability analog of the reference's fused transformer kernels
+(``csrc/transformer/ds_transformer_cuda.cpp`` softmax/attention pieces): the
+FLOPs-heavy attention inner loop runs as a hand-written TPU kernel
+(``deepspeed_tpu/ops/pallas/flash_attention.py``) when shapes allow, with a
+pure-XLA fallback that still fuses well (MXU einsums + f32 softmax).
+
+Layout convention here is [B, S, H, D] (batch, seq, heads, head_dim).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import warning_once
+
+
+def causal_attention_jnp(q, k, v, sm_scale: Optional[float] = None):
+    """Reference implementation: [B,S,H,D] → [B,S,H,D], causal, f32 softmax."""
+    B, S, H, D = q.shape
+    scale = sm_scale if sm_scale is not None else 1.0 / (D**0.5)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+    logits = jnp.where(mask[None, None], logits, jnp.float32(-1e30))
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _pallas_ok(q) -> bool:
+    B, S, H, D = q.shape
+    if jax.default_backend() not in ("tpu",):
+        return False
+    # kernel tiling constraints: seq multiple of block, head_dim lane-friendly
+    # (D=64 is lane-padded by Mosaic — still profitable vs materializing [S,S])
+    return S % 128 == 0 and D % 64 == 0
+
+
+def causal_attention(q, k, v, impl: str = "auto", sm_scale: Optional[float] = None):
+    if impl == "jnp":
+        return causal_attention_jnp(q, k, v, sm_scale)
+    if impl in ("auto", "pallas"):
+        if impl == "pallas" or _pallas_ok(q):
+            try:
+                from .pallas.flash_attention import flash_attention
+
+                return flash_attention(q, k, v, causal=True, sm_scale=sm_scale)
+            except Exception as e:  # pragma: no cover
+                if impl == "pallas":
+                    raise
+                warning_once(f"pallas flash attention unavailable ({e}); using jnp path")
+        return causal_attention_jnp(q, k, v, sm_scale)
+    raise ValueError(f"unknown attention impl {impl}")

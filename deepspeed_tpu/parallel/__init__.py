@@ -1,0 +1,7 @@
+from .topology import (
+    MeshSpec,
+    PipeDataParallelTopology,
+    PipeModelDataParallelTopology,
+    ProcessTopology,
+    single_device_mesh,
+)

@@ -1,0 +1,191 @@
+"""Named-axis device topology → ``jax.sharding.Mesh``.
+
+TPU-native analog of the reference's ``deepspeed/runtime/pipe/topology.py``
+(``ProcessTopology`` at topology.py:9, ``PipeModelDataParallelTopology:243``,
+``PipelineParallelGrid:249``) and ``deepspeed/utils/groups.py``. The reference
+builds an N-D cartesian rank grid and carves NCCL process groups out of it; on
+TPU the same object IS a ``jax.sharding.Mesh`` with named axes — XLA derives
+every "process group" (collective subset) from the mesh axis names used by a
+collective, so no explicit group objects are needed.
+
+Canonical axis names (any subset may be present, sizes default to 1):
+
+- ``pp``   pipeline-parallel stages
+- ``dp``   data parallel (ZeRO shards over this axis)
+- ``tp``   tensor/model parallel
+- ``ep``   expert parallel (MoE); nested inside dp like groups.py:109
+- ``sp``   sequence/context parallel (ring attention / Ulysses)
+"""
+
+from __future__ import annotations
+
+import collections
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+# Axis order matters for ICI locality: innermost (fastest-varying) axes get
+# neighboring devices. tp wants the tightest coupling (per-layer collectives),
+# then ep/sp, then dp, then pp (cheapest: one p2p per microbatch boundary).
+CANONICAL_AXIS_ORDER = ("pp", "dp", "ep", "sp", "tp")
+
+ProcessCoord = collections.namedtuple  # built per-topology below
+
+
+class ProcessTopology:
+    """Cartesian mapping of named parallelism axes onto a flat device list.
+
+    API mirrors the reference ``ProcessTopology`` (rank↔coord queries, axis
+    comms) but ``get_mesh()`` returns the ``jax.sharding.Mesh`` that the rest
+    of the framework consumes.
+    """
+
+    def __init__(self, axes: Sequence[str], dims: Sequence[int], devices: Optional[Sequence] = None):
+        assert len(axes) == len(dims), "axes and dims must align"
+        self.axes = list(axes)
+        self.dims = list(dims)
+        self.ProcessCoord = collections.namedtuple("ProcessCoord", axes)
+        self.mapping: Dict[Tuple[int, ...], int] = {}
+        ranges = [range(d) for d in dims]
+        import itertools
+
+        for global_rank, coord in enumerate(itertools.product(*ranges)):
+            self.mapping[coord] = global_rank
+        self._devices = devices
+
+    def world_size(self) -> int:
+        return int(np.prod(self.dims)) if self.dims else 1
+
+    def get_dim(self, axis: str) -> int:
+        return self.dims[self.axes.index(axis)] if axis in self.axes else 1
+
+    def get_rank(self, **coord_kwargs) -> int:
+        key = tuple(coord_kwargs[a] for a in self.axes)
+        assert key in self.mapping, f"invalid coord {coord_kwargs}"
+        return self.mapping[key]
+
+    def get_coord(self, rank: int):
+        for coord, r in self.mapping.items():
+            if r == rank:
+                return self.ProcessCoord(*coord)
+        raise ValueError(f"rank {rank} not in topology")
+
+    def get_rank_repr(self, rank: int, omit_axes=("dp", "pp"), inner_sep="_", outer_sep="-") -> str:
+        omit_axes = list(omit_axes)
+        axes = [a for a in self.axes if a not in omit_axes]
+        names = []
+        coord = self.get_coord(rank)
+        for ax in axes:
+            names.append(f"{ax}{inner_sep}{getattr(coord, ax):02d}")
+        return outer_sep.join(names)
+
+    def get_axis_list(self, axis: str, idx: int) -> List[int]:
+        """All ranks whose ``axis`` coordinate equals ``idx``."""
+        pos = self.axes.index(axis)
+        return sorted(r for coord, r in self.mapping.items() if coord[pos] == idx)
+
+    def get_axis_comm_lists(self, axis: str) -> List[List[int]]:
+        """Rank lists that would form communicators along ``axis``.
+
+        Retained for parity with reference topology.py:155 — on TPU these are
+        informational (XLA derives collective groups from mesh axis names).
+        """
+        if axis not in self.axes:
+            return []
+        pos = self.axes.index(axis)
+        import itertools
+
+        other_ranges = [range(d) for i, d in enumerate(self.dims) if i != pos]
+        lists = []
+        for other in itertools.product(*other_ranges):
+            ranks = []
+            for v in range(self.dims[pos]):
+                coord = list(other)
+                coord.insert(pos, v)
+                ranks.append(self.mapping[tuple(coord)])
+            lists.append(sorted(ranks))
+        return lists
+
+    def filter_match(self, **filter_kwargs) -> List[int]:
+        def match(coord):
+            return all(getattr(coord, k) == v for k, v in filter_kwargs.items())
+
+        return sorted(r for coord, r in self.mapping.items() if match(self.ProcessCoord(*coord)))
+
+    def get_mesh(self, devices: Optional[Sequence] = None) -> Mesh:
+        """Materialize as a ``jax.sharding.Mesh`` over real (or given) devices."""
+        devices = list(devices if devices is not None else (self._devices or jax.devices()))
+        n = self.world_size()
+        assert len(devices) >= n, f"need {n} devices, have {len(devices)}"
+        dev_array = np.array(devices[:n], dtype=object).reshape(self.dims)
+        return Mesh(dev_array, axis_names=tuple(self.axes))
+
+    def __str__(self):
+        return f"ProcessTopology(axes={self.axes}, dims={self.dims})"
+
+
+class PipeModelDataParallelTopology(ProcessTopology):
+    """3D pp×dp×tp topology; analog of reference topology.py:243."""
+
+    def __init__(self, num_pp: int, num_mp: int, num_dp: int, devices=None):
+        super().__init__(axes=["pp", "dp", "tp"], dims=[num_pp, num_dp, num_mp], devices=devices)
+
+
+class PipeDataParallelTopology(ProcessTopology):
+    def __init__(self, num_pp: int, num_dp: int, devices=None):
+        super().__init__(axes=["pp", "dp"], dims=[num_pp, num_dp], devices=devices)
+
+
+@dataclass
+class MeshSpec:
+    """Declarative mesh request: axis name → size. -1 means "fill remaining".
+
+    ``deepspeed_tpu``'s analog of ``groups.initialize(ep_size, mpu)``: instead
+    of mutating global process groups, callers build a MeshSpec and pass the
+    resulting mesh into the engine.
+    """
+
+    dp: int = -1
+    tp: int = 1
+    pp: int = 1
+    ep: int = 1
+    sp: int = 1
+    axis_order: Tuple[str, ...] = CANONICAL_AXIS_ORDER
+    devices: Optional[Sequence] = None
+
+    def resolve(self, n_devices: Optional[int] = None) -> "ProcessTopology":
+        devices = list(self.devices) if self.devices is not None else jax.devices()
+        n = n_devices if n_devices is not None else len(devices)
+        sizes = {"dp": self.dp, "tp": self.tp, "pp": self.pp, "ep": self.ep, "sp": self.sp}
+        fixed = int(np.prod([v for v in sizes.values() if v > 0]))
+        n_fill = sum(1 for v in sizes.values() if v == -1)
+        assert n_fill <= 1, "at most one axis may be -1"
+        if n_fill:
+            assert n % fixed == 0, f"{n} devices not divisible by fixed axes product {fixed}"
+            fill_val = n // fixed
+            sizes = {k: (fill_val if v == -1 else v) for k, v in sizes.items()}
+        total = int(np.prod(list(sizes.values())))
+        assert total == n, f"mesh {sizes} covers {total} devices but {n} are available"
+        axes = [a for a in self.axis_order if sizes[a] > 1] or ["dp"]
+        dims = [sizes[a] for a in axes]
+        return ProcessTopology(axes=axes, dims=dims, devices=devices[:n])
+
+    def build_mesh(self, n_devices: Optional[int] = None) -> Mesh:
+        return self.resolve(n_devices).get_mesh()
+
+
+def mesh_axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape.get(axis, 1) if axis else 1
+
+
+def dp_axis_names(mesh: Mesh) -> Tuple[str, ...]:
+    """Axes grads are averaged over: dp (and sp — batch is also split over sp)."""
+    return tuple(a for a in ("dp", "sp") if a in mesh.axis_names)
+
+
+def single_device_mesh() -> Mesh:
+    return Mesh(np.array(jax.devices()[:1]), axis_names=("dp",))

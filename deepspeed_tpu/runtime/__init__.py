@@ -1,0 +1,3 @@
+from .config import DeepSpeedConfig
+from .engine import DeepSpeedEngine, TrainState
+from .module import ModuleSpec

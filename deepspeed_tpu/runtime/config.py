@@ -1,0 +1,433 @@
+"""The single-document config system.
+
+Analog of reference ``deepspeed/runtime/config.py`` (``DeepSpeedConfig:699``)
+plus its sub-config modules (``zero/config.py``, ``fp16 section``,
+``activation_checkpointing/config.py``, ``monitor/config.py``,
+``comm/config.py``, ``swap_tensor/aio_config.py``, ``nebula/config.py``).
+
+Key names are kept byte-identical to DeepSpeed's JSON schema wherever the
+concept transfers (``train_micro_batch_size_per_gpu``,
+``zero_optimization.stage``, ``fp16.initial_scale_power``, …) so reference
+users can bring their ds_config.json unchanged. TPU-specific knobs live under
+the ``"mesh"`` and ``"tpu"`` sections.
+
+The batch triple — train_batch_size = micro_batch * gradient_accumulation *
+dp_world — is validated/derived exactly like the reference (config.py's
+``_configure_train_batch_size``).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ..utils.logging import logger
+from .config_utils import DSConfigModel
+
+
+class DeepSpeedConfigError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Sub-configs
+# ---------------------------------------------------------------------------
+
+@dataclass
+class FP16Config(DSConfigModel):
+    """fp16 section (reference config.py fp16 keys; loss scaler semantics from
+    runtime/fp16/loss_scaler.py)."""
+
+    enabled: bool = False
+    auto_cast: bool = False
+    loss_scale: float = 0.0  # 0 → dynamic
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    min_loss_scale: float = 1.0
+
+    @property
+    def dynamic_loss_scale(self) -> bool:
+        return self.loss_scale == 0.0
+
+
+@dataclass
+class BF16Config(DSConfigModel):
+    enabled: bool = False
+
+
+@dataclass
+class OffloadDeviceConfig(DSConfigModel):
+    """zero_optimization.offload_{param,optimizer} (reference zero/offload_config.py)."""
+
+    device: str = "none"  # none | cpu | nvme
+    nvme_path: str = "/local_nvme"
+    buffer_count: int = 5
+    buffer_size: int = 100_000_000
+    pin_memory: bool = False
+    pipeline_read: bool = False
+    pipeline_write: bool = False
+    fast_init: bool = False
+    max_in_cpu: int = 1_000_000_000
+    ratio: float = 1.0
+
+
+@dataclass
+class ZeroConfig(DSConfigModel):
+    """zero_optimization section (reference zero/config.py)."""
+
+    stage: int = 0
+    contiguous_gradients: bool = True
+    reduce_scatter: bool = True
+    reduce_bucket_size: int = 500_000_000
+    allgather_partitions: bool = True
+    allgather_bucket_size: int = 500_000_000
+    overlap_comm: bool = True
+    offload_param: OffloadDeviceConfig = field(default_factory=OffloadDeviceConfig)
+    offload_optimizer: OffloadDeviceConfig = field(default_factory=OffloadDeviceConfig)
+    sub_group_size: int = 1_000_000_000
+    stage3_max_live_parameters: int = 1_000_000_000
+    stage3_max_reuse_distance: int = 1_000_000_000
+    stage3_prefetch_bucket_size: int = 50_000_000
+    stage3_param_persistence_threshold: int = 100_000
+    stage3_gather_16bit_weights_on_model_save: bool = False
+    zero_hpz_partition_size: int = 1
+    round_robin_gradients: bool = False
+    ignore_unused_parameters: bool = True
+    legacy_stage1: bool = False
+    cpu_offload: Optional[bool] = None  # deprecated alias
+
+    def __post_init__(self):
+        if self.cpu_offload:
+            self.offload_optimizer = OffloadDeviceConfig(device="cpu")
+        if not 0 <= self.stage <= 3:
+            raise DeepSpeedConfigError(f"zero_optimization.stage must be 0-3, got {self.stage}")
+
+
+@dataclass
+class ActivationCheckpointingConfig(DSConfigModel):
+    """activation_checkpointing section (reference activation_checkpointing/config.py).
+
+    On TPU, `partition_activations` maps to sharding the saved residuals over
+    the tp axis; `cpu_checkpointing` maps to host offload via
+    ``jax.checkpoint`` policies + host_callback-free device_put streams.
+    """
+
+    partition_activations: bool = False
+    contiguous_memory_optimization: bool = False
+    cpu_checkpointing: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+
+
+@dataclass
+class CommsLoggerConfig(DSConfigModel):
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    debug: bool = False
+    prof_ops: List[str] = field(default_factory=list)
+
+
+@dataclass
+class MonitorSubConfig(DSConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+    # wandb-specific
+    team: Optional[str] = None
+    group: Optional[str] = None
+    project: Optional[str] = None
+
+
+@dataclass
+class FlopsProfilerConfig(DSConfigModel):
+    enabled: bool = False
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+@dataclass
+class AIOConfig(DSConfigModel):
+    """aio section (reference swap_tensor/aio_config.py)."""
+
+    block_size: int = 1048576
+    queue_depth: int = 8
+    thread_count: int = 1
+    single_submit: bool = False
+    overlap_events: bool = True
+
+
+@dataclass
+class SchedulerConfig(DSConfigModel):
+    type: Optional[str] = None
+    params: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class OptimizerConfig(DSConfigModel):
+    type: str = "Adam"
+    params: Dict[str, Any] = field(default_factory=dict)
+    legacy_fusion: bool = False
+
+
+@dataclass
+class CheckpointConfig(DSConfigModel):
+    tag_validation: str = "Warn"  # Ignore | Warn | Fail
+    load_universal: bool = False
+    use_node_local_storage: bool = False
+    parallel_write: Dict[str, Any] = field(default_factory=dict)
+    async_save: bool = False
+
+
+@dataclass
+class ElasticityConfig(DSConfigModel):
+    """elasticity section (reference elasticity/config.py)."""
+
+    enabled: bool = False
+    max_train_batch_size: int = 2000
+    micro_batch_sizes: List[int] = field(default_factory=lambda: [2, 4, 6])
+    min_gpus: int = 1
+    max_gpus: int = 10000
+    min_time: int = 0
+    version: float = 0.2
+    ignore_non_elastic_batch_info: bool = False
+    prefer_larger_batch: bool = True
+
+
+@dataclass
+class CurriculumConfig(DSConfigModel):
+    enabled: bool = False
+    curriculum_type: str = "seqlen"
+    min_difficulty: int = 8
+    max_difficulty: int = 1024
+    schedule_type: str = "fixed_linear"
+    schedule_config: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class ProgressiveLayerDropConfig(DSConfigModel):
+    enabled: bool = False
+    theta: float = 0.5
+    gamma: float = 0.001
+
+
+@dataclass
+class EigenvalueConfig(DSConfigModel):
+    enabled: bool = False
+    verbose: bool = False
+    max_iter: int = 100
+    tol: float = 1e-2
+    stability: float = 1e-6
+    gas_boundary_resolution: int = 1
+    layer_name: str = "bert.encoder.layer"
+    layer_num: int = 0
+
+
+@dataclass
+class SparseAttentionConfig(DSConfigModel):
+    mode: str = "fixed"
+    block: int = 16
+    different_layout_per_head: bool = False
+    num_local_blocks: int = 4
+    num_global_blocks: int = 1
+    attention: str = "bidirectional"
+    horizontal_global_attention: bool = False
+    num_different_global_patterns: int = 1
+    num_random_blocks: int = 0
+    local_window_blocks: List[int] = field(default_factory=lambda: [4])
+    global_block_indices: List[int] = field(default_factory=lambda: [0])
+    global_block_end_indices: Optional[List[int]] = None
+    num_sliding_window_blocks: int = 3
+
+
+@dataclass
+class MeshConfig(DSConfigModel):
+    """TPU-specific: named-axis mesh sizes. -1 = fill with remaining devices.
+
+    This replaces the reference's implicit "world size = all ranks, mpu decides
+    tp/pp" (utils/groups.py) with an explicit declaration.
+    """
+
+    dp: int = -1
+    tp: int = 1
+    pp: int = 1
+    ep: int = 1
+    sp: int = 1
+
+
+@dataclass
+class TPUConfig(DSConfigModel):
+    """TPU-specific execution knobs."""
+
+    param_dtype: str = "float32"
+    # fp32 unless a precision section opts in (DeepSpeed default semantics);
+    # set "bfloat16" (or bf16.enabled) for the TPU fast path
+    compute_dtype: str = "float32"
+    use_pallas_attention: bool = True
+    remat_policy: str = "none"  # none | minimal | full | dots_with_no_batch_dims
+    donate_state: bool = True
+
+
+@dataclass
+class DataTypesConfig(DSConfigModel):
+    grad_accum_dtype: Optional[str] = None
+
+
+# ---------------------------------------------------------------------------
+# Top-level document
+# ---------------------------------------------------------------------------
+
+@dataclass
+class DeepSpeedConfig(DSConfigModel):
+    train_batch_size: Optional[int] = None
+    train_micro_batch_size_per_gpu: Optional[int] = None
+    gradient_accumulation_steps: Optional[int] = None
+    steps_per_print: int = 10
+    dump_state: bool = False
+
+    optimizer: Optional[OptimizerConfig] = None
+    scheduler: Optional[SchedulerConfig] = None
+
+    fp16: FP16Config = field(default_factory=FP16Config)
+    bf16: BF16Config = field(default_factory=BF16Config)
+    zero_optimization: ZeroConfig = field(default_factory=ZeroConfig)
+    activation_checkpointing: ActivationCheckpointingConfig = field(default_factory=ActivationCheckpointingConfig)
+    comms_logger: CommsLoggerConfig = field(default_factory=CommsLoggerConfig)
+    tensorboard: MonitorSubConfig = field(default_factory=MonitorSubConfig)
+    wandb: MonitorSubConfig = field(default_factory=MonitorSubConfig)
+    csv_monitor: MonitorSubConfig = field(default_factory=MonitorSubConfig)
+    flops_profiler: FlopsProfilerConfig = field(default_factory=FlopsProfilerConfig)
+    aio: AIOConfig = field(default_factory=AIOConfig)
+    checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    elasticity: ElasticityConfig = field(default_factory=ElasticityConfig)
+    curriculum_learning: CurriculumConfig = field(default_factory=CurriculumConfig)
+    progressive_layer_drop: ProgressiveLayerDropConfig = field(default_factory=ProgressiveLayerDropConfig)
+    eigenvalue: EigenvalueConfig = field(default_factory=EigenvalueConfig)
+    sparse_attention: Optional[SparseAttentionConfig] = None
+    data_types: DataTypesConfig = field(default_factory=DataTypesConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    tpu: TPUConfig = field(default_factory=TPUConfig)
+
+    gradient_clipping: float = 0.0
+    prescale_gradients: bool = False
+    gradient_predivide_factor: float = 1.0
+    sparse_gradients: bool = False
+    communication_data_type: Optional[str] = None
+    disable_allgather: bool = False
+    memory_breakdown: bool = False
+    wall_clock_breakdown: bool = False
+    zero_allow_untested_optimizer: bool = True
+
+    # filled by finalize()
+    _dp_world_size: int = 1
+    # user-specified batch triple, captured on first finalize so re-finalizing
+    # against a different dp world (engine knows the real mesh) re-derives
+    # instead of tripping over previously-derived values
+    _user_batch: Optional[tuple] = None
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def load(config: Any, dp_world_size: Optional[int] = 1) -> "DeepSpeedConfig":
+        """Accept a path, JSON string, or dict — reference accepts path|dict.
+
+        ``dp_world_size=None`` parses without finalizing the batch triple
+        (the engine finalizes once it knows the actual mesh).
+        """
+        if isinstance(config, DeepSpeedConfig):
+            cfg = config
+        elif isinstance(config, dict):
+            cfg = DeepSpeedConfig.from_dict(config)
+        elif isinstance(config, str):
+            if config.strip().startswith("{"):
+                cfg = DeepSpeedConfig.from_dict(json.loads(config))
+            else:
+                with open(config) as fh:
+                    cfg = DeepSpeedConfig.from_dict(json.load(fh))
+        else:
+            raise DeepSpeedConfigError(f"unsupported config type {type(config)}")
+        if dp_world_size is not None:
+            cfg.finalize(dp_world_size)
+        return cfg
+
+    def finalize(self, dp_world_size: int) -> None:
+        """Derive/validate the batch triple (reference _configure_train_batch_size).
+
+        Idempotent across dp sizes: the triple the *user* wrote is captured
+        once; later finalize calls re-derive from it.
+        """
+        self._dp_world_size = max(1, dp_world_size)
+        if self._user_batch is None:
+            self._user_batch = (
+                self.train_batch_size,
+                self.train_micro_batch_size_per_gpu,
+                self.gradient_accumulation_steps,
+            )
+        tb, mb, gas = self._user_batch
+        dp = self._dp_world_size
+        if tb is not None and mb is not None and gas is not None:
+            if tb != mb * gas * dp:
+                raise DeepSpeedConfigError(
+                    f"train_batch_size {tb} != micro_batch {mb} * gas {gas} * dp {dp}"
+                )
+        elif tb is not None and mb is not None:
+            if tb % (mb * dp) != 0:
+                raise DeepSpeedConfigError(
+                    f"train_batch_size {tb} not divisible by micro_batch {mb} * dp {dp}"
+                )
+            gas = tb // (mb * dp)
+        elif tb is not None and gas is not None:
+            if tb % (gas * dp) != 0:
+                raise DeepSpeedConfigError(
+                    f"train_batch_size {tb} not divisible by gas {gas} * dp {dp}"
+                )
+            mb = tb // (gas * dp)
+        elif mb is not None:
+            gas = gas or 1
+            tb = mb * gas * dp
+        elif tb is not None:
+            gas = 1
+            if tb % dp != 0:
+                raise DeepSpeedConfigError(f"train_batch_size {tb} not divisible by dp {dp}")
+            mb = tb // dp
+        else:
+            raise DeepSpeedConfigError(
+                "one of train_batch_size / train_micro_batch_size_per_gpu must be set"
+            )
+        self.train_batch_size, self.train_micro_batch_size_per_gpu, self.gradient_accumulation_steps = tb, mb, gas
+
+        if self.fp16.enabled and self.bf16.enabled:
+            raise DeepSpeedConfigError("fp16 and bf16 cannot both be enabled")
+
+    # convenience accessors, mirroring engine properties (engine.py:466-788)
+    @property
+    def zero_enabled(self) -> bool:
+        return self.zero_optimization.stage > 0
+
+    @property
+    def compute_dtype(self):
+        import jax.numpy as jnp
+
+        if self.fp16.enabled:
+            return jnp.float16
+        if self.bf16.enabled or self.tpu.compute_dtype == "bfloat16":
+            return jnp.bfloat16
+        if self.tpu.compute_dtype == "float16":
+            return jnp.float16
+        return jnp.float32
+
+    @property
+    def param_dtype(self):
+        import jax.numpy as jnp
+
+        return {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16}[
+            self.tpu.param_dtype
+        ]
+
+    def print_config(self) -> None:
+        logger.info(json.dumps(self.to_dict(), indent=2, default=str))

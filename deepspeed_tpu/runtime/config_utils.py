@@ -1,0 +1,82 @@
+"""Config plumbing helpers.
+
+Analog of reference ``deepspeed/runtime/config_utils.py``: dict → typed config
+objects with defaults, unknown-key warnings, and scientific-notation tolerance.
+Implemented with plain dataclasses (no pydantic dependency).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing
+from dataclasses import dataclass, fields
+from typing import Any, Dict, Optional, Type, TypeVar
+
+from ..utils.logging import logger
+
+T = TypeVar("T", bound="DSConfigModel")
+
+
+def _unwrap_optional(typ):
+    args = typing.get_args(typ)
+    if args and type(None) in args:
+        rest = [a for a in args if a is not type(None)]
+        if len(rest) == 1:
+            return rest[0]
+    return typ
+
+
+def _coerce(value: Any, typ: Any) -> Any:
+    # tolerate "1e9"-style strings and float-typed ints, like the reference's
+    # scientific-notation handling in DeepSpeedConfig
+    if value is None:
+        return None
+    typ = _unwrap_optional(typ)
+    if typing.get_origin(typ) is not None:
+        return value
+    try:
+        if typ is int and not isinstance(value, bool):
+            return int(float(value))
+        if typ is float:
+            return float(value)
+        if typ is bool:
+            return bool(value)
+    except (TypeError, ValueError):
+        return value
+    return value
+
+
+@dataclass
+class DSConfigModel:
+    """Base for all sub-configs: construct from a (possibly partial) dict."""
+
+    @classmethod
+    def from_dict(cls: Type[T], d: Optional[Dict[str, Any]], warn_unknown: bool = True) -> T:
+        d = dict(d or {})
+        try:
+            hints = typing.get_type_hints(cls)
+        except Exception:
+            hints = {f.name: f.type for f in fields(cls)}
+        known = {f.name for f in fields(cls)}
+        kwargs = {}
+        for key, value in list(d.items()):
+            if key in known:
+                typ = _unwrap_optional(hints.get(key, Any))
+                if dataclasses.is_dataclass(typ) and isinstance(value, dict):
+                    kwargs[key] = typ.from_dict(value, warn_unknown=warn_unknown)
+                else:
+                    kwargs[key] = _coerce(value, typ)
+            elif warn_unknown:
+                logger.warning(f"{cls.__name__}: ignoring unknown config key '{key}'")
+        return cls(**kwargs)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def replace(self: T, **kwargs) -> T:
+        return dataclasses.replace(self, **kwargs)
+
+
+def get_scalar_param(param_dict: Dict[str, Any], param_name: str, param_default_value: Any) -> Any:
+    """Reference ``config_utils.get_scalar_param`` parity helper."""
+    return param_dict.get(param_name, param_default_value)

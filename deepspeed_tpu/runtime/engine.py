@@ -1,0 +1,482 @@
+"""DeepSpeedEngine — the TPU-native training engine.
+
+Analog of reference ``deepspeed/runtime/engine.py`` (DeepSpeedEngine:179,
+3302 LoC). The reference wraps a torch module and orchestrates forward /
+backward / step as separate host-driven phases with hook-based ZeRO machinery.
+Here the entire training step — gradient-accumulation loop, mixed-precision
+scaling, ZeRO collectives, gradient clipping, optimizer update, loss-scale
+adjustment — is ONE jit-compiled XLA program over a named device mesh:
+
+- forward/backward/step  (engine.py:1603/1750/1957) → ``train_batch()``
+- allreduce_gradients    (engine.py:1729)           → grads fall out of pjit
+  with the dp-mean built in; ZeRO-2/3's reduce-scatter is the grad sharding
+- GAS boundary logic     (engine.py:1775)           → ``lax.scan`` over
+  micro-batches inside the step
+- loss scaling w/ skip   (fp16/fused_optimizer.py)  → predicated update
+- _broadcast_model       (engine.py:980)            → params initialized via a
+  single jit with deterministic rng → identical by construction
+
+The engine is returned by ``deepspeed_tpu.initialize`` and offers the same
+surface: ``train_batch``, ``eval_batch``, ``save_checkpoint``,
+``load_checkpoint``, lr-scheduler/loss-scale/global-step properties.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Iterable, Iterator, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..parallel.topology import MeshSpec, mesh_axis_size
+from ..utils.logging import log_dist, logger
+from ..utils.timer import (
+    STEP_GLOBAL_TIMER,
+    SynchronizedWallClockTimer,
+    ThroughputTimer,
+    TRAIN_BATCH_TIMER,
+)
+from .config import DeepSpeedConfig
+from .fp16 import loss_scaler as ls
+from .lr_schedules import get_lr_schedule
+from .module import ModuleSpec
+from .optimizers import build_optimizer
+from .zero.partitioning import ZeroShardingPolicy, init_partitioned
+
+PyTree = Any
+
+
+class TrainState(NamedTuple):
+    """The complete, donated, sharded training state (one pytree)."""
+
+    params: PyTree  # fp32 master weights (sharded per ZeRO stage 3 / TP)
+    opt_state: PyTree  # optimizer state (sharded per ZeRO stage >= 1)
+    loss_scale: ls.LossScaleState
+    global_step: jnp.ndarray  # i32
+    skipped_steps: jnp.ndarray  # i32
+
+
+def _tree_select(pred, a: PyTree, b: PyTree) -> PyTree:
+    """pred ? a : b, leafwise (the predicated-update primitive)."""
+    return jax.tree.map(lambda x, y: jnp.where(pred, x, y), a, b)
+
+
+def _cast_params(params: PyTree, dtype) -> PyTree:
+    def cast(p):
+        if hasattr(p, "dtype") and jnp.issubdtype(p.dtype, jnp.floating):
+            return p.astype(dtype)
+        return p
+
+    return jax.tree.map(cast, params)
+
+
+def global_norm(tree: PyTree) -> jnp.ndarray:
+    leaves = [jnp.sum(jnp.square(x.astype(jnp.float32))) for x in jax.tree.leaves(tree)]
+    return jnp.sqrt(jnp.sum(jnp.stack(leaves))) if leaves else jnp.float32(0.0)
+
+
+class DeepSpeedEngine:
+    def __init__(
+        self,
+        model: ModuleSpec,
+        config: DeepSpeedConfig,
+        mesh: Optional[Mesh] = None,
+        params: Optional[PyTree] = None,
+        lr_schedule: Optional[Callable] = None,
+        seed: int = 0,
+        training_data=None,
+        collate_fn=None,
+    ):
+        self.module = model
+        # parse config first (dict/path/JSON accepted), THEN build the mesh it
+        # describes, THEN finalize the batch triple against the real dp size
+        if not isinstance(config, DeepSpeedConfig):
+            config = DeepSpeedConfig.load(config, dp_world_size=None)
+        # --- topology (reference _configure_distributed_model, groups.initialize)
+        if mesh is None:
+            m = config.mesh
+            mesh = MeshSpec(dp=m.dp, tp=m.tp, pp=m.pp, ep=m.ep, sp=m.sp).build_mesh()
+        self.mesh = mesh
+        self.dp_world_size = mesh_axis_size(mesh, "dp")
+        self.tp_world_size = mesh_axis_size(mesh, "tp")
+        self.sp_world_size = mesh_axis_size(mesh, "sp")
+        config.finalize(self.dp_world_size)
+        self.config = config
+        self._config = config  # reference-name alias
+
+        # --- precision
+        self.fp16_enabled = config.fp16.enabled
+        self.bf16_enabled = config.bf16.enabled
+        self.compute_dtype = config.compute_dtype
+        self.dynamic_loss_scale = config.fp16.enabled and config.fp16.dynamic_loss_scale
+        acc = config.data_types.grad_accum_dtype
+        self.grad_accum_dtype = {None: jnp.float32, "fp32": jnp.float32, "fp16": jnp.float16, "bf16": jnp.bfloat16}[acc]
+
+        # --- ZeRO sharding policy
+        zcfg = config.zero_optimization
+        self.zero_stage = zcfg.stage
+        self.policy = ZeroShardingPolicy(
+            mesh,
+            stage=zcfg.stage,
+            min_size_to_shard=max(2, int(zcfg.stage3_param_persistence_threshold)) if zcfg.stage >= 3 else 2**14,
+        )
+
+        # --- lr schedule + optimizer (reference _configure_optimizer / _configure_lr_scheduler)
+        opt_cfg = config.optimizer
+        sched_cfg = config.scheduler
+        base_lr = (opt_cfg.params.get("lr", 1e-3) if opt_cfg else 1e-3)
+        if lr_schedule is None:
+            lr_schedule = get_lr_schedule(
+                sched_cfg.type if sched_cfg else None,
+                sched_cfg.params if sched_cfg else None,
+                fallback_lr=base_lr,
+            )
+        self.lr_schedule = lr_schedule
+        self.optimizer = build_optimizer(
+            opt_cfg.type if opt_cfg else "Adam",
+            opt_cfg.params if opt_cfg else {"lr": base_lr},
+            learning_rate=lr_schedule,
+        )
+
+        # --- params: born sharded (zero.Init analog)
+        init_rng = jax.random.PRNGKey(seed)
+        abstract_params = jax.eval_shape(model.init, init_rng)
+        self.param_shardings = self.policy.param_shardings(abstract_params, model.logical_axes)
+        self.grad_shardings = self.policy.grad_shardings(abstract_params, model.logical_axes)
+        if params is None:
+            params = init_partitioned(model.init, self.param_shardings, init_rng)
+        else:
+            params = jax.tree.map(jax.device_put, params, self.param_shardings)
+
+        abstract_opt = jax.eval_shape(self.optimizer.init, abstract_params)
+        self.opt_shardings = self.policy.opt_state_shardings(abstract_opt, abstract_params, model.logical_axes)
+        opt_state = jax.jit(self.optimizer.init, out_shardings=self.opt_shardings)(params)
+
+        scale_state = ls.from_config(config.fp16)
+        replicated = NamedSharding(mesh, PartitionSpec())
+        self.state = TrainState(
+            params=params,
+            opt_state=opt_state,
+            loss_scale=jax.device_put(scale_state, replicated),
+            global_step=jax.device_put(jnp.int32(0), replicated),
+            skipped_steps=jax.device_put(jnp.int32(0), replicated),
+        )
+        self.state_shardings = TrainState(
+            params=self.param_shardings,
+            opt_state=self.opt_shardings,
+            loss_scale=jax.tree.map(lambda _: replicated, scale_state),
+            global_step=replicated,
+            skipped_steps=replicated,
+        )
+        self._replicated = replicated
+
+        # --- batch sharding: [gas, micro*dp, ...] with dim 1 over dp, seq over sp
+        self.batch_spec = PartitionSpec(None, "dp")
+        self.micro_batch_size = config.train_micro_batch_size_per_gpu
+        self.gradient_accumulation_steps_value = config.gradient_accumulation_steps
+        self.train_batch_size_value = config.train_batch_size
+
+        # --- compiled steps
+        donate = (0,) if config.tpu.donate_state else ()
+        self._train_step = jax.jit(
+            self._make_train_step(),
+            donate_argnums=donate,
+            out_shardings=(self.state_shardings, None),
+        )
+        self._eval_step = jax.jit(self._make_eval_step())
+        self._rng = jax.random.PRNGKey(seed + 1)
+
+        # --- observability (reference EngineTimers / ThroughputTimer / Monitor)
+        self.timers = SynchronizedWallClockTimer()
+        self.tput_timer = ThroughputTimer(
+            batch_size=self.train_batch_size_value, steps_per_output=config.steps_per_print
+        )
+        self.steps_per_print = config.steps_per_print
+        self.wall_clock_breakdown = config.wall_clock_breakdown
+        self.global_steps = 0  # host-side count of train_batch calls
+        self.monitor = None  # wired by deepspeed_tpu.initialize when configured
+
+        self.training_dataloader = None
+        self._data_iterator = None
+        self._jit_apply = jax.jit(model.apply_fn) if model.apply_fn is not None else None
+        if training_data is not None:
+            self.training_dataloader = self.deepspeed_io(training_data, collate_fn=collate_fn)
+
+        log_dist(
+            f"DeepSpeedEngine initialized: mesh={dict(mesh.shape)} zero_stage={self.zero_stage} "
+            f"precision={'fp16' if self.fp16_enabled else ('bf16' if self.bf16_enabled else str(self.compute_dtype))} "
+            f"batch=({self.train_batch_size_value}={self.micro_batch_size}x{self.gradient_accumulation_steps_value}x{self.dp_world_size})"
+        )
+
+    # ------------------------------------------------------------------
+    # step construction
+    # ------------------------------------------------------------------
+    def _make_train_step(self):
+        model = self.module
+        tx = self.optimizer
+        cfg = self.config
+        compute_dtype = self.compute_dtype
+        acc_dtype = self.grad_accum_dtype
+        grad_shardings = self.grad_shardings
+        fp16 = self.fp16_enabled
+        dynamic = self.dynamic_loss_scale
+        clip = cfg.gradient_clipping
+        gas = self.gradient_accumulation_steps_value
+        scale_window = cfg.fp16.loss_scale_window
+        min_scale = cfg.fp16.min_loss_scale
+        predivide = cfg.prescale_gradients
+        predivide_factor = cfg.gradient_predivide_factor
+
+        def scaled_loss_fn(params, micro_batch, rng, scale):
+            cparams = _cast_params(params, compute_dtype)
+            loss, metrics = model.loss_fn(cparams, micro_batch, rng, True)
+            return loss.astype(jnp.float32) * scale, (loss, metrics)
+
+        grad_fn = jax.value_and_grad(scaled_loss_fn, has_aux=True)
+
+        def train_step(state: TrainState, batch: PyTree, rng) -> Tuple[TrainState, Dict[str, Any]]:
+            scale = state.loss_scale.cur_scale if fp16 else jnp.float32(1.0)
+
+            def micro_step(carry, xs):
+                grads_acc, loss_acc, i = carry
+                micro = jax.tree.map(lambda x: x[i], batch)
+                mrng = jax.random.fold_in(rng, i)
+                (_, (loss, _metrics)), grads = grad_fn(state.params, micro, mrng, scale)
+                if predivide:
+                    grads = jax.tree.map(lambda g: g / predivide_factor, grads)
+                grads_acc = jax.tree.map(
+                    lambda a, g: a + g.astype(acc_dtype), grads_acc, grads
+                )
+                # ZeRO >= 2: keep the accumulation buffer sharded over dp —
+                # XLA turns the dp-sum into reduce-scatter (stage3.py:1145 analog)
+                grads_acc = jax.lax.with_sharding_constraint(grads_acc, grad_shardings)
+                return (grads_acc, loss_acc + loss.astype(jnp.float32), i + 1), None
+
+            zero_grads = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, acc_dtype), state.params
+            )
+            zero_grads = jax.lax.with_sharding_constraint(zero_grads, grad_shardings)
+            (grads, loss_sum, _), _ = jax.lax.scan(
+                micro_step, (zero_grads, jnp.float32(0.0), 0), None, length=gas
+            )
+
+            # unscale + average over gas (reference: scale loss by 1/GAS, engine.py:1775)
+            inv = 1.0 / (scale * gas) if fp16 else 1.0 / gas
+            grads = jax.tree.map(lambda g: (g.astype(jnp.float32) * inv), grads)
+            if predivide and predivide_factor != 1.0:
+                grads = jax.tree.map(lambda g: g * predivide_factor, grads)
+
+            overflow = ls.has_inf_or_nan(grads) if fp16 else jnp.bool_(False)
+            grads = jax.tree.map(lambda g: jnp.where(overflow, jnp.zeros_like(g), g), grads)
+
+            gnorm = global_norm(grads)
+            if clip > 0.0:
+                coef = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+                grads = jax.tree.map(lambda g: g * coef, grads)
+
+            updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
+
+            # predicated skip-on-overflow (fp16/fused_optimizer.py step semantics)
+            new_params = _tree_select(~overflow, new_params, state.params)
+            new_opt_state = _tree_select(~overflow, new_opt_state, state.opt_state)
+
+            new_scale_state = ls.update(
+                state.loss_scale, overflow, dynamic=dynamic,
+                scale_window=scale_window, min_scale=min_scale,
+            )
+            new_state = TrainState(
+                params=new_params,
+                opt_state=new_opt_state,
+                loss_scale=new_scale_state,
+                global_step=state.global_step + jnp.where(overflow, 0, 1),
+                skipped_steps=state.skipped_steps + jnp.where(overflow, 1, 0),
+            )
+            metrics = {
+                "loss": loss_sum / gas,
+                "grad_norm": gnorm,
+                "loss_scale": state.loss_scale.cur_scale,
+                "overflow": overflow,
+                "lr": jnp.asarray(self.lr_schedule(state.global_step), jnp.float32),
+                "global_step": new_state.global_step,
+            }
+            return new_state, metrics
+
+        return train_step
+
+    def _make_eval_step(self):
+        model = self.module
+        compute_dtype = self.compute_dtype
+
+        def eval_step(params, batch, rng):
+            cparams = _cast_params(params, compute_dtype)
+
+            def micro(i, acc):
+                mb = jax.tree.map(lambda x: x[i], batch)
+                loss, _ = model.loss_fn(cparams, mb, rng, False)
+                return acc + loss.astype(jnp.float32)
+
+            n = jax.tree.leaves(batch)[0].shape[0]
+            total = jax.lax.fori_loop(0, n, micro, jnp.float32(0.0))
+            return total / n
+
+        return eval_step
+
+    # ------------------------------------------------------------------
+    # data plumbing (reference deepspeed_io, engine.py:1525)
+    # ------------------------------------------------------------------
+    def shard_batch(self, batch: PyTree) -> PyTree:
+        """Host batch [global_batch, ...] → device arrays [gas, micro*dp, ...]
+        with the micro dimension sharded over dp."""
+        gas = self.gradient_accumulation_steps_value
+
+        def put(x):
+            x = np.asarray(x)
+            assert x.shape[0] == self.train_batch_size_value, (
+                f"batch dim {x.shape[0]} != train_batch_size {self.train_batch_size_value}"
+            )
+            x = x.reshape(gas, -1, *x.shape[1:])
+            spec = PartitionSpec(None, "dp", *([None] * (x.ndim - 2)))
+            return jax.device_put(x, NamedSharding(self.mesh, spec))
+
+        return jax.tree.map(put, batch)
+
+    def deepspeed_io(self, dataset, batch_size=None, collate_fn=None, num_workers=0):
+        from .dataloader import DeepSpeedDataLoader
+
+        return DeepSpeedDataLoader(
+            dataset,
+            batch_size=batch_size or self.train_batch_size_value,
+            collate_fn=collate_fn,
+        )
+
+    # ------------------------------------------------------------------
+    # public training surface
+    # ------------------------------------------------------------------
+    def train_batch(self, batch: Optional[PyTree] = None, data_iter: Optional[Iterator] = None) -> Dict[str, Any]:
+        """Run one full training step (GAS micro-batches + optimizer update).
+
+        Accepts either a host batch pytree with leading dim = train_batch_size,
+        or an iterator yielding such batches (PipelineEngine-style API,
+        pipe/engine.py:294)."""
+        if batch is None:
+            if data_iter is None:
+                if self._data_iterator is None:
+                    from .dataloader import RepeatingLoader
+
+                    assert self.training_dataloader is not None, (
+                        "train_batch() without a batch requires training_data at init"
+                    )
+                    self._data_iterator = iter(RepeatingLoader(self.training_dataloader))
+                data_iter = self._data_iterator
+            batch = next(data_iter)
+        if self.wall_clock_breakdown:
+            self.timers(TRAIN_BATCH_TIMER).start()
+        self.tput_timer.start()
+        device_batch = self.shard_batch(batch)
+        self._rng, step_rng = jax.random.split(self._rng)
+        self.state, metrics = self._train_step(self.state, device_batch, step_rng)
+        self.global_steps += 1
+        if self.wall_clock_breakdown:
+            self.timers(TRAIN_BATCH_TIMER).stop(sync_tree=metrics)
+        self.tput_timer.stop(sync_tree=None)
+
+        if self.global_steps % self.steps_per_print == 0:
+            host = {k: float(v) for k, v in jax.device_get(metrics).items()}
+            host.pop("overflow", None)
+            log_dist(
+                f"step={int(host['global_step'])} loss={host['loss']:.4f} "
+                f"lr={host['lr']:.3e} gnorm={host['grad_norm']:.3f} scale={host['loss_scale']:.0f}"
+            )
+            if self.monitor is not None:
+                self.monitor.write_events(
+                    [
+                        ("Train/Samples/train_loss", host["loss"], self.global_steps),
+                        ("Train/Samples/lr", host["lr"], self.global_steps),
+                    ]
+                )
+            if self.wall_clock_breakdown:
+                self.timers.log([TRAIN_BATCH_TIMER])
+        return metrics
+
+    def eval_batch(self, batch: PyTree) -> jnp.ndarray:
+        device_batch = self.shard_batch(batch)
+        self._rng, step_rng = jax.random.split(self._rng)
+        return self._eval_step(self.state.params, device_batch, step_rng)
+
+    def predict(self, batch: PyTree):
+        assert self._jit_apply is not None, "module has no apply_fn"
+        cparams = _cast_params(self.state.params, self.compute_dtype)
+        return self._jit_apply(cparams, batch)
+
+    # ------------------------------------------------------------------
+    # properties (reference engine.py:466-788 property surface)
+    # ------------------------------------------------------------------
+    @property
+    def params(self) -> PyTree:
+        return self.state.params
+
+    @property
+    def train_batch_size(self) -> int:
+        return self.train_batch_size_value
+
+    @property
+    def train_micro_batch_size_per_gpu(self) -> int:
+        return self.micro_batch_size
+
+    @property
+    def gradient_accumulation_steps(self) -> int:
+        return self.gradient_accumulation_steps_value
+
+    @property
+    def loss_scale(self) -> float:
+        return float(jax.device_get(self.state.loss_scale.cur_scale))
+
+    @property
+    def skipped_steps(self) -> int:
+        """Exact count of overflow-skipped steps (device-side counter)."""
+        return int(jax.device_get(self.state.skipped_steps))
+
+    def get_global_step(self) -> int:
+        return int(jax.device_get(self.state.global_step))
+
+    def get_lr(self) -> float:
+        return float(jax.device_get(jnp.asarray(self.lr_schedule(self.state.global_step))))
+
+    def zero_optimization(self) -> bool:
+        return self.zero_stage > 0
+
+    def zero_optimization_stage(self) -> int:
+        return self.zero_stage
+
+    # ------------------------------------------------------------------
+    # checkpointing (reference engine.py:2881 save_checkpoint / :2531 load)
+    # ------------------------------------------------------------------
+    def save_checkpoint(self, save_dir: str, tag: Optional[str] = None, client_state: Optional[Dict] = None, save_latest: bool = True):
+        from ..checkpoint.engine import save_train_state
+
+        tag = tag or f"global_step{self.get_global_step()}"
+        path = save_train_state(
+            save_dir, tag, self.state,
+            client_state={**(client_state or {}), "global_steps": self.global_steps},
+            save_latest=save_latest,
+            async_save=self.config.checkpoint.async_save,
+        )
+        log_dist(f"saved checkpoint: {path}")
+        return path
+
+    def load_checkpoint(self, load_dir: str, tag: Optional[str] = None, load_optimizer_states: bool = True, load_lr_scheduler_states: bool = True):
+        from ..checkpoint.engine import load_train_state
+
+        state, client_state = load_train_state(
+            load_dir, tag, self.state, self.state_shardings,
+            load_optimizer_states=load_optimizer_states,
+        )
+        self.state = state
+        self.global_steps = int(client_state.get("global_steps", self.get_global_step()))
+        log_dist(f"loaded checkpoint from {load_dir} (tag={tag or 'latest'})")
+        return load_dir, client_state

@@ -1,0 +1,1 @@
+from . import loss_scaler

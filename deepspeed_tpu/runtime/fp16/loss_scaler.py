@@ -1,0 +1,110 @@
+"""Static + dynamic loss scaling as jittable state.
+
+Analog of reference ``deepspeed/runtime/fp16/loss_scaler.py`` (``LossScaler:54``,
+``DynamicLossScaler:77``) and the skip-on-overflow logic in
+``fp16/fused_optimizer.py``. The reference checks overflow on the host and
+skips ``optimizer.step()`` in Python; under XLA the whole step is one compiled
+program, so the skip becomes a *predicated* update: overflow → keep old
+params/opt-state and shrink the scale; no overflow → apply the step
+(SURVEY.md §7 "fp16 loss-scale semantics").
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LossScaleState(NamedTuple):
+    """All-array state so it lives inside the donated train-state pytree;
+    whether scaling is *dynamic* is a static engine-level flag."""
+
+    cur_scale: jnp.ndarray  # f32 scalar
+    cur_hysteresis: jnp.ndarray  # i32 scalar
+    last_overflow_iter: jnp.ndarray  # i32 scalar
+    iteration: jnp.ndarray  # i32 scalar
+
+    @property
+    def loss_scale(self):
+        return self.cur_scale
+
+
+def create(
+    static_loss_scale: float = 1.0,
+    dynamic: bool = False,
+    initial_scale_power: int = 16,
+    hysteresis: int = 2,
+) -> LossScaleState:
+    init = float(2**initial_scale_power) if dynamic else float(static_loss_scale)
+    return LossScaleState(
+        cur_scale=jnp.float32(init),
+        cur_hysteresis=jnp.int32(hysteresis),
+        last_overflow_iter=jnp.int32(-1),
+        iteration=jnp.int32(0),
+    )
+
+
+def from_config(fp16_cfg) -> LossScaleState:
+    """Build from an FP16Config section (reference config keys)."""
+    if not fp16_cfg.enabled:
+        return create(1.0, dynamic=False)
+    if fp16_cfg.dynamic_loss_scale:
+        return create(
+            dynamic=True,
+            initial_scale_power=fp16_cfg.initial_scale_power,
+            hysteresis=fp16_cfg.hysteresis,
+        )
+    return create(static_loss_scale=fp16_cfg.loss_scale, dynamic=False)
+
+
+def has_inf_or_nan(tree: Any) -> jnp.ndarray:
+    """Global overflow flag over a grad pytree (reference ``CheckOverflow`` /
+    ``stage3._has_inf_or_nan:2031``). Under pjit the sum is global, which
+    subsumes the reference's cross-rank overflow allreduce."""
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return jnp.bool_(False)
+    flags = [~jnp.isfinite(jnp.sum(leaf.astype(jnp.float32))) for leaf in leaves]
+    return jnp.any(jnp.stack(flags))
+
+
+def update(
+    state: LossScaleState,
+    overflow: jnp.ndarray,
+    dynamic: bool = True,
+    scale_window: int = 1000,
+    min_scale: float = 1.0,
+    scale_factor: float = 2.0,
+) -> LossScaleState:
+    """One dynamic-loss-scale transition (reference DynamicLossScaler.update_scale)."""
+    if not dynamic:
+        return state._replace(iteration=state.iteration + 1)
+
+    def on_overflow(s: LossScaleState):
+        exhausted = s.cur_hysteresis <= 1
+        new_scale = jnp.where(
+            exhausted, jnp.maximum(s.cur_scale / scale_factor, min_scale), s.cur_scale
+        )
+        return s._replace(
+            cur_scale=new_scale,
+            cur_hysteresis=jnp.where(exhausted, s.cur_hysteresis, s.cur_hysteresis - 1),
+            last_overflow_iter=s.iteration,
+        )
+
+    def on_success(s: LossScaleState):
+        grow = (s.iteration - s.last_overflow_iter) % scale_window == (scale_window - 1)
+        return s._replace(cur_scale=jnp.where(grow, s.cur_scale * scale_factor, s.cur_scale))
+
+    new_state = jax.lax.cond(overflow, on_overflow, on_success, state)
+    return new_state._replace(iteration=state.iteration + 1)
+
+
+def scale_loss(loss: jnp.ndarray, state: LossScaleState) -> jnp.ndarray:
+    return loss * state.cur_scale.astype(loss.dtype)
+
+
+def unscale_grads(grads: Any, state: LossScaleState) -> Any:
+    inv = (1.0 / state.cur_scale).astype(jnp.float32)
+    return jax.tree.map(lambda g: g * inv.astype(g.dtype), grads)

@@ -1,0 +1,164 @@
+"""LR schedules: LRRangeTest / OneCycle / WarmupLR / WarmupDecayLR.
+
+Analog of reference ``deepspeed/runtime/lr_schedules.py`` (854 LoC). The
+reference implements stateful torch schedulers that mutate optimizer param
+groups; here each schedule is a pure ``step → lr`` function (optax schedule
+convention) usable both inside the jitted train step and standalone, plus a
+``get_lr_scheduler`` registry keyed by the same config ``type`` strings.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+import jax.numpy as jnp
+
+LR_RANGE_TEST = "LRRangeTest"
+ONE_CYCLE = "OneCycle"
+WARMUP_LR = "WarmupLR"
+WARMUP_DECAY_LR = "WarmupDecayLR"
+COSINE_ANNEALING = "CosineAnnealing"  # convenience extension
+
+VALID_LR_SCHEDULES = [LR_RANGE_TEST, ONE_CYCLE, WARMUP_LR, WARMUP_DECAY_LR, COSINE_ANNEALING]
+
+Schedule = Callable[[Any], Any]
+
+
+def lr_range_test(
+    lr_range_test_min_lr: float = 1e-3,
+    lr_range_test_step_size: int = 2000,
+    lr_range_test_step_rate: float = 1.0,
+    lr_range_test_staircase: bool = False,
+    **_: Any,
+) -> Schedule:
+    """Reference lr_schedules.py:308 — LR sweep for tuning."""
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        interval = step / lr_range_test_step_size
+        if lr_range_test_staircase:
+            interval = jnp.floor(interval)
+        return lr_range_test_min_lr * (1.0 + interval * lr_range_test_step_rate)
+
+    return schedule
+
+
+def one_cycle(
+    cycle_min_lr: float,
+    cycle_max_lr: float,
+    decay_lr_rate: float = 0.0,
+    cycle_first_step_size: int = 2000,
+    cycle_second_step_size: Optional[int] = None,
+    cycle_first_stair_count: int = 0,
+    cycle_second_stair_count: Optional[int] = None,
+    decay_step_size: int = 0,
+    **_: Any,
+) -> Schedule:
+    """Reference lr_schedules.py:415 — 1cycle policy (momentum handled by
+    optimizer wrapper if requested)."""
+    second = cycle_second_step_size if cycle_second_step_size is not None else cycle_first_step_size
+    total_cycle = cycle_first_step_size + second
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        in_up = step < cycle_first_step_size
+        up_frac = jnp.clip(step / max(cycle_first_step_size, 1), 0.0, 1.0)
+        down_frac = jnp.clip((step - cycle_first_step_size) / max(second, 1), 0.0, 1.0)
+        up_lr = cycle_min_lr + (cycle_max_lr - cycle_min_lr) * up_frac
+        down_lr = cycle_max_lr - (cycle_max_lr - cycle_min_lr) * down_frac
+        cyc_lr = jnp.where(in_up, up_lr, down_lr)
+        # decay phase after the cycle completes
+        post = jnp.maximum(step - total_cycle, 0.0)
+        if decay_lr_rate > 0.0 and decay_step_size > 0:
+            decay = 1.0 / (1.0 + decay_lr_rate * jnp.floor(post / decay_step_size))
+        else:
+            decay = 1.0
+        return jnp.where(step < total_cycle, cyc_lr, cycle_min_lr * decay)
+
+    return schedule
+
+
+def warmup_lr(
+    warmup_min_lr: float = 0.0,
+    warmup_max_lr: float = 0.001,
+    warmup_num_steps: int = 1000,
+    warmup_type: str = "log",
+    **_: Any,
+) -> Schedule:
+    """Reference lr_schedules.py:704 — warmup then hold."""
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        frac = jnp.clip((step + 1) / max(warmup_num_steps, 1), 0.0, 1.0)
+        if warmup_type == "log":
+            gamma = jnp.log(frac * (math.e - 1.0) + 1.0)
+        else:
+            gamma = frac
+        return warmup_min_lr + (warmup_max_lr - warmup_min_lr) * gamma
+
+    return schedule
+
+
+def warmup_decay_lr(
+    total_num_steps: int,
+    warmup_min_lr: float = 0.0,
+    warmup_max_lr: float = 0.001,
+    warmup_num_steps: int = 1000,
+    warmup_type: str = "log",
+    **_: Any,
+) -> Schedule:
+    """Reference lr_schedules.py — warmup then linear decay to 0."""
+    base = warmup_lr(warmup_min_lr, warmup_max_lr, warmup_num_steps, warmup_type)
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = base(step)
+        decay = jnp.clip(
+            (total_num_steps - step) / jnp.maximum(float(total_num_steps - warmup_num_steps), 1.0),
+            0.0,
+            1.0,
+        )
+        return jnp.where(step < warmup_num_steps, warm, warmup_max_lr * decay)
+
+    return schedule
+
+
+def cosine_annealing(
+    total_num_steps: int,
+    warmup_num_steps: int = 0,
+    warmup_max_lr: float = 1e-3,
+    warmup_min_lr: float = 0.0,
+    cosine_min_ratio: float = 0.1,
+    **_: Any,
+) -> Schedule:
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm_frac = jnp.clip((step + 1) / max(warmup_num_steps, 1), 0.0, 1.0)
+        warm = warmup_min_lr + (warmup_max_lr - warmup_min_lr) * warm_frac
+        prog = jnp.clip(
+            (step - warmup_num_steps) / max(total_num_steps - warmup_num_steps, 1), 0.0, 1.0
+        )
+        cos = cosine_min_ratio + (1 - cosine_min_ratio) * 0.5 * (1 + jnp.cos(jnp.pi * prog))
+        return jnp.where(step < warmup_num_steps, warm, warmup_max_lr * cos)
+
+    return schedule
+
+
+_REGISTRY: Dict[str, Callable[..., Schedule]] = {
+    LR_RANGE_TEST: lr_range_test,
+    ONE_CYCLE: one_cycle,
+    WARMUP_LR: warmup_lr,
+    WARMUP_DECAY_LR: warmup_decay_lr,
+    COSINE_ANNEALING: cosine_annealing,
+}
+
+
+def get_lr_schedule(name: Optional[str], params: Optional[Dict[str, Any]] = None, fallback_lr: float = 1e-3) -> Schedule:
+    """Build a schedule from config ``scheduler: {type, params}``; no scheduler
+    → constant lr (the optimizer's own)."""
+    if name is None:
+        return lambda step: jnp.float32(fallback_lr)
+    if name not in _REGISTRY:
+        raise ValueError(f"unknown scheduler type {name}; valid: {VALID_LR_SCHEDULES}")
+    return _REGISTRY[name](**(params or {}))

@@ -1,0 +1,61 @@
+"""The model abstraction the engine trains.
+
+The reference wraps a ``torch.nn.Module`` (engine.py:179). The TPU-native
+equivalent is functional: a :class:`ModuleSpec` bundles pure functions + the
+param pytree's sharding metadata. Anything — hand-written JAX, flax, haiku —
+adapts to this in a few lines (see ``deepspeed_tpu/models`` for built-ins and
+``from_flax`` below).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Tuple
+
+PyTree = Any
+Batch = Any
+
+# loss_fn(params, batch, rng, train) -> (loss, metrics_dict)
+LossFn = Callable[[PyTree, Batch, Any, bool], Tuple[Any, Dict[str, Any]]]
+
+
+@dataclass
+class ModuleSpec:
+    """A trainable model: initializer + loss + (optional) forward.
+
+    Attributes:
+      init: ``rng -> params`` pure initializer (runs under jit with sharded
+        out_shardings — the ``zero.Init`` analog, so huge models never
+        materialize unsharded).
+      loss_fn: ``(params, batch, rng, train) -> (scalar_loss, metrics)``.
+      apply_fn: optional inference forward ``(params, batch) -> outputs``.
+      logical_axes: pytree matching params; each leaf a tuple of logical axis
+        names (``("embed", "mlp")`` …) consumed by the ZeRO/TP sharding policy.
+        None → fully unannotated (ZeRO still shards; TP won't).
+      remat: optional override of config remat policy for this model.
+    """
+
+    init: Callable[[Any], PyTree]
+    loss_fn: LossFn
+    apply_fn: Optional[Callable] = None
+    logical_axes: Optional[PyTree] = None
+    num_layers: int = 0
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+def from_flax(flax_module, sample_batch_fn, loss_from_logits) -> ModuleSpec:
+    """Adapt a flax.linen module: params from ``module.init``, loss composed
+    from ``module.apply``. Logical axes come from flax ``nn.Partitioned``
+    metadata when present."""
+    import jax
+
+    def init(rng):
+        variables = flax_module.init(rng, sample_batch_fn())
+        return variables["params"]
+
+    def loss_fn(params, batch, rng, train):
+        logits = flax_module.apply({"params": params}, batch["inputs"])
+        loss = loss_from_logits(logits, batch)
+        return loss, {}
+
+    return ModuleSpec(init=init, loss_fn=loss_fn)

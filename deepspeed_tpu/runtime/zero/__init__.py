@@ -1,0 +1,8 @@
+from .partitioning import (
+    DEFAULT_LOGICAL_RULES,
+    ZeroShardingPolicy,
+    add_zero_axis,
+    gather_full,
+    init_partitioned,
+    logical_to_spec,
+)

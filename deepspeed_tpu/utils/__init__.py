@@ -1,0 +1,2 @@
+from .logging import log_dist, logger, print_rank_0
+from .timer import SynchronizedWallClockTimer, ThroughputTimer

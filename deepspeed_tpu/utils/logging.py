@@ -1,0 +1,77 @@
+"""Rank-filtered logging for multi-host TPU jobs.
+
+TPU-native analog of the reference's ``deepspeed/utils/logging.py`` (``log_dist``,
+``logger``): on a TPU pod each host runs one Python process, so "rank" here is
+``jax.process_index()`` rather than a torch.distributed rank.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+import sys
+
+LOG_LEVEL = os.environ.get("DSTPU_LOG_LEVEL", "INFO").upper()
+
+log_levels = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+@functools.lru_cache(None)
+def _create_logger(name: str = "deepspeed_tpu", level: str = LOG_LEVEL) -> logging.Logger:
+    logger_ = logging.getLogger(name)
+    logger_.setLevel(getattr(logging, level, logging.INFO))
+    logger_.propagate = False
+    if not logger_.handlers:
+        handler = logging.StreamHandler(stream=sys.stdout)
+        handler.setFormatter(
+            logging.Formatter(
+                "[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s",
+                datefmt="%Y-%m-%d %H:%M:%S",
+            )
+        )
+        logger_.addHandler(handler)
+    return logger_
+
+
+logger = _create_logger()
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:  # pragma: no cover - before jax init
+        return 0
+
+
+def log_dist(message: str, ranks=None, level: int = logging.INFO) -> None:
+    """Log ``message`` only on the given process indices (default: process 0).
+
+    ``ranks=[-1]`` logs on every process, mirroring the reference semantics.
+    """
+    my_rank = _process_index()
+    ranks = ranks if ranks else [0]
+    if my_rank in ranks or -1 in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def print_rank_0(message: str) -> None:
+    if _process_index() == 0:
+        print(message, flush=True)
+
+
+def warning_once(message: str) -> None:
+    _warn_once(message)
+
+
+@functools.lru_cache(None)
+def _warn_once(message: str) -> None:
+    logger.warning(message)

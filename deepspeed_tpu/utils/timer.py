@@ -1,0 +1,154 @@
+"""Wall-clock and throughput timers.
+
+TPU-native analog of ``deepspeed/utils/timer.py`` (``SynchronizedWallClockTimer``,
+``ThroughputTimer``). The reference synchronizes with CUDA events; on TPU the
+equivalent synchronization point is ``jax.block_until_ready`` on the arrays
+produced by the timed region (XLA executes asynchronously just like CUDA
+streams). Timers accept an optional pytree to block on.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+
+from .logging import log_dist
+
+FORWARD_MICRO_TIMER = "fwd_microstep"
+FORWARD_GLOBAL_TIMER = "fwd"
+BACKWARD_MICRO_TIMER = "bwd_microstep"
+BACKWARD_GLOBAL_TIMER = "bwd"
+STEP_MICRO_TIMER = "step_microstep"
+STEP_GLOBAL_TIMER = "step"
+TRAIN_BATCH_TIMER = "train_batch"
+
+
+def _sync(tree: Any = None) -> None:
+    if tree is not None:
+        jax.block_until_ready(tree)
+
+
+class _Timer:
+    def __init__(self, name: str):
+        self.name = name
+        self.started = False
+        self.start_time = 0.0
+        self.elapsed_ = 0.0
+        self.count = 0
+
+    def start(self, sync_tree: Any = None) -> None:
+        _sync(sync_tree)
+        self.start_time = time.perf_counter()
+        self.started = True
+
+    def stop(self, sync_tree: Any = None, record: bool = True) -> None:
+        if not self.started:
+            return
+        _sync(sync_tree)
+        if record:
+            self.elapsed_ += time.perf_counter() - self.start_time
+            self.count += 1
+        self.started = False
+
+    def elapsed(self, reset: bool = True) -> float:
+        value = self.elapsed_
+        if reset:
+            self.reset()
+        return value
+
+    def mean(self) -> float:
+        return self.elapsed_ / self.count if self.count else 0.0
+
+    def reset(self) -> None:
+        self.elapsed_ = 0.0
+        self.count = 0
+        self.started = False
+
+
+class SynchronizedWallClockTimer:
+    """Group of named timers; analog of reference ``timer.py:31``."""
+
+    def __init__(self):
+        self.timers: Dict[str, _Timer] = {}
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def has_timer(self, name: str) -> bool:
+        return name in self.timers
+
+    def log(
+        self,
+        names: List[str],
+        normalizer: float = 1.0,
+        reset: bool = True,
+        ranks: Optional[List[int]] = None,
+    ) -> None:
+        assert normalizer > 0.0
+        parts = []
+        for name in names:
+            if name in self.timers:
+                ms = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                parts.append(f"{name}: {ms:.2f}")
+        if parts:
+            log_dist("time (ms) | " + " | ".join(parts), ranks=ranks)
+
+    def get_mean(self, names: List[str], normalizer: float = 1.0) -> Dict[str, float]:
+        return {
+            name: self.timers[name].mean() * 1000.0 / normalizer
+            for name in names
+            if name in self.timers
+        }
+
+
+class ThroughputTimer:
+    """Samples/sec + tokens/sec meter; analog of reference ``timer.py:135``."""
+
+    def __init__(self, batch_size: int, start_step: int = 2, steps_per_output: int = 50, monitor_memory: bool = False, logging_fn=None):
+        self.batch_size = max(1, batch_size)
+        self.start_step = start_step
+        self.steps_per_output = steps_per_output
+        self.logging = logging_fn or log_dist
+        self.initialized = False
+        self.global_step_count = 0
+        self.total_elapsed_time = 0.0
+        self.step_elapsed_time = 0.0
+        self.start_time = 0.0
+        self.started = False
+
+    def update_epoch_count(self) -> None:
+        self.initialized = False
+
+    def start(self) -> None:
+        self.started = True
+        if self.global_step_count >= self.start_step:
+            self.start_time = time.perf_counter()
+
+    def stop(self, global_step: bool = True, report_speed: bool = True, sync_tree: Any = None) -> None:
+        if not self.started:
+            return
+        self.started = False
+        if global_step:
+            self.global_step_count += 1
+        if self.start_time and self.global_step_count > self.start_step:
+            _sync(sync_tree)
+            duration = time.perf_counter() - self.start_time
+            self.total_elapsed_time += duration
+            self.step_elapsed_time += duration
+            if global_step and report_speed and self.global_step_count % self.steps_per_output == 0:
+                self.logging(
+                    f"step={self.global_step_count}, "
+                    f"samples/sec (avg): {self.avg_samples_per_sec():.2f}, "
+                    f"samples/sec (window): {self.steps_per_output * self.batch_size / max(self.step_elapsed_time, 1e-9):.2f}"
+                )
+                self.step_elapsed_time = 0.0
+
+    def avg_samples_per_sec(self) -> float:
+        if self.global_step_count > self.start_step and self.total_elapsed_time > 0:
+            steps = self.global_step_count - self.start_step
+            return steps * self.batch_size / self.total_elapsed_time
+        return 0.0

@@ -1,0 +1,58 @@
+"""Test harness: in-process multi-device mesh on CPU.
+
+The reference's ``DistributedTest`` (tests/unit/common.py:66) forks N
+processes and rendezvouses NCCL to simulate a cluster. The TPU-native analog
+is strictly simpler: 8 virtual CPU devices in ONE process via
+``--xla_force_host_platform_device_count=8``; every sharded test runs the same
+code that runs on a real TPU slice (SURVEY.md §4 "translation to the TPU
+build"). Env vars must be set before jax initializes, hence this module-level
+block.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: harness may pre-set a TPU platform
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The environment's sitecustomize may import jax (registering a TPU plugin)
+# before this file runs, making the env var too late — override via config.
+jax.config.update("jax_platforms", "cpu")
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture
+def mesh_dp8(devices):
+    from deepspeed_tpu.parallel.topology import MeshSpec
+
+    return MeshSpec(dp=8).build_mesh()
+
+
+@pytest.fixture
+def mesh_dp4_tp2(devices):
+    from deepspeed_tpu.parallel.topology import MeshSpec
+
+    return MeshSpec(dp=4, tp=2).build_mesh()
+
+
+@pytest.fixture
+def mesh_single(devices):
+    from deepspeed_tpu.parallel.topology import MeshSpec
+
+    return MeshSpec(dp=1, devices=devices[:1]).build_mesh()
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(42)

@@ -1,0 +1,68 @@
+"""Standard tiny workloads for runtime tests.
+
+Analog of reference ``tests/unit/simple_model.py`` (SimpleModel stack of
+Linears + CE loss, random_dataloader): the default fixture every engine/ZeRO
+test trains for a few steps.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.runtime.module import ModuleSpec
+
+
+def make_simple_model(hidden_dim: int = 32, nlayers: int = 2, out_dim: int = 8) -> ModuleSpec:
+    def init(rng):
+        keys = jax.random.split(rng, nlayers + 1)
+        layers = []
+        for i in range(nlayers):
+            layers.append(
+                {
+                    "w": jax.random.normal(keys[i], (hidden_dim, hidden_dim)) * 0.1,
+                    "b": jnp.zeros((hidden_dim,)),
+                }
+            )
+        head = {
+            "w": jax.random.normal(keys[-1], (hidden_dim, out_dim)) * 0.1,
+            "b": jnp.zeros((out_dim,)),
+        }
+        return {"layers": layers, "head": head}
+
+    def loss_fn(params, batch, rng, train):
+        x = batch["x"]
+        for layer in params["layers"]:
+            x = jnp.tanh(x @ layer["w"] + layer["b"])
+        logits = x @ params["head"]["w"] + params["head"]["b"]
+        labels = batch["y"]
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        loss = -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
+        return loss, {}
+
+    return ModuleSpec(init=init, loss_fn=loss_fn)
+
+
+def random_batches(n_batches: int, batch_size: int, hidden_dim: int = 32, out_dim: int = 8, seed: int = 0):
+    rs = np.random.RandomState(seed)
+    return [
+        {
+            "x": rs.randn(batch_size, hidden_dim).astype(np.float32),
+            "y": rs.randint(0, out_dim, size=(batch_size,)).astype(np.int32),
+        }
+        for _ in range(n_batches)
+    ]
+
+
+def base_config(stage: int = 0, micro: int = 4, gas: int = 2, dp: int = 8, **extra):
+    cfg = {
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": gas,
+        "steps_per_print": 1000,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": stage},
+        "mesh": {"dp": dp},
+    }
+    cfg.update(extra)
+    return cfg

@@ -1,0 +1,99 @@
+"""Config parsing + batch-triple math — analog of reference tests/unit/test_config.py."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
+
+
+def test_batch_triple_full():
+    cfg = DeepSpeedConfig.load(
+        {"train_batch_size": 64, "train_micro_batch_size_per_gpu": 4, "gradient_accumulation_steps": 2},
+        dp_world_size=8,
+    )
+    assert cfg.train_batch_size == 64
+
+
+def test_batch_triple_derive_gas():
+    cfg = DeepSpeedConfig.load(
+        {"train_batch_size": 64, "train_micro_batch_size_per_gpu": 4}, dp_world_size=8
+    )
+    assert cfg.gradient_accumulation_steps == 2
+
+
+def test_batch_triple_derive_tb():
+    cfg = DeepSpeedConfig.load({"train_micro_batch_size_per_gpu": 4}, dp_world_size=8)
+    assert cfg.train_batch_size == 32
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_batch_triple_mismatch():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig.load(
+            {"train_batch_size": 65, "train_micro_batch_size_per_gpu": 4, "gradient_accumulation_steps": 2},
+            dp_world_size=8,
+        )
+
+
+def test_batch_required():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig.load({}, dp_world_size=1)
+
+
+def test_fp16_bf16_exclusive():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig.load(
+            {"train_batch_size": 8, "fp16": {"enabled": True}, "bf16": {"enabled": True}},
+            dp_world_size=1,
+        )
+
+
+def test_ds_json_keys_accepted():
+    """A realistic reference-style ds_config parses with exact key names."""
+    ds_config = {
+        "train_batch_size": 16,
+        "gradient_accumulation_steps": 1,
+        "steps_per_print": 10,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.00015, "betas": [0.9, 0.999], "eps": 1e-8}},
+        "scheduler": {"type": "WarmupLR", "params": {"warmup_min_lr": 0, "warmup_max_lr": 0.00015, "warmup_num_steps": 1000}},
+        "gradient_clipping": 1.0,
+        "fp16": {"enabled": True, "loss_scale": 0, "initial_scale_power": 16, "loss_scale_window": 1000, "hysteresis": 2, "min_loss_scale": 1},
+        "zero_optimization": {
+            "stage": 2,
+            "allgather_partitions": True,
+            "allgather_bucket_size": 500000000,
+            "overlap_comm": True,
+            "reduce_scatter": True,
+            "reduce_bucket_size": 500000000,
+            "contiguous_gradients": True,
+            "offload_optimizer": {"device": "cpu", "pin_memory": True},
+        },
+        "wall_clock_breakdown": False,
+    }
+    cfg = DeepSpeedConfig.load(ds_config, dp_world_size=16)
+    assert cfg.zero_optimization.stage == 2
+    assert cfg.zero_optimization.offload_optimizer.device == "cpu"
+    assert cfg.fp16.dynamic_loss_scale
+    assert cfg.scheduler.type == "WarmupLR"
+    assert cfg.train_micro_batch_size_per_gpu == 1
+
+
+def test_scientific_notation_strings():
+    cfg = DeepSpeedConfig.load(
+        {"train_batch_size": 8, "zero_optimization": {"stage": 1, "reduce_bucket_size": "5e8"}},
+        dp_world_size=1,
+    )
+    assert cfg.zero_optimization.reduce_bucket_size == 500000000
+
+
+def test_config_from_file(tmp_path):
+    p = tmp_path / "ds_config.json"
+    p.write_text(json.dumps({"train_batch_size": 8}))
+    cfg = DeepSpeedConfig.load(str(p), dp_world_size=2)
+    assert cfg.train_micro_batch_size_per_gpu == 4
+
+
+def test_invalid_zero_stage():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig.load({"train_batch_size": 8, "zero_optimization": {"stage": 5}}, dp_world_size=1)

@@ -1,0 +1,116 @@
+"""Engine behavior: convergence, GAS equivalence, fp16 skip, clipping.
+
+Analog of reference tests/unit/test_fp16.py + runtime engine tests.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+from .simple_model import base_config, make_simple_model, random_batches
+
+
+def _make_engine(mesh, dp, stage=0, **extra):
+    model = make_simple_model()
+    cfg = DeepSpeedConfig.load(base_config(stage=stage, dp=dp, **extra), dp_world_size=dp)
+    return DeepSpeedEngine(model, cfg, mesh=mesh, seed=1)
+
+
+def test_loss_decreases(mesh_dp8):
+    engine = _make_engine(mesh_dp8, dp=8)
+    batch = random_batches(1, engine.train_batch_size)[0]
+    first = float(engine.train_batch(batch)["loss"])
+    for _ in range(20):
+        last = float(engine.train_batch(batch)["loss"])
+    assert last < first * 0.9, f"no progress: {first} -> {last}"
+
+
+def test_gas_equivalence(mesh_dp8):
+    """gas=4/micro=1 must equal gas=1/micro=4 (same global batch)."""
+    b = random_batches(1, 32, seed=11)[0]
+    e1 = _make_engine(mesh_dp8, dp=8, micro=4, gas=1)
+    e2 = _make_engine(mesh_dp8, dp=8, micro=1, gas=4)
+    l1 = [float(e1.train_batch(b)["loss"]) for _ in range(3)]
+    l2 = [float(e2.train_batch(b)["loss"]) for _ in range(3)]
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)
+
+
+def test_global_step_advances(mesh_dp8):
+    engine = _make_engine(mesh_dp8, dp=8)
+    batch = random_batches(1, engine.train_batch_size)[0]
+    engine.train_batch(batch)
+    engine.train_batch(batch)
+    assert engine.get_global_step() == 2
+
+
+def test_eval_batch(mesh_dp8):
+    engine = _make_engine(mesh_dp8, dp=8)
+    batch = random_batches(1, engine.train_batch_size)[0]
+    loss = float(engine.eval_batch(batch))
+    assert np.isfinite(loss) and loss > 0
+
+
+def test_grad_clipping(mesh_dp8):
+    engine = _make_engine(mesh_dp8, dp=8, gradient_clipping=1e-4)
+    batch = random_batches(1, engine.train_batch_size)[0]
+    before = jax.device_get(engine.state.params["head"]["w"])
+    engine.train_batch(batch)
+    after = jax.device_get(engine.state.params["head"]["w"])
+    # clipped grads → tiny update (lr * clip-ish scale)
+    assert np.max(np.abs(after - before)) < 1e-1
+
+
+def test_fp16_dynamic_scale_and_skip(mesh_dp8):
+    """Feed a poisoned batch → overflow detected, step skipped, scale halved."""
+    model = make_simple_model()
+    cfg = DeepSpeedConfig.load(
+        base_config(stage=0, dp=8, fp16={"enabled": True, "initial_scale_power": 4, "hysteresis": 1}),
+        dp_world_size=8,
+    )
+    engine = DeepSpeedEngine(model, cfg, mesh=mesh_dp8, seed=1)
+    good = random_batches(1, engine.train_batch_size)[0]
+    bad = {k: v.copy() for k, v in good.items()}
+    bad["x"][:] = np.inf  # force non-finite loss → non-finite grads
+
+    params_before = jax.device_get(engine.state.params["head"]["w"])
+    scale_before = engine.loss_scale
+    m = engine.train_batch(bad)
+    assert bool(jax.device_get(m["overflow"]))
+    params_after = jax.device_get(engine.state.params["head"]["w"])
+    np.testing.assert_array_equal(params_before, params_after)  # step skipped
+    assert engine.loss_scale == scale_before / 2  # scale backoff
+    assert engine.get_global_step() == 0
+
+    m = engine.train_batch(good)
+    assert not bool(jax.device_get(m["overflow"]))
+    assert engine.get_global_step() == 1
+
+
+def test_bf16_training(mesh_dp8):
+    model = make_simple_model()
+    cfg = DeepSpeedConfig.load(
+        base_config(stage=2, dp=8, bf16={"enabled": True}), dp_world_size=8
+    )
+    engine = DeepSpeedEngine(model, cfg, mesh=mesh_dp8, seed=1)
+    batch = random_batches(1, engine.train_batch_size)[0]
+    first = float(engine.train_batch(batch)["loss"])
+    for _ in range(15):
+        last = float(engine.train_batch(batch)["loss"])
+    assert last < first
+
+
+def test_initialize_api(mesh_dp8):
+    import deepspeed_tpu
+
+    model = make_simple_model()
+    engine, optimizer, dataloader, lr = deepspeed_tpu.initialize(
+        model=model, config=base_config(stage=1, dp=8), mesh=mesh_dp8
+    )
+    assert engine.zero_optimization_stage() == 1
+    assert optimizer is engine.optimizer
+    batch = random_batches(1, engine.train_batch_size)[0]
+    engine.train_batch(batch)

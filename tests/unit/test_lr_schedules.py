@@ -1,0 +1,53 @@
+"""LR schedule math — analog of reference tests for runtime/lr_schedules.py."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.lr_schedules import (
+    get_lr_schedule,
+    lr_range_test,
+    one_cycle,
+    warmup_decay_lr,
+    warmup_lr,
+)
+
+
+def test_warmup_lr_endpoints():
+    s = warmup_lr(warmup_min_lr=0.0, warmup_max_lr=1e-3, warmup_num_steps=100, warmup_type="linear")
+    assert float(s(0)) == pytest.approx(1e-5, rel=1e-3)
+    assert float(s(99)) == pytest.approx(1e-3, rel=1e-3)
+    assert float(s(500)) == pytest.approx(1e-3, rel=1e-3)  # holds after warmup
+
+
+def test_warmup_log_monotone():
+    s = warmup_lr(warmup_max_lr=1e-3, warmup_num_steps=50, warmup_type="log")
+    vals = [float(s(i)) for i in range(60)]
+    assert all(b >= a - 1e-12 for a, b in zip(vals, vals[1:]))
+
+
+def test_warmup_decay_reaches_zero():
+    s = warmup_decay_lr(total_num_steps=100, warmup_max_lr=1e-3, warmup_num_steps=10)
+    assert float(s(100)) == pytest.approx(0.0, abs=1e-9)
+    assert float(s(55)) == pytest.approx(1e-3 * 0.5, rel=0.02)
+
+
+def test_one_cycle_shape():
+    s = one_cycle(cycle_min_lr=1e-4, cycle_max_lr=1e-3, cycle_first_step_size=10)
+    assert float(s(0)) == pytest.approx(1e-4, rel=1e-3)
+    assert float(s(10)) == pytest.approx(1e-3, rel=1e-3)
+    assert float(s(20)) == pytest.approx(1e-4, rel=1e-2)
+
+
+def test_lr_range_test_growth():
+    s = lr_range_test(lr_range_test_min_lr=1e-4, lr_range_test_step_size=10, lr_range_test_step_rate=1.0, lr_range_test_staircase=True)
+    assert float(s(0)) == pytest.approx(1e-4)
+    assert float(s(10)) == pytest.approx(2e-4)
+
+
+def test_registry():
+    s = get_lr_schedule("WarmupLR", {"warmup_max_lr": 1e-3})
+    assert s is not None
+    with pytest.raises(ValueError):
+        get_lr_schedule("NoSuch", {})
+    const = get_lr_schedule(None, None, fallback_lr=0.5)
+    assert float(const(123)) == 0.5

@@ -1,0 +1,58 @@
+"""Topology grid math — analog of reference tests/unit/runtime/pipe/test_topology.py."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.parallel.topology import (
+    MeshSpec,
+    PipeModelDataParallelTopology,
+    ProcessTopology,
+)
+
+
+def test_topology_2d():
+    topo = ProcessTopology(axes=["row", "col"], dims=[2, 2])
+    assert topo.world_size() == 4
+    assert topo.get_rank(row=0, col=0) == 0
+    assert topo.get_rank(row=0, col=1) == 1
+    assert topo.get_rank(row=1, col=0) == 2
+    assert topo.get_rank(row=1, col=1) == 3
+    assert topo.get_coord(2) == topo.ProcessCoord(row=1, col=0)
+
+
+def test_topology_3d():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    assert topo.world_size() == 8
+    assert topo.axes == ["pp", "dp", "tp"]
+    # axis membership lists
+    assert topo.get_axis_list("pp", 0) == [0, 1, 2, 3]
+    assert topo.get_axis_list("pp", 1) == [4, 5, 6, 7]
+    # comm lists along tp: consecutive pairs
+    tp_lists = topo.get_axis_comm_lists("tp")
+    assert [0, 1] in tp_lists and [6, 7] in tp_lists
+    # filter
+    assert topo.filter_match(pp=1, dp=0) == [4, 5]
+
+
+def test_rank_repr():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=1)
+    assert topo.get_rank_repr(0) == "tp_00"
+
+
+def test_mesh_spec_fill(devices):
+    topo = MeshSpec(dp=-1, tp=2).resolve()
+    assert topo.get_dim("dp") == 4
+    assert topo.get_dim("tp") == 2
+    mesh = topo.get_mesh()
+    assert mesh.shape["dp"] == 4 and mesh.shape["tp"] == 2
+
+
+def test_mesh_spec_mismatch(devices):
+    with pytest.raises(AssertionError):
+        MeshSpec(dp=3, tp=2).build_mesh()  # 6 != 8
+
+
+def test_mesh_axis_order(devices):
+    mesh = MeshSpec(dp=2, tp=2, pp=2).build_mesh()
+    # canonical order: pp outermost, tp innermost (ICI locality)
+    assert tuple(mesh.axis_names) == ("pp", "dp", "tp")

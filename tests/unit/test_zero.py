@@ -1,0 +1,119 @@
+"""ZeRO sharding-policy + numerical-parity tests.
+
+Analog of reference tests/unit/test_zero.py (correctness vs baseline across
+stages): here the baseline is the same model trained on a single device, and
+each ZeRO stage on an 8-way dp mesh must produce identical losses (the
+strongest possible parity statement — sharding must be semantics-preserving).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+from deepspeed_tpu.runtime.zero.partitioning import (
+    ZeroShardingPolicy,
+    add_zero_axis,
+    logical_to_spec,
+)
+
+from .simple_model import base_config, make_simple_model, random_batches
+
+
+def test_logical_to_spec(mesh_dp4_tp2):
+    spec = logical_to_spec(("embed", "mlp"), mesh=mesh_dp4_tp2)
+    assert spec == PartitionSpec(None, "tp")
+    # tp axis used once only
+    spec2 = logical_to_spec(("qkv", "mlp"), mesh=mesh_dp4_tp2)
+    assert spec2 == PartitionSpec("tp")
+
+
+def test_add_zero_axis(mesh_dp8):
+    spec = add_zero_axis(PartitionSpec(), (1024, 64), mesh_dp8, min_size_to_shard=0)
+    assert spec == PartitionSpec("dp")
+    # small tensors stay replicated (persistence threshold analog)
+    spec = add_zero_axis(PartitionSpec(), (4,), mesh_dp8, min_size_to_shard=2**14)
+    assert spec == PartitionSpec()
+    # indivisible dims stay replicated
+    spec = add_zero_axis(PartitionSpec(), (3, 5), mesh_dp8, min_size_to_shard=0)
+    assert spec == PartitionSpec()
+
+
+def test_add_zero_axis_composes_with_tp(mesh_dp4_tp2):
+    # dim0 taken by tp → dp goes to the largest free dim
+    spec = add_zero_axis(PartitionSpec("tp", None), (256, 512), mesh_dp4_tp2, min_size_to_shard=0)
+    assert spec == PartitionSpec("tp", "dp")
+
+
+def test_stage_policies(mesh_dp8):
+    import numpy as _np
+
+    abstract = {"w": jax.ShapeDtypeStruct((256, 256), jnp.float32)}
+    for stage, (p_sharded, g_sharded, o_sharded) in {
+        0: (False, False, False),
+        1: (False, False, True),
+        2: (False, True, True),
+        3: (True, True, True),
+    }.items():
+        policy = ZeroShardingPolicy(mesh_dp8, stage=stage, min_size_to_shard=0)
+        p = policy.param_shardings(abstract)["w"].spec
+        g = policy.grad_shardings(abstract)["w"].spec
+        o = policy.opt_shardings_for_params(abstract)["w"].spec
+        assert ("dp" in str(p)) == p_sharded, f"stage {stage} params"
+        assert ("dp" in str(g)) == g_sharded, f"stage {stage} grads"
+        assert ("dp" in str(o)) == o_sharded, f"stage {stage} opt"
+
+
+def _train_losses(stage: int, mesh, dp: int, steps: int = 5) -> np.ndarray:
+    model = make_simple_model()
+    # same GLOBAL batch (64) regardless of dp width → comparable trajectories
+    cfg = DeepSpeedConfig.load(
+        base_config(stage=stage, micro=32 // dp, gas=2, dp=dp), dp_world_size=dp
+    )
+    engine = DeepSpeedEngine(model, cfg, mesh=mesh, seed=7)
+    batches = random_batches(steps, cfg.train_batch_size, seed=3)
+    losses = []
+    for b in batches:
+        m = engine.train_batch(b)
+        losses.append(float(m["loss"]))
+    return np.array(losses)
+
+
+@pytest.mark.parametrize("stage", [0, 1, 2, 3])
+def test_zero_stage_parity_vs_single_device(stage, mesh_dp8, mesh_single):
+    """Every ZeRO stage over dp=8 must match single-device training bitwise-ish."""
+    # single-device baseline: same global batch, stage 0
+    base = _train_losses(0, mesh_single, dp=1)
+    sharded = _train_losses(stage, mesh_dp8, dp=8)
+    np.testing.assert_allclose(sharded, base, rtol=2e-5, atol=2e-6)
+
+
+def test_zero3_params_actually_sharded(mesh_dp8):
+    model = make_simple_model(hidden_dim=64)
+    cfg = DeepSpeedConfig.load(
+        base_config(
+            stage=3, dp=8,
+            zero_optimization={"stage": 3, "stage3_param_persistence_threshold": 0},
+        ),
+        dp_world_size=8,
+    )
+    engine = DeepSpeedEngine(model, cfg, mesh=mesh_dp8)
+    w = engine.state.params["layers"][0]["w"]
+    assert "dp" in str(w.sharding.spec)
+    # per-device shard is 1/8 of the full tensor
+    shard_shapes = {s.data.shape for s in w.addressable_shards}
+    assert all(np.prod(s) == w.size // 8 for s in shard_shapes)
+
+
+def test_zero1_opt_sharded_params_replicated(mesh_dp8):
+    model = make_simple_model(hidden_dim=64)
+    cfg = DeepSpeedConfig.load(base_config(stage=1, dp=8), dp_world_size=8)
+    engine = DeepSpeedEngine(model, cfg, mesh=mesh_dp8)
+    w = engine.state.params["layers"][0]["w"]
+    assert "dp" not in str(w.sharding.spec)
+    mu = jax.tree.leaves(engine.state.opt_state)
+    sharded_any = any("dp" in str(x.sharding.spec) for x in mu if hasattr(x, "sharding") and x.ndim >= 2)
+    assert sharded_any
