@@ -48,10 +48,21 @@ class GPT2Config:
     remat: bool = False
     attn_impl: str = "auto"  # auto | pallas | jnp
     dtype: Any = jnp.float32  # param init dtype (master)
+    # MoE (DeepSpeed-MoE capability, Switch-style: every MLP is an expert
+    # layer so scan-over-layers stays homogeneous). 0 = dense.
+    moe_experts: int = 0
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.25
+    moe_eval_capacity_factor: Optional[float] = None  # None → moe_capacity_factor
+    moe_aux_loss_weight: float = 0.01
 
     @property
     def head_dim(self) -> int:
         return self.n_embd // self.n_head
+
+    @property
+    def is_moe(self) -> bool:
+        return self.moe_experts > 0
 
 
 # name → config, sizes per the GPT-2 paper / HF checkpoints
@@ -100,19 +111,53 @@ def init_params(cfg: GPT2Config, rng) -> PyTree:
                 "c_proj_w": normal(next(k), (L, E, E), pstd),
                 "c_proj_b": jnp.zeros((L, E), dt),
             },
-            "mlp": {
-                "c_fc_w": normal(next(k), (L, E, 4 * E), std),
-                "c_fc_b": jnp.zeros((L, 4 * E), dt),
-                "c_proj_w": normal(next(k), (L, 4 * E, E), pstd),
-                "c_proj_b": jnp.zeros((L, E), dt),
-            },
+            "mlp": _init_mlp(cfg, [next(k), next(k), next(k)], std, pstd, dt),
         },
     }
     return params
 
 
-def logical_axes() -> PyTree:
+def _init_mlp(cfg: GPT2Config, keys, std, pstd, dt):
+    E, L = cfg.n_embd, cfg.n_layer
+
+    def normal(key, shape, s):
+        return (jax.random.normal(key, shape) * s).astype(dt)
+
+    if not cfg.is_moe:
+        return {
+            "c_fc_w": normal(keys[0], (L, E, 4 * E), std),
+            "c_fc_b": jnp.zeros((L, 4 * E), dt),
+            "c_proj_w": normal(keys[1], (L, 4 * E, E), pstd),
+            "c_proj_b": jnp.zeros((L, E), dt),
+        }
+    X = cfg.moe_experts
+    return {
+        "gate_w": normal(keys[2], (L, E, X), std).astype(jnp.float32),
+        "w_in": normal(keys[0], (L, X, E, 4 * E), std),
+        "b_in": jnp.zeros((L, X, 4 * E), dt),
+        "w_out": normal(keys[1], (L, X, 4 * E, E), pstd),
+        "b_out": jnp.zeros((L, X, E), dt),
+    }
+
+
+def logical_axes(cfg: Optional[GPT2Config] = None) -> PyTree:
     """Logical-axis names per param (see zero/partitioning.DEFAULT_LOGICAL_RULES)."""
+    moe = cfg is not None and cfg.is_moe
+    if moe:
+        mlp = {
+            "gate_w": ("layers", "embed", None),
+            "w_in": ("layers", "expert", "embed", "expert_mlp"),
+            "b_in": ("layers", "expert", "expert_mlp"),
+            "w_out": ("layers", "expert", "expert_mlp", "embed"),
+            "b_out": ("layers", "expert", "embed"),
+        }
+    else:
+        mlp = {
+            "c_fc_w": ("layers", "embed", "mlp"),
+            "c_fc_b": ("layers", "mlp"),
+            "c_proj_w": ("layers", "mlp", "embed"),
+            "c_proj_b": ("layers", "embed"),
+        }
     return {
         "wte": ("vocab", "embed"),
         "wpe": (None, "embed"),
@@ -126,12 +171,7 @@ def logical_axes() -> PyTree:
                 "c_proj_w": ("layers", "heads", "embed"),
                 "c_proj_b": ("layers", "embed"),
             },
-            "mlp": {
-                "c_fc_w": ("layers", "embed", "mlp"),
-                "c_fc_b": ("layers", "mlp"),
-                "c_proj_w": ("layers", "mlp", "embed"),
-                "c_proj_b": ("layers", "embed"),
-            },
+            "mlp": mlp,
         },
     }
 
@@ -172,10 +212,25 @@ def _attention(cfg: GPT2Config, lp, h, train: bool, rng=None):
     return out
 
 
-def _mlp(lp, h):
+def _mlp(cfg: GPT2Config, lp, h, train: bool, rng=None):
+    """Dense or MoE FFN; returns (out, aux_loss)."""
+    if cfg.is_moe:
+        from ..moe.sharded_moe import MoEConfig, moe_mlp
+
+        mcfg = MoEConfig(
+            num_experts=cfg.moe_experts,
+            k=cfg.moe_top_k,
+            capacity_factor=cfg.moe_capacity_factor,
+            eval_capacity_factor=(
+                cfg.moe_eval_capacity_factor
+                if cfg.moe_eval_capacity_factor is not None
+                else cfg.moe_capacity_factor
+            ),
+        )
+        return moe_mlp(lp, h, mcfg, rng=rng, train=train)
     x = h @ lp["c_fc_w"] + lp["c_fc_b"]
     x = jax.nn.gelu(x, approximate=True)
-    return x @ lp["c_proj_w"] + lp["c_proj_b"]
+    return x @ lp["c_proj_w"] + lp["c_proj_b"], jnp.float32(0.0)
 
 
 def _block(cfg: GPT2Config, layer_params, h, train: bool, rng=None):
@@ -185,50 +240,76 @@ def _block(cfg: GPT2Config, layer_params, h, train: bool, rng=None):
         r1, r2 = jax.random.split(rng)
     a = _attention(cfg, layer_params["attn"], _layer_norm(h, layer_params["ln_1"]["scale"], layer_params["ln_1"]["bias"], eps), train, r1)
     h = h + _dropout(a, cfg.dropout, r1, train)
-    m = _mlp(layer_params["mlp"], _layer_norm(h, layer_params["ln_2"]["scale"], layer_params["ln_2"]["bias"], eps))
-    return h + _dropout(m, cfg.dropout, r2, train)
+    m, aux = _mlp(cfg, layer_params["mlp"], _layer_norm(h, layer_params["ln_2"]["scale"], layer_params["ln_2"]["bias"], eps), train, r2)
+    return h + _dropout(m, cfg.dropout, r2, train), aux
 
 
-def forward(
+def forward_with_aux(
     cfg: GPT2Config,
     params: PyTree,
     input_ids: jnp.ndarray,
     train: bool = False,
     rng=None,
-) -> jnp.ndarray:
-    """input_ids [B,S] → logits [B,S,V]. ``rng`` enables dropout when train."""
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """input_ids [B,S] → (logits [B,S,V], moe_aux_loss scalar)."""
     B, S = input_ids.shape
     h = params["wte"][input_ids] + params["wpe"][:S][None, :, :]
-    use_dropout = train and cfg.dropout > 0.0 and rng is not None
-    if use_dropout:
-        h = _dropout(h, cfg.dropout, jax.random.fold_in(rng, -1), train)
+    # rng per layer when dropout or MoE stochastic routing needs it
+    need_rng = rng is not None and (
+        (train and cfg.dropout > 0.0) or (cfg.is_moe and cfg.moe_top_k == 2)
+    )
+    if need_rng:
+        if train and cfg.dropout > 0.0:
+            h = _dropout(h, cfg.dropout, jax.random.fold_in(rng, cfg.n_layer), train)
         layer_keys = jax.random.split(jax.random.fold_in(rng, 0), cfg.n_layer)
 
         def body(carry, x):
             layer_params, key = x
-            return _block(cfg, layer_params, carry, train, key), None
+            h, aux_sum = carry
+            h, aux = _block(cfg, layer_params, h, train, key)
+            return (h, aux_sum + aux), None
 
         xs = (params["blocks"], layer_keys)
     else:
 
         def body(carry, layer_params):
-            return _block(cfg, layer_params, carry, train, None), None
+            h, aux_sum = carry
+            h, aux = _block(cfg, layer_params, h, train, None)
+            return (h, aux_sum + aux), None
 
         xs = params["blocks"]
 
     if cfg.remat:
         body = jax.checkpoint(body, prevent_cse=False)
-    h, _ = lax.scan(body, h, xs)
+    (h, aux_total), _ = lax.scan(body, (h, jnp.float32(0.0)), xs)
     h = _layer_norm(h, params["ln_f"]["scale"], params["ln_f"]["bias"], cfg.layer_norm_epsilon)
     logits = h @ params["wte"].T  # tied embeddings
-    return logits
+    return logits, aux_total
+
+
+def forward(cfg: GPT2Config, params: PyTree, input_ids: jnp.ndarray, train: bool = False, rng=None) -> jnp.ndarray:
+    """input_ids [B,S] → logits [B,S,V]. ``rng`` enables dropout when train."""
+    return forward_with_aux(cfg, params, input_ids, train=train, rng=rng)[0]
 
 
 def lm_loss(cfg: GPT2Config, params: PyTree, batch: Dict[str, jnp.ndarray], rng, train: bool) -> Tuple[jnp.ndarray, Dict]:
     """Next-token cross-entropy. batch: {"input_ids": [B,S]} and optional
     {"labels": [B,S]} (-100 = ignore, HF convention) / {"attention_mask"}."""
     ids = batch["input_ids"]
-    logits = forward(cfg, params, ids, train=train, rng=rng)[:, :-1]
+    full_logits, moe_aux = forward_with_aux(cfg, params, ids, train=train, rng=rng)
+    loss, ntokens = _token_loss(cfg, params, full_logits, batch)
+    # aux load-balancing penalty only shapes the training objective; eval loss
+    # stays pure LM cross-entropy (comparable to dense baselines)
+    if cfg.is_moe and train:
+        loss = loss + cfg.moe_aux_loss_weight * moe_aux
+    return loss, {"ntokens": ntokens, "moe_aux": moe_aux}
+
+
+def _token_loss(cfg: GPT2Config, params, logits_full, batch):
+    """Shifted CE given full logits (shared by plain and pipeline paths).
+    Returns (mean nll, ntokens)."""
+    ids = batch["input_ids"]
+    logits = logits_full[:, :-1]
     labels = batch.get("labels", ids)[:, 1:]
     mask = (labels != -100).astype(jnp.float32)
     if "attention_mask" in batch:
@@ -237,8 +318,61 @@ def lm_loss(cfg: GPT2Config, params: PyTree, batch: Dict[str, jnp.ndarray], rng,
     logz = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
     gold = jnp.take_along_axis(logits.astype(jnp.float32), labels[..., None], axis=-1)[..., 0]
     nll = (logz - gold) * mask
-    loss = jnp.sum(nll) / jnp.maximum(jnp.sum(mask), 1.0)
-    return loss, {"ntokens": jnp.sum(mask)}
+    return jnp.sum(nll) / jnp.maximum(jnp.sum(mask), 1.0), jnp.sum(mask)
+
+
+def pipeline_lm_loss(cfg: GPT2Config, params: PyTree, batch_micro, rng, train: bool, mesh):
+    """All-microbatch LM loss through the pp pipeline.
+
+    batch_micro leaves are [M, mb, ...]; blocks run as pipeline stages
+    (parallel/pipeline.py), embedding/head replicated (tied-grad psum is
+    automatic — the _exec_reduce_tied_grads analog).
+    """
+    from ..parallel.pipeline import pipeline_apply
+
+    ids = batch_micro["input_ids"]  # [M, mb, S]
+    M, mb, S = ids.shape
+    h0 = params["wte"][ids] + params["wpe"][:S][None, None, :, :]  # [M, mb, S, E]
+    use_rng = rng is not None and train and cfg.dropout > 0.0
+    if use_rng:
+        h0 = _dropout(h0, cfg.dropout, jax.random.fold_in(rng, 2), train)
+
+        def stage_fn(local_layers, h, key):
+            def body(carry, lp):
+                hh, j = carry
+                out, _aux = _block(cfg, lp, hh, train, jax.random.fold_in(key, j))
+                return (out, j + 1), None
+
+            (h, _), _ = lax.scan(body, (h, jnp.int32(0)), local_layers)
+            return h
+
+    else:
+
+        def stage_fn(local_layers, h):
+            def body(carry, lp):
+                out, _aux = _block(cfg, lp, carry, train, None)
+                return out, None
+
+            h, _ = lax.scan(body, h, local_layers)
+            return h
+
+    h_out = pipeline_apply(
+        stage_fn,
+        params["blocks"],
+        h0,
+        mesh,
+        remat_stage=cfg.remat,
+        rng=jax.random.fold_in(rng, 1) if use_rng else None,
+    )
+    h_out = _layer_norm(h_out, params["ln_f"]["scale"], params["ln_f"]["bias"], cfg.layer_norm_epsilon)
+    logits = h_out @ params["wte"].T  # [M, mb, S, V]
+
+    def per_micro(i, acc):
+        micro_batch = jax.tree.map(lambda x: x[i], batch_micro)
+        return acc + _token_loss(cfg, params, logits[i], micro_batch)[0]
+
+    total = lax.fori_loop(0, M, per_micro, jnp.float32(0.0))
+    return total / M, {}
 
 
 def make_module(cfg: GPT2Config) -> ModuleSpec:
@@ -246,7 +380,10 @@ def make_module(cfg: GPT2Config) -> ModuleSpec:
         init=lambda rng: init_params(cfg, rng),
         loss_fn=lambda params, batch, rng, train: lm_loss(cfg, params, batch, rng, train),
         apply_fn=lambda params, batch: forward(cfg, params, batch["input_ids"], train=False),
-        logical_axes=logical_axes(),
+        logical_axes=logical_axes(cfg),
         num_layers=cfg.n_layer,
+        pipeline_loss_fn=None if cfg.is_moe else (
+            lambda params, batch, rng, train, mesh: pipeline_lm_loss(cfg, params, batch, rng, train, mesh)
+        ),
         extra={"config": cfg},
     )

@@ -1,0 +1,2 @@
+from .layer import MoE
+from .sharded_moe import MoEConfig, moe_mlp, top1_gating, top2_gating

@@ -1,0 +1,43 @@
+"""Standalone MoE layer builder (public API analog of reference moe/layer.py MoE:15).
+
+The reference ``MoE`` wraps an arbitrary expert ``nn.Module`` and hides the
+process-group plumbing. Functionally, an MoE layer here is: params built by
+``init_moe_mlp_params``, logical axes from ``moe_mlp_logical_axes`` (expert
+dim → ``ep`` mesh axis), applied with ``moe_mlp``. This module packages those
+as a convenience bundle for models not using the GPT-2 family integration.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .sharded_moe import (
+    MoEConfig,
+    init_moe_mlp_params,
+    moe_mlp,
+    moe_mlp_logical_axes,
+)
+
+PyTree = Any
+
+
+@dataclass
+class MoE:
+    """Bundle of (init, apply, logical_axes) for one expert-parallel FFN."""
+
+    d_model: int
+    d_hidden: int
+    config: MoEConfig
+
+    def init(self, rng, dtype=jnp.float32) -> PyTree:
+        return init_moe_mlp_params(rng, self.d_model, self.d_hidden, self.config.num_experts, dtype)
+
+    def logical_axes(self) -> PyTree:
+        return moe_mlp_logical_axes()
+
+    def apply(self, params: PyTree, x: jnp.ndarray, rng=None, train: bool = True) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        return moe_mlp(params, x, self.config, rng=rng, train=train)

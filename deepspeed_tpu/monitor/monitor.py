@@ -1,0 +1,113 @@
+"""Metrics monitoring: TensorBoard / W&B / CSV fan-out.
+
+Analog of reference ``deepspeed/monitor/`` (Monitor ABC monitor.py:9,
+MonitorMaster:24, tensorboard.py, wandb.py, csv_monitor.py). Events are
+``(tag, scalar_value, global_step)`` tuples, exactly the reference's
+``write_events`` contract (engine.py:1779-1787 call sites).
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import Any, List, Optional, Sequence, Tuple
+
+from ..utils.logging import logger
+
+Event = Tuple[str, Any, int]
+
+
+class Monitor:
+    def __init__(self, monitor_config):
+        self.monitor_config = monitor_config
+        self.enabled = bool(getattr(monitor_config, "enabled", False))
+
+    def write_events(self, event_list: Sequence[Event]) -> None:
+        raise NotImplementedError
+
+
+class TensorBoardMonitor(Monitor):
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        self.summary_writer = None
+        if not self.enabled:
+            return
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+
+            out = os.path.join(cfg.output_path or "./runs", cfg.job_name)
+            self.summary_writer = SummaryWriter(log_dir=out)
+        except Exception as e:  # tensorboard optional
+            logger.warning(f"tensorboard unavailable: {e}")
+            self.enabled = False
+
+    def write_events(self, event_list):
+        if self.summary_writer is None:
+            return
+        for tag, value, step in event_list:
+            self.summary_writer.add_scalar(tag, float(value), int(step))
+        self.summary_writer.flush()
+
+
+class WandbMonitor(Monitor):
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        if not self.enabled:
+            return
+        try:
+            import wandb
+
+            wandb.init(project=cfg.project, group=cfg.group, entity=cfg.team)
+            self._wandb = wandb
+        except Exception as e:
+            logger.warning(f"wandb unavailable: {e}")
+            self.enabled = False
+
+    def write_events(self, event_list):
+        if not self.enabled:
+            return
+        for tag, value, step in event_list:
+            self._wandb.log({tag: float(value)}, step=int(step))
+
+
+class CsvMonitor(Monitor):
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        self._files = {}
+        if self.enabled:
+            self.base = os.path.join(cfg.output_path or "./csv_logs", cfg.job_name)
+            os.makedirs(self.base, exist_ok=True)
+
+    def write_events(self, event_list):
+        if not self.enabled:
+            return
+        for tag, value, step in event_list:
+            fname = os.path.join(self.base, tag.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            with open(fname, "a", newline="") as fh:
+                w = csv.writer(fh)
+                if new:
+                    w.writerow(["step", tag])
+                w.writerow([int(step), float(value)])
+
+
+class MonitorMaster(Monitor):
+    """Fans events to every enabled writer; only process 0 writes
+    (reference MonitorMaster rank-0 guard)."""
+
+    def __init__(self, ds_config):
+        self.tb_monitor = TensorBoardMonitor(ds_config.tensorboard)
+        self.wandb_monitor = WandbMonitor(ds_config.wandb)
+        self.csv_monitor = CsvMonitor(ds_config.csv_monitor)
+        self.enabled = any(
+            m.enabled for m in (self.tb_monitor, self.wandb_monitor, self.csv_monitor)
+        )
+
+    def write_events(self, event_list: Sequence[Event]) -> None:
+        import jax
+
+        if jax.process_index() != 0 or not self.enabled:
+            return
+        for m in (self.tb_monitor, self.wandb_monitor, self.csv_monitor):
+            if m.enabled:
+                m.write_events(event_list)

@@ -35,9 +35,12 @@ def _pallas_ok(q) -> bool:
     B, S, H, D = q.shape
     if jax.default_backend() not in ("tpu",):
         return False
+    from .pallas.flash_attention import VMEM_RESIDENT_BYTES
+
     # kernel tiling constraints: seq multiple of block, head_dim lane-friendly
-    # (D=64 is lane-padded by Mosaic — still profitable vs materializing [S,S])
-    return S % 128 == 0 and D % 64 == 0
+    # (D=64 is lane-padded by Mosaic — still profitable vs materializing [S,S]);
+    # whole-K/V-in-VMEM design bounds the per-device sequence length
+    return S % 128 == 0 and D % 64 == 0 and S * D * q.dtype.itemsize <= VMEM_RESIDENT_BYTES
 
 
 def causal_attention(q, k, v, impl: str = "auto", sm_scale: Optional[float] = None):

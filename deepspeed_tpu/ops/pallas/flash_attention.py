@@ -1,0 +1,268 @@
+"""Flash attention as a Pallas TPU kernel (fwd + bwd), causal.
+
+TPU-native replacement for the attention core of the reference's fused
+transformer kernels (``csrc/transformer/ds_transformer_cuda.cpp`` — attention
+score softmax/dropout fused ops; ``softmax_kernels.cu``): one VMEM-resident
+online-softmax kernel instead of materializing the [S,S] score matrix in HBM.
+
+Layout: inputs [B, S, H, D]; internally processed as [B*H, S, D].
+Block sizes: BQ=BK=128 (MXU-tile aligned); D may be 64/128/256 (sub-128 head
+dims are lane-padded by Mosaic).
+
+Backward follows the standard flash recomputation: forward also emits the
+per-row logsumexp; dq and dk/dv are computed by two kernels that recompute
+P = exp(S - lse) blockwise, using delta = rowsum(dO * O).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BQ = 128
+BK = 128
+NEG_INF = -1e30
+
+
+def _causal_mask(s, q_block, k_block):
+    """Mask scores where key position > query position (shared by all kernels)."""
+    row = q_block * BQ + jax.lax.broadcasted_iota(jnp.int32, (BQ, BK), 0)
+    col = k_block * BK + jax.lax.broadcasted_iota(jnp.int32, (BQ, BK), 1)
+    return jnp.where(row >= col, s, NEG_INF)
+
+
+def _causal_hi(qi, num_k_blocks):
+    """Number of k blocks a q block attends into (correct for any BQ/BK)."""
+    return jnp.minimum(pl.cdiv((qi + 1) * BQ, BK), num_k_blocks)
+
+
+def _causal_lo(ki):
+    """First q block that can attend to k block ki (correct for any BQ/BK)."""
+    return (ki * BK) // BQ
+
+
+# This kernel keeps the full per-(batch,head) K/V (fwd, dq) or Q/dO (dkv) block
+# resident in VMEM (~16 MB/core). Budget for the largest such array; beyond it
+# callers must shard the sequence (ring attention over the sp axis).
+VMEM_RESIDENT_BYTES = 4 * 1024 * 1024
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale: float, causal: bool, seq_len: int):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * sm_scale  # [BQ, D]
+
+    num_k_blocks = pl.cdiv(seq_len, BK)
+    hi = _causal_hi(qi, num_k_blocks) if causal else num_k_blocks
+
+    def body(j, carry):
+        acc, m_prev, l_prev = carry
+        k = k_ref[0, pl.ds(j * BK, BK), :].astype(jnp.float32)  # [BK, D]
+        v = v_ref[0, pl.ds(j * BK, BK), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [BQ, BK]
+        if causal:
+            s = _causal_mask(s, qi, j)
+        m_cur = jnp.max(s, axis=1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return acc, m_new, l_new
+
+    acc0 = jnp.zeros((BQ, q_ref.shape[-1]), jnp.float32)
+    m0 = jnp.full((BQ,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((BQ,), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, hi, body, (acc0, m0, l0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l)
+
+
+def _fwd(q3, k3, v3, sm_scale: float, causal: bool, interpret: bool = False):
+    """q3/k3/v3: [BH, S, D] → (o [BH,S,D], lse [BH,S])."""
+    BH, S, D = q3.shape
+    grid = (BH, S // BQ)
+    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal, seq_len=S)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        interpret=interpret,
+        in_specs=[
+            pl.BlockSpec((1, BQ, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, BQ, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, BQ), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), q3.dtype),
+            jax.ShapeDtypeStruct((BH, S), jnp.float32),
+        ],
+    )(q3, k3, v3)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, sm_scale, causal, seq_len):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * sm_scale
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+
+    num_k_blocks = pl.cdiv(seq_len, BK)
+    hi = _causal_hi(qi, num_k_blocks) if causal else num_k_blocks
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * BK, BK), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * BK, BK), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, qi, j)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((BQ, q_ref.shape[-1]), jnp.float32))
+    dq_ref[0] = (dq * sm_scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, sm_scale, causal, seq_len):
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)  # [BK, D]
+    v = v_ref[0].astype(jnp.float32)
+
+    num_q_blocks = pl.cdiv(seq_len, BQ)
+    lo = _causal_lo(ki) if causal else 0
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * BQ, BQ), :].astype(jnp.float32) * sm_scale
+        do = do_ref[0, pl.ds(i * BQ, BQ), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * BQ, BQ)]
+        delta = delta_ref[0, pl.ds(i * BQ, BQ)]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, i, ki)
+        p = jnp.exp(s - lse[:, None])  # [BQ, BK]
+        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        return dk, dv
+
+    D = k_ref.shape[-1]
+    dk0 = jnp.zeros((BK, D), jnp.float32)
+    dv0 = jnp.zeros((BK, D), jnp.float32)
+    dk, dv = jax.lax.fori_loop(lo, num_q_blocks, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)  # sm_scale already folded into q
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(q3, k3, v3, o3, lse, do3, sm_scale: float, causal: bool, interpret: bool = False):
+    BH, S, D = q3.shape
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1)  # [BH,S]
+
+    full = lambda b, i: (b, 0, 0)
+    full2 = lambda b, i: (b, 0)
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal, seq_len=S),
+        grid=(BH, S // BQ),
+        interpret=interpret,
+        in_specs=[
+            pl.BlockSpec((1, BQ, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, S, D), full),
+            pl.BlockSpec((1, S, D), full),
+            pl.BlockSpec((1, BQ, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, BQ), lambda b, i: (b, i)),
+            pl.BlockSpec((1, BQ), lambda b, i: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, BQ, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), q3.dtype),
+    )(q3, k3, v3, do3, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal, seq_len=S),
+        grid=(BH, S // BK),
+        interpret=interpret,
+        in_specs=[
+            pl.BlockSpec((1, S, D), full),
+            pl.BlockSpec((1, BK, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, BK, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, S, D), full),
+            pl.BlockSpec((1, S), full2),
+            pl.BlockSpec((1, S), full2),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, BK, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, BK, D), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), q3.dtype),
+            jax.ShapeDtypeStruct((BH, S, D), q3.dtype),
+        ],
+    )(q3, k3, v3, do3, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public API with custom VJP
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q3, k3, v3, sm_scale: float, causal: bool, interpret: bool):
+    o, _ = _fwd(q3, k3, v3, sm_scale, causal, interpret)
+    return o
+
+
+def _flash_fwd_rule(q3, k3, v3, sm_scale, causal, interpret):
+    o, lse = _fwd(q3, k3, v3, sm_scale, causal, interpret)
+    return o, (q3, k3, v3, o, lse)
+
+
+def _flash_bwd_rule(sm_scale, causal, interpret, res, do3):
+    q3, k3, v3, o3, lse = res
+    dq, dk, dv = _bwd(q3, k3, v3, o3, lse, do3, sm_scale, causal, interpret)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q, k, v, causal: bool = True, sm_scale: Optional[float] = None, interpret: bool = False):
+    """[B,S,H,D] causal flash attention. S must be a multiple of 128."""
+    B, S, H, D = q.shape
+    if S % BQ != 0 or S % BK != 0:
+        raise ValueError(f"seq {S} must be a multiple of {BQ}/{BK}")
+    if S * D * q.dtype.itemsize > VMEM_RESIDENT_BYTES:
+        raise ValueError(
+            f"seq {S} x head_dim {D} exceeds the whole-K/V-in-VMEM budget of this "
+            f"kernel ({VMEM_RESIDENT_BYTES} B); shard the sequence (sp axis / ring "
+            "attention) or reduce per-device sequence length"
+        )
+    scale = sm_scale if sm_scale is not None else 1.0 / (D**0.5)
+
+    def to3(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+
+    o3 = _flash(to3(q), to3(k), to3(v), float(scale), bool(causal), bool(interpret))
+    return o3.reshape(B, H, S, D).transpose(0, 2, 1, 3)

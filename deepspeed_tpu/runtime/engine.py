@@ -231,43 +231,71 @@ class DeepSpeedEngine:
         predivide = cfg.prescale_gradients
         predivide_factor = cfg.gradient_predivide_factor
 
+        pipeline_mode = mesh_axis_size(self.mesh, "pp") > 1
+        if pipeline_mode and model.pipeline_loss_fn is None:
+            raise ValueError(
+                "mesh has a pp axis but the model provides no pipeline_loss_fn"
+            )
+        mesh = self.mesh
+
         def scaled_loss_fn(params, micro_batch, rng, scale):
             cparams = _cast_params(params, compute_dtype)
             loss, metrics = model.loss_fn(cparams, micro_batch, rng, True)
             return loss.astype(jnp.float32) * scale, (loss, metrics)
 
+        def scaled_pipeline_loss_fn(params, batch, rng, scale):
+            cparams = _cast_params(params, compute_dtype)
+            loss, metrics = model.pipeline_loss_fn(cparams, batch, rng, True, mesh)
+            return loss.astype(jnp.float32) * scale, (loss, metrics)
+
         grad_fn = jax.value_and_grad(scaled_loss_fn, has_aux=True)
+        pipe_grad_fn = jax.value_and_grad(scaled_pipeline_loss_fn, has_aux=True)
 
         def train_step(state: TrainState, batch: PyTree, rng) -> Tuple[TrainState, Dict[str, Any]]:
             scale = state.loss_scale.cur_scale if fp16 else jnp.float32(1.0)
 
-            def micro_step(carry, xs):
-                grads_acc, loss_acc, i = carry
-                micro = jax.tree.map(lambda x: x[i], batch)
-                mrng = jax.random.fold_in(rng, i)
-                (_, (loss, _metrics)), grads = grad_fn(state.params, micro, mrng, scale)
-                if predivide:
-                    grads = jax.tree.map(lambda g: g / predivide_factor, grads)
-                grads_acc = jax.tree.map(
-                    lambda a, g: a + g.astype(acc_dtype), grads_acc, grads
+            if pipeline_mode:
+                # pipeline path: all gas microbatches flow through the 1F1B/
+                # fill-drain schedule in ONE grad call (PipelineEngine
+                # train_batch analog) — gas IS the pipeline microbatch count
+                (_, (loss, _metrics)), grads = pipe_grad_fn(state.params, batch, rng, scale)
+                grads = jax.lax.with_sharding_constraint(
+                    jax.tree.map(lambda g: g.astype(acc_dtype), grads), grad_shardings
                 )
-                # ZeRO >= 2: keep the accumulation buffer sharded over dp —
-                # XLA turns the dp-sum into reduce-scatter (stage3.py:1145 analog)
-                grads_acc = jax.lax.with_sharding_constraint(grads_acc, grad_shardings)
-                return (grads_acc, loss_acc + loss.astype(jnp.float32), i + 1), None
+                loss_sum = loss.astype(jnp.float32) * gas
+            else:
 
-            zero_grads = jax.tree.map(
-                lambda p: jnp.zeros(p.shape, acc_dtype), state.params
-            )
-            zero_grads = jax.lax.with_sharding_constraint(zero_grads, grad_shardings)
-            (grads, loss_sum, _), _ = jax.lax.scan(
-                micro_step, (zero_grads, jnp.float32(0.0), 0), None, length=gas
-            )
+                def micro_step(carry, xs):
+                    grads_acc, loss_acc, i = carry
+                    micro = jax.tree.map(lambda x: x[i], batch)
+                    mrng = jax.random.fold_in(rng, i)
+                    (_, (loss, _metrics)), grads = grad_fn(state.params, micro, mrng, scale)
+                    if predivide:
+                        grads = jax.tree.map(lambda g: g / predivide_factor, grads)
+                    grads_acc = jax.tree.map(
+                        lambda a, g: a + g.astype(acc_dtype), grads_acc, grads
+                    )
+                    # ZeRO >= 2: keep the accumulation buffer sharded over dp —
+                    # XLA turns the dp-sum into reduce-scatter (stage3.py:1145 analog)
+                    grads_acc = jax.lax.with_sharding_constraint(grads_acc, grad_shardings)
+                    return (grads_acc, loss_acc + loss.astype(jnp.float32), i + 1), None
+
+                zero_grads = jax.tree.map(
+                    lambda p: jnp.zeros(p.shape, acc_dtype), state.params
+                )
+                zero_grads = jax.lax.with_sharding_constraint(zero_grads, grad_shardings)
+                (grads, loss_sum, _), _ = jax.lax.scan(
+                    micro_step, (zero_grads, jnp.float32(0.0), 0), None, length=gas
+                )
 
             # unscale + average over gas (reference: scale loss by 1/GAS, engine.py:1775)
             inv = 1.0 / (scale * gas) if fp16 else 1.0 / gas
+            if pipeline_mode:
+                inv = inv * gas  # pipeline loss is already the mean over microbatches
             grads = jax.tree.map(lambda g: (g.astype(jnp.float32) * inv), grads)
-            if predivide and predivide_factor != 1.0:
+            # pre-divide only happens in the micro_step accumulation loop, so
+            # the re-multiply must not run on the pipeline path
+            if predivide and predivide_factor != 1.0 and not pipeline_mode:
                 grads = jax.tree.map(lambda g: g * predivide_factor, grads)
 
             overflow = ls.has_inf_or_nan(grads) if fp16 else jnp.bool_(False)

@@ -40,6 +40,11 @@ class ModuleSpec:
     apply_fn: Optional[Callable] = None
     logical_axes: Optional[PyTree] = None
     num_layers: int = 0
+    # pipeline-parallel loss over all microbatches at once:
+    # (params, batch [M, mb, ...], rng, train, mesh) -> (loss, metrics).
+    # Used by the engine when the mesh has a pp axis (the PipelineEngine
+    # analog — reference runtime/pipe/engine.py train_batch).
+    pipeline_loss_fn: Optional[Callable] = None
     extra: Dict[str, Any] = field(default_factory=dict)
 
 

@@ -51,7 +51,10 @@ DEFAULT_LOGICAL_RULES: Tuple[Tuple[str, Optional[str]], ...] = (
     ("expert", "ep"),
     ("expert_mlp", "tp"),
     ("seq", "sp"),
-    ("layers", None),
+    # stacked layer dim shards over pp = pipeline stage partition
+    # (PipelineModule._partition_layers analog); degrades to replicated
+    # when the mesh has no pp axis
+    ("layers", "pp"),
     ("stack", None),
 )
 

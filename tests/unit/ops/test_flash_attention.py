@@ -1,0 +1,86 @@
+"""Pallas flash-attention parity vs jnp reference (interpret mode on CPU).
+
+Analog of reference tests/unit/test_cuda_forward.py / test_cuda_backward.py:
+kernel vs reference-module outputs with tolerance sweeps.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.attention import causal_attention_jnp
+from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+
+def _qkv(B, S, H, D, seed=0, dtype=jnp.float32):
+    rs = np.random.RandomState(seed)
+    return [jnp.asarray(rs.randn(B, S, H, D), dtype) for _ in range(3)]
+
+
+@pytest.mark.parametrize("shape", [(1, 128, 2, 64), (2, 256, 2, 64), (1, 384, 1, 128)])
+def test_forward_parity(shape):
+    q, k, v = _qkv(*shape)
+    o_ref = causal_attention_jnp(q, k, v)
+    o = flash_attention(q, k, v, interpret=True)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref), atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal,sm_scale", [(True, None), (False, None), (True, 0.3)])
+def test_backward_parity(causal, sm_scale):
+    q, k, v = _qkv(2, 256, 2, 64, seed=1)
+    scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(64)
+
+    def ref_attn(q, k, v):
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+        if causal:
+            mask = jnp.tril(jnp.ones((256, 256), jnp.bool_))
+            logits = jnp.where(mask[None, None], logits, jnp.float32(-1e30))
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal, sm_scale=sm_scale, interpret=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(ref_attn(q, k, v) ** 2)
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5, rtol=5e-4)
+
+
+def test_non_causal():
+    q, k, v = _qkv(1, 128, 2, 64, seed=2)
+    o = flash_attention(q, k, v, causal=False, interpret=True)
+    # full attention reference
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(64)
+    probs = jax.nn.softmax(logits, axis=-1)
+    o_ref = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref), atol=2e-5, rtol=2e-5)
+
+
+def test_bf16_inputs():
+    q, k, v = _qkv(1, 128, 2, 64, seed=3, dtype=jnp.bfloat16)
+    o = flash_attention(q, k, v, interpret=True)
+    o_ref = causal_attention_jnp(q, k, v)
+    assert o.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(o, np.float32), np.asarray(o_ref, np.float32), atol=3e-2, rtol=3e-2
+    )
+
+
+def test_seq_not_multiple_raises():
+    q, k, v = _qkv(1, 100, 1, 64)
+    with pytest.raises(ValueError):
+        flash_attention(q, k, v, interpret=True)
+
+
+def test_vmem_budget_raises():
+    from deepspeed_tpu.ops.pallas.flash_attention import VMEM_RESIDENT_BYTES
+
+    S = 128 * ((VMEM_RESIDENT_BYTES // (64 * 4)) // 128 + 1)
+    q = jnp.zeros((1, S, 1, 64), jnp.float32)
+    with pytest.raises(ValueError, match="VMEM"):
+        flash_attention(q, q, q, interpret=True)
