@@ -46,7 +46,10 @@ class GPT2Config:
     layer_norm_epsilon: float = 1e-5
     use_bias: bool = True
     remat: bool = False
-    attn_impl: str = "auto"  # auto | pallas | jnp
+    attn_impl: str = "auto"  # auto | pallas | jnp | ring | ulysses
+    # mesh is required for the sequence-parallel attention impls ("ring",
+    # "ulysses") — they shard_map over its sp axis (parallel/sequence.py)
+    mesh: Any = None
     dtype: Any = jnp.float32  # param init dtype (master)
     # MoE (DeepSpeed-MoE capability, Switch-style: every MLP is an expert
     # layer so scan-over-layers stays homogeneous). 0 = dense.
@@ -204,9 +207,15 @@ def _attention(cfg: GPT2Config, lp, h, train: bool, rng=None):
 
     q, k_, v = heads(q), heads(k_), heads(v)
 
-    from ..ops.attention import causal_attention
+    if cfg.attn_impl in ("ring", "ulysses"):
+        from ..parallel.sequence import sequence_parallel_attention
 
-    o = causal_attention(q, k_, v, impl=cfg.attn_impl)  # [B,S,H,D]
+        assert cfg.mesh is not None, f"attn_impl={cfg.attn_impl} requires cfg.mesh"
+        o = sequence_parallel_attention(q, k_, v, cfg.mesh, impl=cfg.attn_impl)
+    else:
+        from ..ops.attention import causal_attention
+
+        o = causal_attention(q, k_, v, impl=cfg.attn_impl)  # [B,S,H,D]
     o = o.reshape(B, S, E)
     out = o @ lp["c_proj_w"] + lp["c_proj_b"]
     return out
@@ -235,13 +244,14 @@ def _mlp(cfg: GPT2Config, lp, h, train: bool, rng=None):
 
 def _block(cfg: GPT2Config, layer_params, h, train: bool, rng=None):
     eps = cfg.layer_norm_epsilon
-    r1 = r2 = None
+    r1 = r2 = r3 = None
     if rng is not None:
-        r1, r2 = jax.random.split(rng)
+        # distinct keys per stochastic op: attn dropout, MoE routing, mlp dropout
+        r1, r2, r3 = jax.random.split(rng, 3)
     a = _attention(cfg, layer_params["attn"], _layer_norm(h, layer_params["ln_1"]["scale"], layer_params["ln_1"]["bias"], eps), train, r1)
     h = h + _dropout(a, cfg.dropout, r1, train)
     m, aux = _mlp(cfg, layer_params["mlp"], _layer_norm(h, layer_params["ln_2"]["scale"], layer_params["ln_2"]["bias"], eps), train, r2)
-    return h + _dropout(m, cfg.dropout, r2, train), aux
+    return h + _dropout(m, cfg.dropout, r3, train), aux
 
 
 def forward_with_aux(
@@ -365,11 +375,13 @@ def pipeline_lm_loss(cfg: GPT2Config, params: PyTree, batch_micro, rng, train: b
         rng=jax.random.fold_in(rng, 1) if use_rng else None,
     )
     h_out = _layer_norm(h_out, params["ln_f"]["scale"], params["ln_f"]["bias"], cfg.layer_norm_epsilon)
-    logits = h_out @ params["wte"].T  # [M, mb, S, V]
 
+    # head matmul + loss per microbatch: materializing [M, mb, S, V] logits at
+    # once would cost M× the activation memory the pipeline exists to save
     def per_micro(i, acc):
         micro_batch = jax.tree.map(lambda x: x[i], batch_micro)
-        return acc + _token_loss(cfg, params, logits[i], micro_batch)[0]
+        logits_i = h_out[i] @ params["wte"].T  # [mb, S, V]
+        return acc + _token_loss(cfg, params, logits_i, micro_batch)[0]
 
     total = lax.fori_loop(0, M, per_micro, jnp.float32(0.0))
     return total / M, {}

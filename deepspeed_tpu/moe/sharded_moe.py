@@ -33,7 +33,11 @@ PyTree = Any
 
 
 def _capacity(num_tokens: int, num_experts: int, capacity_factor: float, min_capacity: int = 4) -> int:
-    cap = int(capacity_factor * num_tokens / num_experts)
+    # ceil, matching reference _capacity (sharded_moe.py:155) — truncating
+    # would silently drop one extra token per expert whenever T*f/E is fractional
+    import math
+
+    cap = math.ceil(capacity_factor * num_tokens / num_experts)
     return max(cap, min_capacity)
 
 

@@ -73,7 +73,6 @@ class WandbMonitor(Monitor):
 class CsvMonitor(Monitor):
     def __init__(self, cfg):
         super().__init__(cfg)
-        self._files = {}
         if self.enabled:
             self.base = os.path.join(cfg.output_path or "./csv_logs", cfg.job_name)
             os.makedirs(self.base, exist_ok=True)

@@ -71,17 +71,19 @@ def pipeline_apply(
     """
     Pn = num_pp_stages(mesh)
     if Pn == 1:
-        if rng is None:
-            body = stage_fn
-            if remat_stage:
-                body = jax.checkpoint(body, prevent_cse=False)
-            return jax.vmap(lambda xb: body(layer_params, xb))(x_micro)
         body = stage_fn
         if remat_stage:
             body = jax.checkpoint(body, prevent_cse=False)
+        if rng is None:
+            return jax.vmap(lambda xb: body(layer_params, xb))(x_micro)
         keys = jax.random.split(rng, x_micro.shape[0])
         return jax.vmap(lambda xb, k: body(layer_params, xb, k))(x_micro, keys)
 
+    L = jax.tree.leaves(layer_params)[0].shape[0]
+    if L % Pn != 0:
+        raise ValueError(
+            f"pipeline_apply: layer count {L} not divisible by pp stages {Pn}"
+        )
     M = x_micro.shape[0]
     T = M + Pn - 1
     if layer_axis_specs is None:

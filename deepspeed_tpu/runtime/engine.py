@@ -362,13 +362,19 @@ class DeepSpeedEngine:
         with the micro dimension sharded over dp."""
         gas = self.gradient_accumulation_steps_value
 
+        sp = "sp" if self.sp_world_size > 1 else None
+
         def put(x):
             x = np.asarray(x)
             assert x.shape[0] == self.train_batch_size_value, (
                 f"batch dim {x.shape[0]} != train_batch_size {self.train_batch_size_value}"
             )
             x = x.reshape(gas, -1, *x.shape[1:])
-            spec = PartitionSpec(None, "dp", *([None] * (x.ndim - 2)))
+            rest = [None] * (x.ndim - 2)
+            # long-context: the sequence dim (first non-batch dim) shards over sp
+            if rest and sp is not None and x.shape[2] % self.sp_world_size == 0:
+                rest[0] = sp
+            spec = PartitionSpec(None, "dp", *rest)
             return jax.device_put(x, NamedSharding(self.mesh, spec))
 
         return jax.tree.map(put, batch)
