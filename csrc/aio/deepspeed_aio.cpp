@@ -1,0 +1,264 @@
+// Async file I/O engine for tensor swapping (ZeRO-Infinity NVMe tier).
+//
+// TPU-native analog of reference csrc/aio/ (deepspeed_aio_thread.{h,cpp},
+// deepspeed_py_aio_handle.{h,cpp}): a C++ thread pool with a work queue and a
+// completion counter, submitting aligned O_DIRECT reads/writes against local
+// NVMe. Where the reference drives Linux libaio (io_submit/io_getevents), this
+// implementation uses a pool of synchronous pread/pwrite workers — on TPU-VM
+// hosts the NVMe queue depth is saturated by N threads doing large sequential
+// block I/O, and the API surface (submit + wait, pinned host buffers) is the
+// same. Exposed as a plain C ABI consumed from Python via ctypes (no pybind11).
+//
+// API (all extern "C"):
+//   aio_handle_new(block_size, queue_depth, n_threads) -> handle*
+//   aio_pread(handle, buf, path, nbytes, offset, validate) -> 0/err
+//   aio_pwrite(handle, buf, path, nbytes, offset, fsync) -> 0/err
+//   aio_submit_pread / aio_submit_pwrite: async variants returning immediately
+//   aio_wait(handle) -> number of ops completed since last wait (<0 on error)
+//   aio_pending(handle) -> ops still in flight
+//   aio_handle_free(handle)
+
+#include <atomic>
+#include <condition_variable>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <fcntl.h>
+#include <mutex>
+#include <string>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+constexpr size_t kAlign = 4096;  // O_DIRECT sector alignment
+
+struct AioOp {
+    bool write = false;
+    char* buf = nullptr;
+    std::string path;
+    size_t nbytes = 0;
+    size_t file_offset = 0;
+    bool fsync = false;
+    int fd = -1;  // >= 0: use this fd instead of opening path
+};
+
+struct AioHandle {
+    size_t block_size;
+    int queue_depth;
+    int n_threads;
+
+    std::vector<std::thread> workers;
+    std::deque<AioOp> queue;
+    std::mutex mu;
+    std::condition_variable cv;       // signals workers: work available / stop
+    std::condition_variable done_cv;  // signals waiters: op retired
+    size_t inflight = 0;              // queued + running
+    long completed_since_wait = 0;
+    long errors = 0;
+    bool stop = false;
+
+    explicit AioHandle(size_t bs, int qd, int nt)
+        : block_size(bs), queue_depth(qd), n_threads(nt) {
+        for (int i = 0; i < n_threads; ++i) {
+            workers.emplace_back([this] { this->worker_loop(); });
+        }
+    }
+
+    ~AioHandle() {
+        {
+            std::lock_guard<std::mutex> lk(mu);
+            stop = true;
+        }
+        cv.notify_all();
+        for (auto& t : workers) t.join();
+    }
+
+    // One op = one contiguous byte range of one file. Runs on a worker thread.
+    // Returns 0 on success, -errno on failure.
+    int run_op(const AioOp& op) {
+        int fd = op.fd;
+        bool own_fd = false;
+        if (fd < 0) {
+            int flags = op.write ? (O_WRONLY | O_CREAT) : O_RDONLY;
+            // Try O_DIRECT first (NVMe fast path); fall back to buffered I/O
+            // when the buffer/offset/filesystem does not support it.
+            bool aligned = (reinterpret_cast<uintptr_t>(op.buf) % kAlign == 0) &&
+                           (op.file_offset % kAlign == 0) && (op.nbytes % kAlign == 0);
+            fd = -1;
+            if (aligned) fd = ::open(op.path.c_str(), flags | O_DIRECT, 0644);
+            if (fd < 0) fd = ::open(op.path.c_str(), flags, 0644);
+            if (fd < 0) return -errno;
+            own_fd = true;
+        }
+        size_t done = 0;
+        int err = 0;
+        while (done < op.nbytes) {
+            size_t chunk = op.nbytes - done;
+            if (block_size > 0 && chunk > block_size) chunk = block_size;
+            ssize_t n = op.write
+                            ? ::pwrite(fd, op.buf + done, chunk, op.file_offset + done)
+                            : ::pread(fd, op.buf + done, chunk, op.file_offset + done);
+            if (n < 0) {
+                if (errno == EINTR) continue;
+                // O_DIRECT can fail mid-stream (e.g. EINVAL on tail block):
+                // reopen buffered and retry the remainder.
+                if (errno == EINVAL && own_fd) {
+                    int bfd = ::open(op.path.c_str(),
+                                     op.write ? (O_WRONLY | O_CREAT) : O_RDONLY, 0644);
+                    if (bfd >= 0) {
+                        ::close(fd);
+                        fd = bfd;
+                        continue;
+                    }
+                }
+                err = -errno;
+                break;
+            }
+            if (n == 0) {  // EOF on read
+                err = -EIO;
+                break;
+            }
+            done += static_cast<size_t>(n);
+        }
+        if (err == 0 && op.write && op.fsync) {
+            if (::fsync(fd) != 0) err = -errno;
+        }
+        if (own_fd) ::close(fd);
+        return err;
+    }
+
+    void worker_loop() {
+        for (;;) {
+            AioOp op;
+            {
+                std::unique_lock<std::mutex> lk(mu);
+                cv.wait(lk, [this] { return stop || !queue.empty(); });
+                if (stop && queue.empty()) return;
+                op = std::move(queue.front());
+                queue.pop_front();
+            }
+            int err = run_op(op);
+            {
+                std::lock_guard<std::mutex> lk(mu);
+                --inflight;
+                ++completed_since_wait;
+                if (err != 0) ++errors;
+            }
+            done_cv.notify_all();
+        }
+    }
+
+    // Split [0, nbytes) into per-thread sub-ranges and enqueue them so one
+    // large tensor swap saturates all workers (reference-style parallel I/O).
+    void submit(const AioOp& op) {
+        size_t n_parts = static_cast<size_t>(n_threads);
+        if (n_parts < 1) n_parts = 1;
+        size_t part = (op.nbytes + n_parts - 1) / n_parts;
+        // keep O_DIRECT-compatible alignment of sub-range boundaries
+        part = ((part + kAlign - 1) / kAlign) * kAlign;
+        std::vector<AioOp> ops;
+        for (size_t off = 0; off < op.nbytes; off += part) {
+            AioOp sub = op;
+            sub.buf = op.buf + off;
+            sub.file_offset = op.file_offset + off;
+            sub.nbytes = std::min(part, op.nbytes - off);
+            sub.fsync = op.fsync && (off + part >= op.nbytes);  // fsync once, on the tail op
+            ops.push_back(std::move(sub));
+        }
+        {
+            std::lock_guard<std::mutex> lk(mu);
+            for (auto& o : ops) {
+                queue.push_back(std::move(o));
+                ++inflight;
+            }
+        }
+        cv.notify_all();
+    }
+
+    long wait_all() {
+        std::unique_lock<std::mutex> lk(mu);
+        done_cv.wait(lk, [this] { return inflight == 0; });
+        long n = completed_since_wait;
+        completed_since_wait = 0;
+        if (errors > 0) {
+            long e = errors;
+            errors = 0;
+            return -e;
+        }
+        return n;
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* aio_handle_new(long block_size, int queue_depth, int n_threads) {
+    if (n_threads < 1) n_threads = 1;
+    return new AioHandle(static_cast<size_t>(block_size), queue_depth, n_threads);
+}
+
+void aio_handle_free(void* h) { delete static_cast<AioHandle*>(h); }
+
+int aio_submit_pread(void* h, void* buf, const char* path, long nbytes, long offset) {
+    AioOp op;
+    op.write = false;
+    op.buf = static_cast<char*>(buf);
+    op.path = path;
+    op.nbytes = static_cast<size_t>(nbytes);
+    op.file_offset = static_cast<size_t>(offset);
+    static_cast<AioHandle*>(h)->submit(op);
+    return 0;
+}
+
+int aio_submit_pwrite(void* h, void* buf, const char* path, long nbytes, long offset,
+                      int do_fsync) {
+    AioOp op;
+    op.write = true;
+    op.buf = static_cast<char*>(buf);
+    op.path = path;
+    op.nbytes = static_cast<size_t>(nbytes);
+    op.file_offset = static_cast<size_t>(offset);
+    op.fsync = do_fsync != 0;
+    static_cast<AioHandle*>(h)->submit(op);
+    return 0;
+}
+
+long aio_wait(void* h) { return static_cast<AioHandle*>(h)->wait_all(); }
+
+long aio_pending(void* h) {
+    AioHandle* handle = static_cast<AioHandle*>(h);
+    std::lock_guard<std::mutex> lk(handle->mu);
+    return static_cast<long>(handle->inflight);
+}
+
+int aio_pread(void* h, void* buf, const char* path, long nbytes, long offset) {
+    aio_submit_pread(h, buf, path, nbytes, offset);
+    return static_cast<AioHandle*>(h)->wait_all() < 0 ? -1 : 0;
+}
+
+int aio_pwrite(void* h, void* buf, const char* path, long nbytes, long offset,
+               int do_fsync) {
+    aio_submit_pwrite(h, buf, path, nbytes, offset, do_fsync);
+    return static_cast<AioHandle*>(h)->wait_all() < 0 ? -1 : 0;
+}
+
+// Aligned host buffer helpers (reference "pinned" buffer analog — on TPU-VM
+// hosts page-aligned DRAM is what the DMA engine wants).
+void* aio_alloc_aligned(long nbytes) {
+    void* p = nullptr;
+    size_t padded = ((static_cast<size_t>(nbytes) + kAlign - 1) / kAlign) * kAlign;
+    if (posix_memalign(&p, kAlign, padded) != 0) return nullptr;
+    return p;
+}
+
+void aio_free_aligned(void* p) { free(p); }
+
+}  // extern "C"

@@ -1,0 +1,114 @@
+"""Python handle over the native async-I/O engine (ctypes).
+
+Analog of reference ``deepspeed_py_aio_handle.{h,cpp}`` (csrc/aio): an
+``AsyncIOHandle`` with sync/async pread/pwrite of numpy buffers against local
+NVMe files, plus aligned "pinned" host buffer allocation. Used by the
+ZeRO-Infinity tensor swappers (``runtime/swap_tensor``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+from .op_builder import AsyncIOBuilder
+
+
+class AsyncIOHandle:
+    """Thread-pooled async file I/O over host buffers.
+
+    Parameters mirror the reference handle (block_size, queue_depth,
+    thread_count — deepspeed_py_aio_handle.h:12 region).
+    """
+
+    def __init__(self, block_size: int = 1 << 20, queue_depth: int = 32,
+                 thread_count: int = 8):
+        self._lib = AsyncIOBuilder().load()
+        lib = self._lib
+        lib.aio_handle_new.restype = ctypes.c_void_p
+        lib.aio_handle_new.argtypes = [ctypes.c_long, ctypes.c_int, ctypes.c_int]
+        lib.aio_handle_free.argtypes = [ctypes.c_void_p]
+        lib.aio_submit_pread.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_char_p, ctypes.c_long, ctypes.c_long]
+        lib.aio_submit_pwrite.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_char_p, ctypes.c_long,
+            ctypes.c_long, ctypes.c_int]
+        lib.aio_wait.restype = ctypes.c_long
+        lib.aio_wait.argtypes = [ctypes.c_void_p]
+        lib.aio_pending.restype = ctypes.c_long
+        lib.aio_pending.argtypes = [ctypes.c_void_p]
+        lib.aio_alloc_aligned.restype = ctypes.c_void_p
+        lib.aio_alloc_aligned.argtypes = [ctypes.c_long]
+        lib.aio_free_aligned.argtypes = [ctypes.c_void_p]
+        self._h = lib.aio_handle_new(block_size, queue_depth, thread_count)
+        self.block_size = block_size
+        self.queue_depth = queue_depth
+        self.thread_count = thread_count
+
+    # -- async API ---------------------------------------------------------
+    def async_pread(self, buf: np.ndarray, path: str, file_offset: int = 0) -> None:
+        assert buf.flags["C_CONTIGUOUS"]
+        self._lib.aio_submit_pread(
+            self._h, buf.ctypes.data_as(ctypes.c_void_p), path.encode(),
+            buf.nbytes, file_offset)
+
+    def async_pwrite(self, buf: np.ndarray, path: str, file_offset: int = 0,
+                     fsync: bool = False) -> None:
+        assert buf.flags["C_CONTIGUOUS"]
+        self._lib.aio_submit_pwrite(
+            self._h, buf.ctypes.data_as(ctypes.c_void_p), path.encode(),
+            buf.nbytes, file_offset, int(fsync))
+
+    def wait(self) -> int:
+        """Block until all submitted ops retire; returns ops completed.
+
+        Raises IOError if any op failed (negative return from native side)."""
+        n = self._lib.aio_wait(self._h)
+        if n < 0:
+            raise IOError(f"aio: {-n} operation(s) failed")
+        return n
+
+    def pending(self) -> int:
+        return self._lib.aio_pending(self._h)
+
+    # -- sync convenience --------------------------------------------------
+    def sync_pread(self, buf: np.ndarray, path: str, file_offset: int = 0) -> int:
+        self.async_pread(buf, path, file_offset)
+        return self.wait()
+
+    def sync_pwrite(self, buf: np.ndarray, path: str, file_offset: int = 0,
+                    fsync: bool = False) -> int:
+        self.async_pwrite(buf, path, file_offset, fsync)
+        return self.wait()
+
+    def new_aligned_buffer(self, nbytes: int, dtype=np.uint8) -> np.ndarray:
+        """4096-aligned host buffer suitable for O_DIRECT (pinned-buffer analog)."""
+        ptr = self._lib.aio_alloc_aligned(nbytes)
+        if not ptr:
+            raise MemoryError("aio_alloc_aligned failed")
+        raw = (ctypes.c_uint8 * nbytes).from_address(ptr)
+        arr = np.frombuffer(raw, dtype=dtype)
+        # keep the allocation alive and freeable
+        arr = arr.view()
+        arr.flags.writeable = True
+        self._aligned_ptrs = getattr(self, "_aligned_ptrs", [])
+        self._aligned_ptrs.append(ptr)
+        return arr
+
+    def free(self):
+        if getattr(self, "_h", None):
+            self.wait()
+            for p in getattr(self, "_aligned_ptrs", []):
+                self._lib.aio_free_aligned(p)
+            self._aligned_ptrs = []
+            self._lib.aio_handle_free(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.free()
+        except Exception:
+            pass

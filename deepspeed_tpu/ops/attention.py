@@ -24,7 +24,9 @@ def causal_attention_jnp(q, k, v, sm_scale: Optional[float] = None):
     """Reference implementation: [B,S,H,D] → [B,S,H,D], causal, f32 softmax."""
     B, S, H, D = q.shape
     scale = sm_scale if sm_scale is not None else 1.0 / (D**0.5)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
     mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
     logits = jnp.where(mask[None, None], logits, jnp.float32(-1e30))
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)

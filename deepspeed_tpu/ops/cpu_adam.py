@@ -1,0 +1,163 @@
+"""Host-side SIMD optimizers over numpy shards (ctypes wrappers).
+
+Analog of reference ``ops/adam/cpu_adam.py`` (DeepSpeedCPUAdam:12),
+``ops/adagrad/cpu_adagrad.py`` and the host half of ``ops/lamb``: the
+optimizer step runs on TPU-VM host cores over fp32 master shards living in
+host DRAM (ZeRO-Offload), leaving HBM for params/activations. The native
+kernels live in ``csrc/adam/cpu_adam.cpp`` (OpenMP + auto-vectorized AVX).
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .op_builder import CPUAdamBuilder
+
+
+def _lib():
+    lib = CPUAdamBuilder().load()
+    if not getattr(lib, "_ds_typed", False):
+        f32p = ctypes.POINTER(ctypes.c_float)
+        u16p = ctypes.POINTER(ctypes.c_uint16)
+        lib.ds_adam_step.argtypes = [f32p, f32p, f32p, f32p, ctypes.c_int64,
+                                     ctypes.c_int, ctypes.c_float, ctypes.c_float,
+                                     ctypes.c_float, ctypes.c_float, ctypes.c_float,
+                                     ctypes.c_int, ctypes.c_int]
+        lib.ds_adagrad_step.argtypes = [f32p, f32p, f32p, ctypes.c_int64,
+                                        ctypes.c_float, ctypes.c_float, ctypes.c_float]
+        lib.ds_lamb_phase1.argtypes = [f32p, f32p, f32p, f32p, f32p, ctypes.c_int64,
+                                       ctypes.c_int, ctypes.c_float, ctypes.c_float,
+                                       ctypes.c_float, ctypes.c_float]
+        lib.ds_lamb_phase2.argtypes = [f32p, f32p, ctypes.c_int64, ctypes.c_float,
+                                       ctypes.c_float]
+        lib.ds_sumsq.restype = ctypes.c_double
+        lib.ds_sumsq.argtypes = [f32p, ctypes.c_int64]
+        lib.ds_f32_to_bf16.argtypes = [u16p, f32p, ctypes.c_int64]
+        lib.ds_bf16_to_f32.argtypes = [f32p, u16p, ctypes.c_int64]
+        lib._ds_typed = True
+    return lib
+
+
+def _f32p(a: np.ndarray):
+    assert a.dtype == np.float32 and a.flags["C_CONTIGUOUS"]
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def f32_to_bf16(src: np.ndarray, out: Optional[np.ndarray] = None) -> np.ndarray:
+    """Round-to-nearest-even fp32→bf16 on host (returns uint16 view array)."""
+    lib = _lib()
+    flat = np.ascontiguousarray(src, np.float32).ravel()
+    if out is None:
+        out = np.empty(flat.shape, np.uint16)
+    lib.ds_f32_to_bf16(out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)), _f32p(flat), flat.size)
+    return out.reshape(src.shape)
+
+
+def bf16_to_f32(src: np.ndarray) -> np.ndarray:
+    lib = _lib()
+    flat = np.ascontiguousarray(src, np.uint16).ravel()
+    out = np.empty(flat.shape, np.float32)
+    lib.ds_bf16_to_f32(_f32p(out), flat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)), flat.size)
+    return out.reshape(src.shape)
+
+
+class DeepSpeedCPUAdam:
+    """Adam/AdamW stepping flat fp32 host shards in place.
+
+    One instance per parameter group; ``step(params, grads)`` mutates params
+    and internal moments. Matches reference DeepSpeedCPUAdam semantics
+    (bias correction, adamw_mode) within fp32 rounding.
+    """
+
+    def __init__(self, lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
+                 weight_decay: float = 0.0, adamw_mode: bool = True,
+                 bias_correction: bool = True):
+        self.lr = lr
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adamw_mode = adamw_mode
+        self.bias_correction = bias_correction
+        self.step_count = 0
+        self._m: Dict[int, np.ndarray] = {}
+        self._v: Dict[int, np.ndarray] = {}
+
+    def state_tensors(self, key: int, n: int):
+        if key not in self._m:
+            self._m[key] = np.zeros(n, np.float32)
+            self._v[key] = np.zeros(n, np.float32)
+        return self._m[key], self._v[key]
+
+    def step(self, params: np.ndarray, grads: np.ndarray, key: int = 0,
+             lr: Optional[float] = None) -> None:
+        assert params.shape == grads.shape
+        self.step_count += 1
+        m, v = self.state_tensors(key, params.size)
+        _lib().ds_adam_step(
+            _f32p(params), _f32p(np.ascontiguousarray(grads, np.float32)),
+            _f32p(m), _f32p(v), params.size, self.step_count,
+            lr if lr is not None else self.lr, self.beta1, self.beta2,
+            self.eps, self.weight_decay, int(self.adamw_mode),
+            int(self.bias_correction))
+
+    # state swap hooks used by the NVMe optimizer swapper
+    def get_state(self, key: int) -> List[np.ndarray]:
+        return [self._m[key], self._v[key]]
+
+    def set_state(self, key: int, tensors: List[np.ndarray]) -> None:
+        self._m[key], self._v[key] = tensors[0], tensors[1]
+
+
+class DeepSpeedCPUAdagrad:
+    """Adagrad over flat fp32 host shards (reference cpu_adagrad.py:10)."""
+
+    def __init__(self, lr: float = 1e-2, eps: float = 1e-10, weight_decay: float = 0.0):
+        self.lr = lr
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self._sq: Dict[int, np.ndarray] = {}
+
+    def step(self, params: np.ndarray, grads: np.ndarray, key: int = 0) -> None:
+        if key not in self._sq:
+            self._sq[key] = np.zeros(params.size, np.float32)
+        _lib().ds_adagrad_step(
+            _f32p(params), _f32p(np.ascontiguousarray(grads, np.float32)),
+            _f32p(self._sq[key]), params.size, self.lr, self.eps, self.weight_decay)
+
+
+class DeepSpeedCPULamb:
+    """LAMB with per-tensor trust ratio on host shards (reference ops/lamb)."""
+
+    def __init__(self, lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-6,
+                 weight_decay: float = 0.0, min_trust: float = 0.01, max_trust: float = 10.0):
+        self.lr = lr
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.min_trust = min_trust
+        self.max_trust = max_trust
+        self.step_count = 0
+        self._m: Dict[int, np.ndarray] = {}
+        self._v: Dict[int, np.ndarray] = {}
+
+    def step(self, params: np.ndarray, grads: np.ndarray, key: int = 0) -> None:
+        lib = _lib()
+        self.step_count += 1
+        if key not in self._m:
+            self._m[key] = np.zeros(params.size, np.float32)
+            self._v[key] = np.zeros(params.size, np.float32)
+        update = np.empty(params.size, np.float32)
+        lib.ds_lamb_phase1(
+            _f32p(params), _f32p(np.ascontiguousarray(grads, np.float32)),
+            _f32p(self._m[key]), _f32p(self._v[key]), _f32p(update),
+            params.size, self.step_count, self.beta1, self.beta2, self.eps,
+            self.weight_decay)
+        w_norm = float(np.sqrt(lib.ds_sumsq(_f32p(params), params.size)))
+        u_norm = float(np.sqrt(lib.ds_sumsq(_f32p(update), params.size)))
+        trust = 1.0
+        if w_norm > 0 and u_norm > 0:
+            trust = float(np.clip(w_norm / u_norm, self.min_trust, self.max_trust))
+        lib.ds_lamb_phase2(_f32p(params), _f32p(update), params.size, self.lr, trust)

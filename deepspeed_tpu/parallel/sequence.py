@@ -68,6 +68,12 @@ def _ring_attention_local(q, k, v, *, axis_name: str, sm_scale: Optional[float],
     def step(carry, step_i):
         o, m, l, k_blk, v_blk = carry
         src = (idx + step_i) % n
+
+        # NOTE: for causal attention, blocks with src > idx are fully masked,
+        # but skipping them cannot shorten the step — the ppermute chains each
+        # step to the busiest device (device n-1 always attends). Balancing
+        # needs a zigzag Q layout, not a per-step branch; until then the mask
+        # handles it.
         k_pos = src * S + jnp.arange(S)
         logits = jnp.einsum(
             "bqhd,bkhd->bhqk", q, k_blk, preferred_element_type=jnp.float32
@@ -88,11 +94,12 @@ def _ring_attention_local(q, k, v, *, axis_name: str, sm_scale: Optional[float],
             preferred_element_type=jnp.float32,
         )
         o = o * alpha[..., None] + pv
+        m = m_new
         # rotate K/V to the next device; independent of this block's compute,
         # so XLA overlaps the ppermute with the matmuls above
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
-        return (o, m_new, l, k_blk, v_blk), None
+        return (o, m, l, k_blk, v_blk), None
 
     (o, m, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v), jnp.arange(n))
     o = o / jnp.maximum(l, 1e-30)[..., None]
@@ -121,15 +128,20 @@ def _ulysses_local(q, k, v, *, axis_name: str, sm_scale: Optional[float], causal
 
     q, k, v = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
     Sf = S * n
-    scale = sm_scale if sm_scale is not None else 1.0 / (D**0.5)
-    logits = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
-    ) * scale
     if causal:
-        mask = jnp.tril(jnp.ones((Sf, Sf), jnp.bool_))
-        logits = jnp.where(mask[None, None], logits, _NEG_INF)
-    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
-    o = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        # Pallas flash path on TPU: O(S_full) memory per device. The jnp
+        # fallback (non-TPU, or shapes the kernel rejects) still materializes
+        # the [B, H/n, S_full, S_full] logits — at that point prefer ring.
+        from ..ops.attention import causal_attention
+
+        o = causal_attention(q, k, v, sm_scale=sm_scale)
+    else:
+        scale = sm_scale if sm_scale is not None else 1.0 / (D**0.5)
+        logits = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+        ) * scale
+        probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+        o = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
     return heads_to_seq(o)
 
 
@@ -157,6 +169,12 @@ def sequence_parallel_attention(
     """
     if impl not in ("ring", "ulysses"):
         raise ValueError(f"unknown sequence-parallel impl {impl}")
+    if mesh.shape.get("pp", 1) > 1 and mesh.shape.get(sp_axis, 1) > 1:
+        raise NotImplementedError(
+            "sequence-parallel attention (ring/ulysses) cannot run inside a "
+            "pipeline-parallel stage: the sp shard_map would nest inside the "
+            "pp shard_map. Use pp with attn_impl='flash'/'jnp', or drop pp."
+        )
     axes = mesh.axis_names
     dp = dp_axis if dp_axis in axes else None
     sp = sp_axis if sp_axis in axes else None

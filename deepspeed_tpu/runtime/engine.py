@@ -363,6 +363,7 @@ class DeepSpeedEngine:
         gas = self.gradient_accumulation_steps_value
 
         sp = "sp" if self.sp_world_size > 1 else None
+        dp = "dp" if "dp" in self.mesh.axis_names else None
 
         def put(x):
             x = np.asarray(x)
@@ -372,9 +373,21 @@ class DeepSpeedEngine:
             x = x.reshape(gas, -1, *x.shape[1:])
             rest = [None] * (x.ndim - 2)
             # long-context: the sequence dim (first non-batch dim) shards over sp
-            if rest and sp is not None and x.shape[2] % self.sp_world_size == 0:
-                rest[0] = sp
-            spec = PartitionSpec(None, "dp", *rest)
+            if rest and sp is not None:
+                if x.shape[2] % self.sp_world_size == 0:
+                    rest[0] = sp
+                else:
+                    # non-sequence leaves (e.g. [B, 3] features) legitimately
+                    # land here; a true sequence leaf will fail later in the
+                    # attention shard_map — this warning names the cause
+                    from ..utils.logging import warning_once
+
+                    warning_once(
+                        f"batch leaf dim {x.shape[2]} not divisible by sp "
+                        f"({self.sp_world_size}); replicating over sp. If this "
+                        "is the sequence dim, pad it or change sp."
+                    )
+            spec = PartitionSpec(None, dp, *rest)
             return jax.device_put(x, NamedSharding(self.mesh, spec))
 
         return jax.tree.map(put, batch)
