@@ -27,6 +27,7 @@
 #include <cstring>
 #include <deque>
 #include <fcntl.h>
+#include <memory>
 #include <mutex>
 #include <string>
 #include <sys/stat.h>
@@ -47,6 +48,10 @@ struct AioOp {
     size_t file_offset = 0;
     bool fsync = false;
     int fd = -1;  // >= 0: use this fd instead of opening path
+    // Sub-ops split from one logical write share a countdown; the LAST one to
+    // retire performs the fsync — doing it on the tail sub-op would race the
+    // siblings still writing on other workers.
+    std::shared_ptr<std::atomic<int>> group_remaining;
 };
 
 struct AioHandle {
@@ -127,7 +132,9 @@ struct AioHandle {
             }
             done += static_cast<size_t>(n);
         }
-        if (err == 0 && op.write && op.fsync) {
+        bool last_in_group =
+            !op.group_remaining || op.group_remaining->fetch_sub(1) == 1;
+        if (err == 0 && op.write && op.fsync && last_in_group) {
             if (::fsync(fd) != 0) err = -errno;
         }
         if (own_fd) ::close(fd);
@@ -169,8 +176,12 @@ struct AioHandle {
             sub.buf = op.buf + off;
             sub.file_offset = op.file_offset + off;
             sub.nbytes = std::min(part, op.nbytes - off);
-            sub.fsync = op.fsync && (off + part >= op.nbytes);  // fsync once, on the tail op
             ops.push_back(std::move(sub));
+        }
+        if (op.fsync && ops.size() > 1) {
+            auto remaining = std::make_shared<std::atomic<int>>(
+                static_cast<int>(ops.size()));
+            for (auto& o : ops) o.group_remaining = remaining;
         }
         {
             std::lock_guard<std::mutex> lk(mu);

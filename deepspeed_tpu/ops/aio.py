@@ -85,25 +85,31 @@ class AsyncIOHandle:
         return self.wait()
 
     def new_aligned_buffer(self, nbytes: int, dtype=np.uint8) -> np.ndarray:
-        """4096-aligned host buffer suitable for O_DIRECT (pinned-buffer analog)."""
+        """4096-aligned host buffer suitable for O_DIRECT (pinned-buffer analog).
+
+        The allocation is owned by the returned array: it is released when the
+        array (and every view of it) is garbage-collected — NOT when the
+        handle is freed, so buffers may safely outlive the handle."""
+        import weakref
+
         ptr = self._lib.aio_alloc_aligned(nbytes)
         if not ptr:
             raise MemoryError("aio_alloc_aligned failed")
         raw = (ctypes.c_uint8 * nbytes).from_address(ptr)
         arr = np.frombuffer(raw, dtype=dtype)
-        # keep the allocation alive and freeable
         arr = arr.view()
         arr.flags.writeable = True
-        self._aligned_ptrs = getattr(self, "_aligned_ptrs", [])
-        self._aligned_ptrs.append(ptr)
+        # every numpy view's .base chain bottoms out at `raw` (numpy collapses
+        # view bases to the buffer owner), so the finalizer fires only once no
+        # array at all references the allocation
+        weakref.finalize(raw, self._lib.aio_free_aligned, ptr)
         return arr
 
     def free(self):
+        """Drain in-flight ops and destroy the native handle. Aligned buffers
+        from ``new_aligned_buffer`` stay valid (freed by their own GC)."""
         if getattr(self, "_h", None):
             self.wait()
-            for p in getattr(self, "_aligned_ptrs", []):
-                self._lib.aio_free_aligned(p)
-            self._aligned_ptrs = []
             self._lib.aio_handle_free(self._h)
             self._h = None
 

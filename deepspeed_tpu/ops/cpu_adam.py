@@ -81,7 +81,9 @@ class DeepSpeedCPUAdam:
         self.weight_decay = weight_decay
         self.adamw_mode = adamw_mode
         self.bias_correction = bias_correction
-        self.step_count = 0
+        # per-shard step counts: bias correction must track each shard's own
+        # update count (reference keeps per-param state['step'], cpu_adam.py:163)
+        self._step: Dict[int, int] = {}
         self._m: Dict[int, np.ndarray] = {}
         self._v: Dict[int, np.ndarray] = {}
 
@@ -89,26 +91,35 @@ class DeepSpeedCPUAdam:
         if key not in self._m:
             self._m[key] = np.zeros(n, np.float32)
             self._v[key] = np.zeros(n, np.float32)
+            self._step[key] = 0
         return self._m[key], self._v[key]
 
     def step(self, params: np.ndarray, grads: np.ndarray, key: int = 0,
              lr: Optional[float] = None) -> None:
         assert params.shape == grads.shape
-        self.step_count += 1
         m, v = self.state_tensors(key, params.size)
+        self._step[key] += 1
         _lib().ds_adam_step(
             _f32p(params), _f32p(np.ascontiguousarray(grads, np.float32)),
-            _f32p(m), _f32p(v), params.size, self.step_count,
+            _f32p(m), _f32p(v), params.size, self._step[key],
             lr if lr is not None else self.lr, self.beta1, self.beta2,
             self.eps, self.weight_decay, int(self.adamw_mode),
             int(self.bias_correction))
 
+    @property
+    def step_count(self) -> int:
+        """Max step across shards (informational)."""
+        return max(self._step.values(), default=0)
+
     # state swap hooks used by the NVMe optimizer swapper
     def get_state(self, key: int) -> List[np.ndarray]:
-        return [self._m[key], self._v[key]]
+        return [self._m[key], self._v[key],
+                np.asarray([self._step.get(key, 0)], np.float32)]
 
     def set_state(self, key: int, tensors: List[np.ndarray]) -> None:
         self._m[key], self._v[key] = tensors[0], tensors[1]
+        if len(tensors) > 2:
+            self._step[key] = int(tensors[2][0])
 
 
 class DeepSpeedCPUAdagrad:
@@ -139,21 +150,22 @@ class DeepSpeedCPULamb:
         self.weight_decay = weight_decay
         self.min_trust = min_trust
         self.max_trust = max_trust
-        self.step_count = 0
+        self._step: Dict[int, int] = {}
         self._m: Dict[int, np.ndarray] = {}
         self._v: Dict[int, np.ndarray] = {}
 
     def step(self, params: np.ndarray, grads: np.ndarray, key: int = 0) -> None:
         lib = _lib()
-        self.step_count += 1
         if key not in self._m:
             self._m[key] = np.zeros(params.size, np.float32)
             self._v[key] = np.zeros(params.size, np.float32)
+            self._step[key] = 0
+        self._step[key] += 1
         update = np.empty(params.size, np.float32)
         lib.ds_lamb_phase1(
             _f32p(params), _f32p(np.ascontiguousarray(grads, np.float32)),
             _f32p(self._m[key]), _f32p(self._v[key]), _f32p(update),
-            params.size, self.step_count, self.beta1, self.beta2, self.eps,
+            params.size, self._step[key], self.beta1, self.beta2, self.eps,
             self.weight_decay)
         w_norm = float(np.sqrt(lib.ds_sumsq(_f32p(params), params.size)))
         u_norm = float(np.sqrt(lib.ds_sumsq(_f32p(update), params.size)))

@@ -69,12 +69,12 @@ class OpBuilder:
             return gate != "0"
         return shutil.which("g++") is not None and all(os.path.exists(s) for s in self.sources)
 
-    def _source_hash(self) -> str:
+    def _source_hash(self, flags: List[str]) -> str:
         h = hashlib.sha256()
         for s in sorted(self.sources):
             with open(s, "rb") as f:
                 h.update(f.read())
-        h.update(" ".join(self.cflags()).encode())
+        h.update(" ".join(flags).encode())
         return h.hexdigest()[:16]
 
     def cflags(self) -> List[str]:
@@ -83,25 +83,37 @@ class OpBuilder:
             flags.append("-march=native")
         return flags + self.extra_flags
 
-    def so_path(self) -> str:
-        return os.path.join(_cache_dir(), f"{self.name}_{self._source_hash()}.so")
+    def so_path(self, flags: Optional[List[str]] = None) -> str:
+        flags = flags if flags is not None else self.cflags()
+        return os.path.join(_cache_dir(), f"{self.name}_{self._source_hash(flags)}.so")
 
     def build(self) -> str:
-        out = self.so_path()
-        if os.path.exists(out):
-            return out
-        cmd = ["g++", *self.cflags(), *self.sources, "-o", out + ".tmp"]
-        logger.info(f"building native op '{self.name}': {' '.join(cmd)}")
-        try:
-            subprocess.run(cmd, check=True, capture_output=True, text=True)
-        except subprocess.CalledProcessError as e:  # retry without -march=native
-            if "-march=native" in cmd:
-                cmd.remove("-march=native")
+        # Concurrency-safe (8 host procs cold-starting at once on a pod slice):
+        # compile to a per-process unique tmp, publish with atomic os.replace;
+        # losers of the race simply overwrite with identical bytes. Each flag
+        # set caches under its own hash, so a -march=native fallback never
+        # masquerades as the native build.
+        flags = self.cflags()
+        while True:
+            out = self.so_path(flags)
+            if os.path.exists(out):
+                return out
+            tmp = f"{out}.tmp.{os.getpid()}.{os.urandom(4).hex()}"
+            cmd = ["g++", *flags, *self.sources, "-o", tmp]
+            logger.info(f"building native op '{self.name}': {' '.join(cmd)}")
+            try:
                 subprocess.run(cmd, check=True, capture_output=True, text=True)
-            else:
-                raise RuntimeError(f"native build of {self.name} failed:\n{e.stderr}") from e
-        os.replace(out + ".tmp", out)
-        return out
+            except subprocess.CalledProcessError as e:
+                if os.path.exists(tmp):
+                    os.remove(tmp)
+                if "-march=native" in flags:
+                    flags = [f for f in flags if f != "-march=native"]
+                    continue
+                raise RuntimeError(
+                    f"native build of {self.name} failed:\n{e.stderr}"
+                ) from e
+            os.replace(tmp, out)
+            return out
 
     def load(self) -> ctypes.CDLL:
         if self.name in _loaded:

@@ -1,0 +1,21 @@
+from .checkpointing import (
+    CheckpointPolicy,
+    checkpoint,
+    checkpoint_wrapper,
+    configure,
+    get_policy,
+    is_configured,
+    partition_activations_constraint,
+    reset,
+)
+
+__all__ = [
+    "CheckpointPolicy",
+    "checkpoint",
+    "checkpoint_wrapper",
+    "configure",
+    "get_policy",
+    "is_configured",
+    "partition_activations_constraint",
+    "reset",
+]

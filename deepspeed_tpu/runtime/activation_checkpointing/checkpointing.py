@@ -1,0 +1,159 @@
+"""Activation checkpointing — TPU-native rematerialisation.
+
+Analog of reference ``deepspeed/runtime/activation_checkpointing/checkpointing.py``
+(CheckpointFunction:493, partition_activations:367, gather_partitioned_activations:259,
+configure:825, 917 LoC). The reference re-implements torch checkpointing with
+manual RNG state tracking (CudaRNGStatesTracker:122), activation partitioning
+across model-parallel ranks, CPU offload and contiguous buffers.
+
+On TPU every one of those mechanisms collapses into ``jax.checkpoint``:
+
+- recompute-in-backward     → ``jax.checkpoint`` (XLA rematerialisation)
+- RNG state tracking        → functional PRNG keys are replayed exactly by
+                              construction; no tracker needed
+- partition_activations     → a sharding constraint on the saved residuals
+                              (``partition_activations_constraint``) so each
+                              tp rank keeps 1/tp of every checkpoint
+- cpu_checkpointing         → ``jax.checkpoint`` offload policies: residuals
+                              are moved to pinned host RAM and fetched back in
+                              backward (``offload_dot`` policy below)
+- contiguous_memory_optimization → XLA's allocator already packs residual
+                              buffers; exposed as a no-op knob for config parity
+- profile / num_layers      → remat policy selection per layer
+
+The public surface mirrors the reference: ``configure(config)`` then
+``checkpoint(fn, *args)``; models may also call ``checkpoint_wrapper(fn)``
+to bake a policy in at trace time.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+import jax
+from jax import lax
+from jax.sharding import PartitionSpec
+
+PyTree = Any
+
+# jax.checkpoint policy registry. "selective" saves matmul outputs (the
+# flash-attention-era default: cheap elementwise ops recompute, expensive
+# MXU ops do not); "full" saves nothing and recomputes everything (max
+# memory savings); "offload" saves matmul outputs to host RAM.
+_POLICIES = {
+    "none": None,  # no remat — save everything (jax default without checkpoint)
+    "full": jax.checkpoint_policies.nothing_saveable,
+    "selective": jax.checkpoint_policies.checkpoint_dots,
+    "selective_no_batch": jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+}
+
+
+def _offload_policy():
+    # offload residuals that are matmul outputs to pinned host memory
+    # (cpu_checkpointing analog, reference checkpointing.py:480)
+    return jax.checkpoint_policies.save_and_offload_only_these_names(
+        names_which_can_be_saved=[],
+        names_which_can_be_offloaded=["ckpt_offload"],
+        offload_src="device",
+        offload_dst="pinned_host",
+    )
+
+
+@dataclass
+class CheckpointPolicy:
+    """Resolved activation-checkpointing behaviour."""
+
+    enabled: bool = False
+    policy_name: str = "full"
+    partition_activations: bool = False
+    cpu_checkpointing: bool = False
+    prevent_cse: bool = False
+
+    def jax_policy(self):
+        if self.cpu_checkpointing:
+            return _offload_policy()
+        return _POLICIES.get(self.policy_name)
+
+
+_configured: Optional[CheckpointPolicy] = None
+
+
+def configure(config=None, **kwargs) -> CheckpointPolicy:
+    """Set the global checkpointing policy (reference configure:825).
+
+    Accepts the ``activation_checkpointing`` config section (an object with
+    ``partition_activations`` / ``cpu_checkpointing`` attributes) or kwargs.
+    """
+    global _configured
+    if config is not None:
+        pol = CheckpointPolicy(
+            enabled=True,
+            partition_activations=getattr(config, "partition_activations", False),
+            cpu_checkpointing=getattr(config, "cpu_checkpointing", False),
+        )
+    else:
+        pol = CheckpointPolicy(enabled=True)
+    for k, v in kwargs.items():
+        setattr(pol, k, v)
+    _configured = pol
+    return pol
+
+
+def reset() -> None:
+    global _configured
+    _configured = None
+
+
+def is_configured() -> bool:
+    return _configured is not None
+
+
+def get_policy() -> CheckpointPolicy:
+    return _configured if _configured is not None else CheckpointPolicy()
+
+
+def checkpoint_wrapper(fn: Callable, policy: Optional[CheckpointPolicy] = None) -> Callable:
+    """Wrap ``fn`` so its activations are rematerialised in backward.
+
+    The direct analog of reference CheckpointFunction (checkpointing.py:493):
+    ``block = checkpoint_wrapper(block)`` inside a model stack.
+    """
+    pol = policy or get_policy()
+    if not pol.enabled:
+        return fn
+    return jax.checkpoint(fn, policy=pol.jax_policy(), prevent_cse=pol.prevent_cse)
+
+
+def checkpoint(fn: Callable, *args):
+    """Run ``fn(*args)`` under the configured remat policy.
+
+    Matches the reference call style ``checkpointing.checkpoint(run, x)``
+    (checkpointing.py:954).
+    """
+    return checkpoint_wrapper(fn)(*args)
+
+
+def partition_activations_constraint(x, tp_axis: str = "tp", dim: int = -1):
+    """Shard a residual over the tp axis (partition_activations:367 analog).
+
+    Inside a jitted function, constrain the saved activation so each model-
+    parallel rank materialises only its 1/tp slice; XLA inserts the gather in
+    backward exactly where the reference calls
+    gather_partitioned_activations:259.
+    """
+    ndim = x.ndim
+    dim = dim % ndim
+    spec = [None] * ndim
+    spec[dim] = tp_axis
+    return lax.with_sharding_constraint(x, PartitionSpec(*spec))
+
+
+def offload_name(x):
+    """Tag an intermediate for host offload under cpu_checkpointing.
+
+    Usage inside a model: ``h = offload_name(h)`` on the tensors worth
+    spilling; with the ``offload`` policy active they live in pinned host
+    RAM between forward and backward.
+    """
+    return jax.ad_checkpoint.checkpoint_name(x, "ckpt_offload")

@@ -1,0 +1,3 @@
+from .curriculum_scheduler import CurriculumScheduler
+
+__all__ = ["CurriculumScheduler"]

@@ -1,0 +1,113 @@
+"""Curriculum learning — difficulty (sequence-length) scheduling.
+
+Analog of reference ``deepspeed/runtime/data_pipeline/curriculum_scheduler.py``
+(CurriculumScheduler:8, 134 LoC) and the engine hook that feeds the current
+seqlen into forward kwargs (engine.py:1643-1649).
+
+Schedules supported (same names/semantics as the reference):
+- ``fixed_linear``:   difficulty grows linearly from min to max over
+                      ``total_curriculum_step`` steps, rounded down to a
+                      multiple of ``difficulty_step``.
+- ``fixed_root``:     difficulty grows as step^(1/root_degree).
+- ``fixed_discrete``: explicit [difficulty, max_step] staircase.
+
+On TPU the scheduled seqlen is used by truncating/bucketing the host batch
+before device_put — XLA requires static shapes, so the engine rounds the
+difficulty to a small set of buckets to bound recompilation (each bucket
+compiles once, then is cached).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict
+
+FIXED_LINEAR = "fixed_linear"
+FIXED_ROOT = "fixed_root"
+FIXED_DISCRETE = "fixed_discrete"
+
+
+class CurriculumScheduler:
+    def __init__(self, config: Any):
+        # accept either the typed CurriculumConfig or a raw dict
+        if isinstance(config, dict):
+            self.min_difficulty = int(config.get("min_difficulty", 8))
+            self.max_difficulty = int(config.get("max_difficulty", 1024))
+            self.schedule_type = config.get("schedule_type", FIXED_LINEAR)
+            self.schedule_config: Dict[str, Any] = dict(config.get("schedule_config", {}))
+        else:
+            self.min_difficulty = int(config.min_difficulty)
+            self.max_difficulty = int(config.max_difficulty)
+            self.schedule_type = config.schedule_type
+            self.schedule_config = dict(config.schedule_config)
+        if self.schedule_type not in (FIXED_LINEAR, FIXED_ROOT, FIXED_DISCRETE):
+            raise ValueError(f"unknown curriculum schedule_type {self.schedule_type!r}")
+        self.current_difficulty = self.min_difficulty
+        self.first_step = True
+
+    # -- schedule math ---------------------------------------------------
+    def _fixed_linear(self, global_step: int) -> int:
+        total = int(self.schedule_config.get("total_curriculum_step", 1000))
+        dstep = int(self.schedule_config.get("difficulty_step", 8))
+        frac = min(1.0, max(0.0, global_step / max(1, total)))
+        diff = self.min_difficulty + frac * (self.max_difficulty - self.min_difficulty)
+        diff = int(diff // dstep) * dstep
+        return max(self.min_difficulty, min(self.max_difficulty, diff))
+
+    def _fixed_root(self, global_step: int) -> int:
+        total = int(self.schedule_config.get("total_curriculum_step", 1000))
+        dstep = int(self.schedule_config.get("difficulty_step", 8))
+        degree = float(self.schedule_config.get("root_degree", 2))
+        frac = min(1.0, max(0.0, global_step / max(1, total)))
+        diff = self.min_difficulty + math.pow(frac, 1.0 / degree) * (
+            self.max_difficulty - self.min_difficulty
+        )
+        diff = int(diff // dstep) * dstep
+        return max(self.min_difficulty, min(self.max_difficulty, diff))
+
+    def _fixed_discrete(self, global_step: int) -> int:
+        difficulties = self.schedule_config.get("difficulty", [self.max_difficulty])
+        boundaries = self.schedule_config.get("max_step", [])
+        # inclusive boundaries, matching reference semantics
+        # (global_steps <= max_step[i] keeps difficulty[i])
+        for diff, boundary in zip(difficulties, boundaries):
+            if global_step <= boundary:
+                return int(diff)
+        return int(difficulties[-1])
+
+    def get_difficulty(self, global_step: int) -> int:
+        if self.schedule_type == FIXED_LINEAR:
+            return self._fixed_linear(global_step)
+        if self.schedule_type == FIXED_ROOT:
+            return self._fixed_root(global_step)
+        return self._fixed_discrete(global_step)
+
+    def update_difficulty(self, global_step: int) -> int:
+        self.current_difficulty = self.get_difficulty(global_step)
+        return self.current_difficulty
+
+    # -- batch shaping ---------------------------------------------------
+    def truncate_batch(self, batch: Dict[str, Any], seq_dim: int = -1) -> Dict[str, Any]:
+        """Truncate every token-sequence array in the host batch to the
+        current difficulty (the engine-side analog of passing
+        `curriculum_seqlen` into forward, engine.py:1643).
+
+        Only integer-typed arrays (input_ids / attention_mask / labels) are
+        truncated; float feature tensors pass through untouched."""
+        import numpy as np
+
+        seqlen = self.current_difficulty
+        out = {}
+        for k, v in batch.items():
+            if (
+                hasattr(v, "ndim")
+                and v.ndim >= 2
+                and np.issubdtype(np.asarray(v).dtype, np.integer)
+                and v.shape[seq_dim] > seqlen
+            ):
+                sl = [slice(None)] * v.ndim
+                sl[seq_dim] = slice(0, seqlen)
+                out[k] = v[tuple(sl)]
+            else:
+                out[k] = v
+        return out

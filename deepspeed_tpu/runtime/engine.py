@@ -200,6 +200,22 @@ class DeepSpeedEngine:
         self.global_steps = 0  # host-side count of train_batch calls
         self.monitor = None  # wired by deepspeed_tpu.initialize when configured
 
+        # --- curriculum learning (reference engine.py:1643-1649 hook)
+        self.curriculum_scheduler = None
+        if config.curriculum_learning.enabled:
+            from .data_pipeline.curriculum_scheduler import CurriculumScheduler
+
+            self.curriculum_scheduler = CurriculumScheduler(config.curriculum_learning)
+        # --- progressive layer drop (reference progressive_layer_drop.py)
+        self.progressive_layer_drop = None
+        if config.progressive_layer_drop.enabled:
+            from .progressive_layer_drop import ProgressiveLayerDrop
+
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=config.progressive_layer_drop.theta,
+                gamma=config.progressive_layer_drop.gamma,
+            )
+
         self.training_dataloader = None
         self._data_iterator = None
         self._jit_apply = jax.jit(model.apply_fn) if model.apply_fn is not None else None
@@ -424,6 +440,14 @@ class DeepSpeedEngine:
         if self.wall_clock_breakdown:
             self.timers(TRAIN_BATCH_TIMER).start()
         self.tput_timer.start()
+        if self.curriculum_scheduler is not None:
+            # truncate seqlen to the scheduled difficulty; difficulty rounds
+            # to difficulty_step multiples so the set of compiled shapes
+            # (jit cache entries) stays small
+            self.curriculum_scheduler.update_difficulty(self.global_steps)
+            batch = self.curriculum_scheduler.truncate_batch(batch)
+        if self.progressive_layer_drop is not None:
+            self.progressive_layer_drop.update_state(self.global_steps)
         device_batch = self.shard_batch(batch)
         self._rng, step_rng = jax.random.split(self._rng)
         self.state, metrics = self._train_step(self.state, device_batch, step_rng)
@@ -499,6 +523,19 @@ class DeepSpeedEngine:
 
     def zero_optimization_stage(self) -> int:
         return self.zero_stage
+
+    def curriculum_enabled(self) -> bool:
+        return self.curriculum_scheduler is not None
+
+    def curriculum_learning_difficulty(self) -> Optional[int]:
+        if self.curriculum_scheduler is None:
+            return None
+        return self.curriculum_scheduler.current_difficulty
+
+    def progressive_layer_drop_theta(self) -> Optional[float]:
+        if self.progressive_layer_drop is None:
+            return None
+        return self.progressive_layer_drop.get_theta()
 
     # ------------------------------------------------------------------
     # checkpointing (reference engine.py:2881 save_checkpoint / :2531 load)

@@ -1,0 +1,40 @@
+"""Progressive Layer Drop (PLD).
+
+Analog of reference ``deepspeed/runtime/progressive_layer_drop.py``
+(ProgressiveLayerDrop:5, 33 LoC): a global keep-probability schedule
+``theta(t) = (1 - theta) * exp(-gamma * t) + theta`` that anneals from 1
+toward ``theta``. Layer i of L keeps with probability
+``1 - (i / L) * (1 - theta(t))`` (deeper layers drop more).
+
+TPU integration: the engine computes ``theta(t)`` on host each step and
+passes it to the model as a scalar; the model applies stochastic depth with
+``jax.random.bernoulli`` + ``lax.cond``-free arithmetic (select between the
+block output and identity), so the jitted program is step-independent.
+"""
+
+from __future__ import annotations
+
+import math
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def update_state(self, global_step: int) -> float:
+        self.current_theta = (1.0 - self.theta) * math.exp(
+            -self.gamma * global_step
+        ) + self.theta
+        return self.current_theta
+
+    def layer_keep_prob(self, layer_idx: int, num_layers: int) -> float:
+        """Per-layer keep probability under the current theta."""
+        return 1.0 - (layer_idx / max(1, num_layers)) * (1.0 - self.current_theta)

@@ -1,0 +1,269 @@
+"""Tests for activation checkpointing, curriculum, PLD, eigenvalue, sparse tensor.
+
+Reference analogs: tests around activation_checkpointing (tests/unit/
+test_activation_checkpointing.py), curriculum (test_curriculum_learning.py),
+PLD (test_pld.py), sparse grads (test_sparse_grads.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.activation_checkpointing import (
+    CheckpointPolicy,
+    checkpoint,
+    checkpoint_wrapper,
+    configure,
+    reset,
+)
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import CurriculumScheduler
+from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
+from deepspeed_tpu.runtime.sparse_tensor import (
+    SparseTensor,
+    embedding_grad_to_sparse,
+)
+
+
+class TestActivationCheckpointing:
+    def teardown_method(self):
+        reset()
+
+    def test_wrapper_preserves_values_and_grads(self):
+        def block(x):
+            return jnp.tanh(x @ x.T).sum()
+
+        x = jnp.asarray(np.random.RandomState(0).randn(8, 8), jnp.float32)
+        configure(None)
+        f_remat = checkpoint_wrapper(block)
+        assert np.allclose(block(x), f_remat(x), atol=1e-6)
+        g_ref = jax.grad(block)(x)
+        g_remat = jax.grad(f_remat)(x)
+        assert np.allclose(g_ref, g_remat, atol=1e-6)
+
+    def test_checkpoint_call_style(self):
+        configure(None)
+        out = checkpoint(lambda a, b: (a * b).sum(), jnp.ones(4), jnp.full(4, 2.0))
+        assert float(out) == 8.0
+
+    def test_disabled_policy_is_identity(self):
+        reset()
+        fn = lambda x: x * 2
+        assert checkpoint_wrapper(fn) is fn
+
+    def test_selective_policy(self):
+        pol = CheckpointPolicy(enabled=True, policy_name="selective")
+        def block(x):
+            return jnp.sum(jnp.tanh(x @ x))
+        x = jnp.eye(4)
+        wrapped = checkpoint_wrapper(block, pol)
+        assert np.allclose(jax.grad(wrapped)(x), jax.grad(block)(x), atol=1e-6)
+
+
+class TestCurriculum:
+    def test_fixed_linear(self):
+        s = CurriculumScheduler(
+            {
+                "min_difficulty": 8,
+                "max_difficulty": 64,
+                "schedule_type": "fixed_linear",
+                "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 8},
+            }
+        )
+        assert s.get_difficulty(0) == 8
+        assert s.get_difficulty(50) == 32
+        assert s.get_difficulty(100) == 64
+        assert s.get_difficulty(10**6) == 64
+        # monotone
+        diffs = [s.get_difficulty(t) for t in range(0, 120, 10)]
+        assert diffs == sorted(diffs)
+        # multiples of difficulty_step
+        assert all(d % 8 == 0 for d in diffs)
+
+    def test_fixed_root(self):
+        s = CurriculumScheduler(
+            {
+                "min_difficulty": 8,
+                "max_difficulty": 64,
+                "schedule_type": "fixed_root",
+                "schedule_config": {
+                    "total_curriculum_step": 100,
+                    "difficulty_step": 8,
+                    "root_degree": 2,
+                },
+            }
+        )
+        # sqrt schedule reaches difficulty faster than linear early on
+        assert s.get_difficulty(25) >= 32
+        assert s.get_difficulty(100) == 64
+
+    def test_fixed_discrete(self):
+        s = CurriculumScheduler(
+            {
+                "min_difficulty": 8,
+                "max_difficulty": 64,
+                "schedule_type": "fixed_discrete",
+                "schedule_config": {"difficulty": [8, 16, 64], "max_step": [10, 20, 30]},
+            }
+        )
+        assert s.get_difficulty(5) == 8
+        assert s.get_difficulty(10) == 8  # boundary is inclusive (reference semantics)
+        assert s.get_difficulty(15) == 16
+        assert s.get_difficulty(25) == 64
+        assert s.get_difficulty(99) == 64
+
+    def test_truncate_batch(self):
+        s = CurriculumScheduler(
+            {
+                "min_difficulty": 4,
+                "max_difficulty": 16,
+                "schedule_type": "fixed_linear",
+                "schedule_config": {"total_curriculum_step": 10, "difficulty_step": 4},
+            }
+        )
+        s.update_difficulty(0)
+        batch = {
+            "input_ids": np.zeros((2, 16), np.int32),
+            "meta": np.zeros((2,)),
+            "feats": np.zeros((2, 16), np.float32),  # float: untouched
+        }
+        out = s.truncate_batch(batch)
+        assert out["input_ids"].shape == (2, 4)
+        assert out["meta"].shape == (2,)
+        assert out["feats"].shape == (2, 16)
+
+    def test_engine_integration(self, mesh_dp8):
+        from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+        from deepspeed_tpu.runtime.config import DeepSpeedConfig
+        from .simple_model import make_simple_model
+
+        model = make_simple_model()
+        ds = DeepSpeedConfig.load(
+            {
+                "train_micro_batch_size_per_gpu": 2,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "curriculum_learning": {
+                    "enabled": True,
+                    "min_difficulty": 8,
+                    "max_difficulty": 32,
+                    "schedule_type": "fixed_linear",
+                    "schedule_config": {"total_curriculum_step": 4, "difficulty_step": 8},
+                },
+                "steps_per_print": 10**9,
+            },
+            dp_world_size=8,
+        )
+        engine = DeepSpeedEngine(model, ds, mesh=mesh_dp8, seed=0)
+        rs = np.random.RandomState(0)
+        # feature-dim truncation: simple model takes [B, hidden]; use a seq-
+        # shaped input to verify the seq dim shrinks per the schedule
+        batch = {
+            "x": rs.randn(16, 32).astype(np.float32),
+            "y": rs.randint(0, 8, size=(16,)).astype(np.int32),
+        }
+        m = engine.train_batch(batch)
+        assert np.isfinite(float(jax.device_get(m["loss"])))
+        assert engine.curriculum_enabled()
+        assert engine.curriculum_learning_difficulty() in (8, 16, 24, 32)
+
+
+class TestPLD:
+    def test_theta_anneals_down(self):
+        pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+        t0 = pld.update_state(0)
+        t_mid = pld.update_state(100)
+        t_end = pld.update_state(10**5)
+        assert t0 == pytest.approx(1.0)
+        assert 0.5 < t_mid < 1.0
+        assert t_end == pytest.approx(0.5, abs=1e-3)
+
+    def test_layer_keep_prob_monotone_in_depth(self):
+        pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+        pld.update_state(10**5)
+        probs = [pld.layer_keep_prob(i, 12) for i in range(12)]
+        assert probs == sorted(probs, reverse=True)
+        assert probs[0] == pytest.approx(1.0)
+
+    def test_get_state(self):
+        pld = ProgressiveLayerDrop()
+        st = pld.get_state()
+        assert st["progressive_layer_drop"] is True
+
+
+class TestEigenvalue:
+    def test_quadratic_form(self):
+        # loss = 0.5 x^T A x with known top eigenvalue
+        A = jnp.diag(jnp.asarray([4.0, 1.0, 0.25]))
+
+        def loss(params):
+            x = params["x"]
+            return 0.5 * x @ A @ x
+
+        ev, vec = Eigenvalue(max_iter=200, tol=1e-6).compute_eigenvalue(
+            loss, {"x": jnp.ones(3)}, jax.random.PRNGKey(0)
+        )
+        assert float(ev) == pytest.approx(4.0, rel=1e-2)
+        v = np.abs(np.asarray(vec["x"]))
+        assert v[0] == pytest.approx(1.0, abs=1e-2)
+
+    def test_on_model_loss(self):
+        def loss(params):
+            w = params["w"]
+            return jnp.sum(jnp.tanh(w) ** 2)
+
+        ev, _ = Eigenvalue(max_iter=50).compute_eigenvalue(
+            loss, {"w": jnp.zeros((4, 4))}, jax.random.PRNGKey(1)
+        )
+        # Hessian of sum(tanh(w)^2) at 0 is 2*I → top eigenvalue 2
+        assert float(ev) == pytest.approx(2.0, rel=1e-2)
+
+
+class TestSparseTensor:
+    def test_roundtrip(self):
+        dense = jnp.zeros((10, 4)).at[jnp.asarray([1, 7])].set(1.5)
+        sp = SparseTensor.from_dense_rows(dense, jnp.asarray([1, 7]))
+        assert np.allclose(sp.to_dense(), dense)
+        stored, full = sp.sparse_size()
+        assert stored < full
+
+    def test_embedding_grad_to_sparse(self):
+        vocab, dim = 50, 8
+        token_ids = jnp.asarray([[3, 3, 9], [12, 9, 3]])
+
+        def loss(emb):
+            return jnp.sum(emb[token_ids] ** 2)
+
+        emb = jnp.asarray(np.random.RandomState(0).randn(vocab, dim), jnp.float32)
+        grad = jax.grad(loss)(emb)
+        sp = embedding_grad_to_sparse(grad, token_ids)
+        assert np.allclose(sp.to_dense(), grad, atol=1e-6)
+        assert sp.indices.shape[0] == 3  # unique ids {3, 9, 12}
+
+    def test_sparse_allgather_apply(self, mesh_dp8):
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from deepspeed_tpu.runtime.sparse_tensor import sparse_allgather_apply
+
+        vocab, dim = 16, 4
+        # per-shard: each dp rank contributes one row id + row grad
+        ids = jnp.arange(8, dtype=jnp.int32)  # rank r touches row r
+        vals = jnp.ones((8, dim), jnp.float32) * (1 + ids)[:, None]
+
+        def body(idx, v):
+            sp = SparseTensor(indices=idx, values=v, dense_shape=(vocab, dim))
+            return sparse_allgather_apply(sp, "dp")
+
+        out = shard_map(
+            body,
+            mesh=mesh_dp8,
+            in_specs=(P("dp"), P("dp")),
+            out_specs=P(),  # dense result replicated
+            check_rep=False,
+        )(ids, vals)
+        expect = np.zeros((vocab, dim), np.float32)
+        for r in range(8):
+            expect[r] += r + 1
+        assert np.allclose(out, expect)
