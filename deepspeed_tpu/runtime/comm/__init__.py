@@ -1,0 +1,3 @@
+from .compressed import compressed_allreduce, pack_signs, unpack_signs
+
+__all__ = ["compressed_allreduce", "pack_signs", "unpack_signs"]

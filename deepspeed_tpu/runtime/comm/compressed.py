@@ -1,0 +1,106 @@
+"""Error-feedback 1-bit compressed allreduce over a mesh axis.
+
+Analog of reference ``runtime/comm/nccl.py`` (NcclBackend.compressed_allreduce:51)
+and ``runtime/comm/mpi.py``: the 1-bit Adam/LAMB communication backend. The
+reference packs sign bits with cupy, alltoalls worker chunks, has each rank
+"serve" (sum + recompress) its chunk, then allgathers — with error-feedback
+buffers on both the worker and server sides so quantization error is carried
+into the next iteration instead of lost.
+
+The TPU-native formulation runs *inside the jitted train step* under
+``shard_map`` over the ``dp`` axis, built from ``lax.all_to_all`` +
+``lax.all_gather`` (XLA collectives on ICI), with sign bits packed 8-per-byte
+via ``jnp.packbits`` so the wire volume is 1/32 of fp32 (plus one scale per
+chunk) — the same ~31x gradient-volume reduction the reference claims.
+
+Layout contract (caller pads): ``x`` is the flat fp32 vector, length
+``world * chunk`` with ``chunk % 8 == 0``. Rank r serves chunk r.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pack_signs(signs: jnp.ndarray) -> jnp.ndarray:
+    """bool [..., n] → uint8 [..., n/8] (n % 8 == 0)."""
+    return jnp.packbits(signs, axis=-1)
+
+
+def unpack_signs(packed: jnp.ndarray, n: int) -> jnp.ndarray:
+    """uint8 [..., n/8] → bool [..., n]."""
+    return jnp.unpackbits(packed, axis=-1, count=n).astype(bool)
+
+
+def padded_length(n: int, world: int) -> int:
+    """Smallest length >= n that is divisible by world with chunk % 8 == 0."""
+    chunk = -(-n // world)  # ceil
+    chunk = ((chunk + 7) // 8) * 8
+    return chunk * world
+
+
+def _compress(x: jnp.ndarray, axis: int = -1) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """1-bit quantize along ``axis``: returns (signs>=0, scale, dequantized).
+
+    Scale = mean |x| per compressed slice — the L2-optimal magnitude for a
+    sign vector (argmin_s E[(x - s*sign(x))^2]).
+    """
+    scale = jnp.mean(jnp.abs(x), axis=axis, keepdims=True)
+    signs = x >= 0
+    deq = jnp.where(signs, scale, -scale)
+    return signs, scale, deq
+
+
+def compressed_allreduce(
+    x: jnp.ndarray,
+    worker_error: jnp.ndarray,
+    server_error: jnp.ndarray,
+    axis_name: str,
+    world: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Mean of ``x`` across ``axis_name`` using two-stage 1-bit compression.
+
+    Args:
+      x:            [n] flat local vector, n == world * chunk, chunk % 8 == 0.
+      worker_error: [n] error-feedback buffer for the worker-side compression.
+      server_error: [n // world] error feedback for this rank's served chunk.
+      axis_name:    mesh axis to reduce over (inside shard_map).
+      world:        static size of that axis.
+
+    Returns (avg, new_worker_error, new_server_error); ``avg`` approximates
+    ``pmean(x)`` with error carried forward, matching the reference's
+    compensated compression (nccl.py:51-160).
+    """
+    n = x.shape[0]
+    assert n % world == 0, (n, world)
+    chunk = n // world
+
+    # -- worker side: compensate, compress per destination chunk ----------
+    comp = x + worker_error
+    chunks = comp.reshape(world, chunk)
+    signs, scale, deq = _compress(chunks)
+    new_worker_error = (chunks - deq).reshape(n)
+
+    packed = pack_signs(signs)  # [world, chunk/8] uint8
+    # all_to_all: rank r receives every rank's r-th chunk (the chunk it serves)
+    recv_packed = lax.all_to_all(packed, axis_name, split_axis=0, concat_axis=0, tiled=False)
+    recv_scale = lax.all_to_all(scale, axis_name, split_axis=0, concat_axis=0, tiled=False)
+    recv_signs = unpack_signs(recv_packed, chunk)  # [world, chunk] bool
+    vals = jnp.where(recv_signs, recv_scale, -recv_scale)  # [world, chunk]
+
+    # -- server side: average my chunk, compensate, recompress ------------
+    chunk_avg = jnp.mean(vals.astype(jnp.float32), axis=0)  # [chunk]
+    server_comp = chunk_avg + server_error
+    s_signs, s_scale, s_deq = _compress(server_comp[None, :])
+    new_server_error = server_comp - s_deq[0]
+
+    # -- broadcast the served chunks back ---------------------------------
+    all_packed = lax.all_gather(pack_signs(s_signs[0]), axis_name, axis=0)  # [world, chunk/8]
+    all_scale = lax.all_gather(s_scale[0], axis_name, axis=0)  # [world, 1]
+    all_signs = unpack_signs(all_packed, chunk)  # [world, chunk]
+    avg = jnp.where(all_signs, all_scale, -all_scale).reshape(n).astype(jnp.float32)
+    return avg, new_worker_error, new_server_error

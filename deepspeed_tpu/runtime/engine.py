@@ -136,11 +136,33 @@ class DeepSpeedEngine:
                 fallback_lr=base_lr,
             )
         self.lr_schedule = lr_schedule
-        self.optimizer = build_optimizer(
-            opt_cfg.type if opt_cfg else "Adam",
-            opt_cfg.params if opt_cfg else {"lr": base_lr},
-            learning_rate=lr_schedule,
-        )
+        # 1-bit family needs explicit collectives (shard_map path below);
+        # everything else is a plain optax transform under pjit
+        opt_name = (opt_cfg.type if opt_cfg else "Adam").lower()
+        from .fp16.onebit import ONEBIT_OPTIMIZER_NAMES
+
+        self.onebit = opt_name in ONEBIT_OPTIMIZER_NAMES
+        if self.onebit:
+            if config.fp16.enabled:
+                raise ValueError(
+                    "1-bit optimizers do not support fp16 dynamic loss scaling "
+                    "(reference restriction); use bf16"
+                )
+            if zcfg.stage > 0:
+                raise ValueError(
+                    "1-bit optimizers require ZeRO stage 0 (reference: 1-bit "
+                    "Adam is incompatible with ZeRO) — their state is a "
+                    "replicated flat buffer"
+                )
+            if self.tp_world_size > 1 or self.sp_world_size > 1 or mesh_axis_size(mesh, "pp") > 1:
+                raise ValueError("1-bit optimizers support a dp-only mesh")
+            self.optimizer = self._build_onebit_optimizer(opt_name, opt_cfg, lr_schedule)
+        else:
+            self.optimizer = build_optimizer(
+                opt_cfg.type if opt_cfg else "Adam",
+                opt_cfg.params if opt_cfg else {"lr": base_lr},
+                learning_rate=lr_schedule,
+            )
 
         # --- params: born sharded (zero.Init analog)
         init_rng = jax.random.PRNGKey(seed)
@@ -182,11 +204,15 @@ class DeepSpeedEngine:
 
         # --- compiled steps
         donate = (0,) if config.tpu.donate_state else ()
-        self._train_step = jax.jit(
-            self._make_train_step(),
-            donate_argnums=donate,
-            out_shardings=(self.state_shardings, None),
-        )
+        if self.onebit:
+            self._onebit_step_cache: Dict[Tuple, Callable] = {}
+            self._train_step = self._onebit_dispatch
+        else:
+            self._train_step = jax.jit(
+                self._make_train_step(),
+                donate_argnums=donate,
+                out_shardings=(self.state_shardings, None),
+            )
         self._eval_step = jax.jit(self._make_eval_step())
         self._rng = jax.random.PRNGKey(seed + 1)
 
@@ -227,6 +253,151 @@ class DeepSpeedEngine:
             f"precision={'fp16' if self.fp16_enabled else ('bf16' if self.bf16_enabled else str(self.compute_dtype))} "
             f"batch=({self.train_batch_size_value}={self.micro_batch_size}x{self.gradient_accumulation_steps_value}x{self.dp_world_size})"
         )
+
+    # ------------------------------------------------------------------
+    # 1-bit optimizer path (explicit compressed collectives via shard_map)
+    # ------------------------------------------------------------------
+    def _build_onebit_optimizer(self, name: str, opt_cfg, lr_schedule):
+        from .fp16.onebit import OnebitAdam, OnebitLamb, ZeroOneAdam
+
+        p = dict(opt_cfg.params or {})
+        common = dict(
+            lr=lr_schedule,
+            betas=tuple(p.get("betas", (0.9, 0.999))),
+            weight_decay=float(p.get("weight_decay", 0.0)),
+            axis_name="dp",
+            world=self.dp_world_size,
+        )
+        if name == "onebitadam":
+            return OnebitAdam(
+                eps=float(p.get("eps", 1e-8)),
+                freeze_step=int(p.get("freeze_step", 100)), **common,
+            )
+        if name == "onebitlamb":
+            return OnebitLamb(
+                eps=float(p.get("eps", 1e-6)),
+                freeze_step=int(p.get("freeze_step", 100)),
+                min_trust=float(p.get("min_coeff", 0.01)),
+                max_trust=float(p.get("max_coeff", 10.0)), **common,
+            )
+        return ZeroOneAdam(
+            eps=float(p.get("eps", 1e-8)),
+            var_freeze_step=int(p.get("var_freeze_step", 100)),
+            var_update_scaler=int(p.get("var_update_scaler", 16)),
+            local_step_scaler=int(p.get("local_step_scaler", 1000)),
+            local_step_clipper=int(p.get("local_step_clipper", 16)), **common,
+        )
+
+    def _onebit_dispatch(self, state: "TrainState", batch: PyTree, rng):
+        """Host-side stage policy → static flags → cached jitted variant.
+
+        Static flags keep the collectives out of traced lax.cond branches:
+        a ZeroOneAdam local step compiles to a program with zero cross-chip
+        traffic (the point of local steps)."""
+        from .fp16.onebit import ZeroOneAdam
+
+        step = self.global_steps
+        if isinstance(self.optimizer, ZeroOneAdam):
+            flags = {
+                "sync": self.optimizer.sync_step(step),
+                "update_var": self.optimizer.variance_update_step(step),
+            }
+        else:
+            flags = {"compressed": step >= self.optimizer.freeze_step}
+        key = tuple(sorted(flags.items()))
+        fn = self._onebit_step_cache.get(key)
+        if fn is None:
+            fn = jax.jit(self._make_onebit_train_step(**flags))
+            self._onebit_step_cache[key] = fn
+        return fn(state, batch, rng)
+
+    def _make_onebit_train_step(self, **opt_flags):
+        from jax import shard_map
+
+        model = self.module
+        opt = self.optimizer
+        compute_dtype = self.compute_dtype
+        gas = self.gradient_accumulation_steps_value
+        mesh = self.mesh
+        world = self.dp_world_size
+
+        def per_rank(params, opt_state, batch, rng):
+            rank = jax.lax.axis_index("dp")
+
+            def scaled_loss(p, micro, mrng):
+                loss, metrics = model.loss_fn(_cast_params(p, compute_dtype), micro, mrng, True)
+                return loss.astype(jnp.float32), metrics
+
+            grad_fn = jax.value_and_grad(scaled_loss, has_aux=True)
+
+            def micro_step(carry, i):
+                grads_acc, loss_acc = carry
+                micro = jax.tree.map(lambda x: x[i], batch)
+                mrng = jax.random.fold_in(jax.random.fold_in(rng, i), rank)
+                (loss, _), grads = grad_fn(params, micro, mrng)
+                grads_acc = jax.tree.map(
+                    lambda a, g: a + g.astype(jnp.float32), grads_acc, grads
+                )
+                return (grads_acc, loss_acc + loss), None
+
+            zero_grads = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            (grads, loss_sum), _ = jax.lax.scan(
+                micro_step, (zero_grads, jnp.float32(0.0)), jnp.arange(gas)
+            )
+            grads = jax.tree.map(lambda g: g / gas, grads)  # LOCAL mean over gas
+
+            gnorm_local = global_norm(grads)
+            updates, new_opt_state = opt.update(grads, opt_state, params, **opt_flags)
+            new_params = optax.apply_updates(params, updates)
+            loss_mean = jax.lax.pmean(loss_sum / gas, "dp")
+            gnorm = jax.lax.pmean(gnorm_local, "dp")
+            return new_params, new_opt_state, loss_mean, gnorm
+
+        replicated_spec = PartitionSpec()
+        batch_specs = None  # filled per call via tree mapping
+
+        def train_step(state: TrainState, batch: PyTree, rng):
+            in_batch_specs = jax.tree.map(
+                lambda x: PartitionSpec(None, "dp", *([None] * (x.ndim - 2))), batch
+            )
+            mapped = shard_map(
+                per_rank,
+                mesh=mesh,
+                in_specs=(
+                    jax.tree.map(lambda _: replicated_spec, state.params),
+                    jax.tree.map(lambda _: replicated_spec, state.opt_state),
+                    in_batch_specs,
+                    replicated_spec,
+                ),
+                out_specs=(
+                    jax.tree.map(lambda _: replicated_spec, state.params),
+                    jax.tree.map(lambda _: replicated_spec, state.opt_state),
+                    replicated_spec,
+                    replicated_spec,
+                ),
+                check_vma=False,
+            )
+            new_params, new_opt_state, loss, gnorm = mapped(
+                state.params, state.opt_state, batch, rng
+            )
+            new_state = TrainState(
+                params=new_params,
+                opt_state=new_opt_state,
+                loss_scale=state.loss_scale,
+                global_step=state.global_step + 1,
+                skipped_steps=state.skipped_steps,
+            )
+            metrics = {
+                "loss": loss,
+                "grad_norm": gnorm,
+                "loss_scale": jnp.float32(1.0),
+                "overflow": jnp.bool_(False),
+                "lr": jnp.asarray(self.lr_schedule(state.global_step), jnp.float32),
+                "global_step": new_state.global_step,
+            }
+            return new_state, metrics
+
+        return train_step
 
     # ------------------------------------------------------------------
     # step construction

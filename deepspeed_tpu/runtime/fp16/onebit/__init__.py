@@ -1,0 +1,7 @@
+from .adam import OnebitAdam
+from .lamb import OnebitLamb
+from .zoadam import ZeroOneAdam
+
+ONEBIT_OPTIMIZER_NAMES = ("onebitadam", "onebitlamb", "zerooneadam")
+
+__all__ = ["OnebitAdam", "OnebitLamb", "ZeroOneAdam", "ONEBIT_OPTIMIZER_NAMES"]

@@ -1,0 +1,133 @@
+"""1-bit Adam — compressed-momentum Adam with a warmup stage.
+
+Analog of reference ``runtime/fp16/onebit/adam.py`` (OnebitAdam:10, 315 LoC):
+- **warmup stage** (step < freeze_step): vanilla Adam with full-precision
+  gradient averaging; the variance estimate stabilises.
+- **compressed stage**: the variance is FROZEN; each rank updates momentum
+  from its LOCAL gradient and the momenta are averaged with the 1-bit
+  error-feedback allreduce (``runtime/comm/compressed.py``). Averaging the
+  momentum is exact in expectation because m is identical across ranks before
+  the update: mean_r(b1*m + (1-b1)*g_r) = b1*m + (1-b1)*mean_r(g_r).
+
+TPU-native integration: ``update()`` runs inside ``shard_map`` over the dp
+axis; the stage switch is a *static* python bool decided host-side by the
+engine (two compiled programs), so neither branch's collectives are traced
+behind a ``lax.cond``. State is kept flat (one [n] vector per moment) so the
+whole tree ships as ONE compressed collective, like the reference's fused
+flat buffer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.flatten_util import ravel_pytree
+
+from ...comm.compressed import compressed_allreduce, padded_length
+
+PyTree = Any
+Schedule = Union[float, Callable]
+
+
+class OnebitAdamState(NamedTuple):
+    step: jnp.ndarray  # i32
+    m: jnp.ndarray  # [n_pad] f32 momentum (flat)
+    v: jnp.ndarray  # [n_pad] f32 variance (flat, frozen after warmup)
+    worker_error: jnp.ndarray  # [n_pad] f32
+    server_error: jnp.ndarray  # [n_pad / world] f32
+
+
+def _schedule_lr(lr: Schedule, step) -> jnp.ndarray:
+    return jnp.asarray(lr(step) if callable(lr) else lr, jnp.float32)
+
+
+class OnebitAdam:
+    """Flat-state 1-bit Adam. Not an optax transform: ``update`` requires the
+    dp axis context (call inside shard_map) and a static ``compressed`` flag.
+    """
+
+    def __init__(
+        self,
+        lr: Schedule = 1e-3,
+        betas=(0.9, 0.999),
+        eps: float = 1e-8,
+        weight_decay: float = 0.0,
+        freeze_step: int = 100,
+        axis_name: str = "dp",
+        world: int = 1,
+    ):
+        self.lr = lr
+        self.b1, self.b2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.freeze_step = freeze_step
+        self.axis_name = axis_name
+        self.world = world
+        self._unravel = None
+        self._n = None
+
+    def _flatten(self, tree: PyTree) -> jnp.ndarray:
+        flat, unravel = ravel_pytree(tree)
+        if self._unravel is None:
+            self._unravel = unravel
+            self._n = flat.shape[0]
+        pad = padded_length(flat.shape[0], self.world) - flat.shape[0]
+        return jnp.pad(flat.astype(jnp.float32), (0, pad))
+
+    def init(self, params: PyTree) -> OnebitAdamState:
+        flat = self._flatten(params)
+        n = flat.shape[0]
+        z = jnp.zeros(n, jnp.float32)
+        return OnebitAdamState(
+            step=jnp.int32(0),
+            m=z,
+            v=z,
+            worker_error=z,
+            server_error=jnp.zeros(n // self.world, jnp.float32),
+        )
+
+    def update(
+        self,
+        grads: PyTree,
+        state: OnebitAdamState,
+        params: PyTree,
+        compressed: bool,
+    ):
+        """grads are LOCAL (unreduced) when ``compressed``; the collective
+        happens inside. Returns (updates_tree, new_state)."""
+        g = self._flatten(grads)
+        step = state.step + 1
+        t = step.astype(jnp.float32)
+
+        if not compressed:
+            g = lax.pmean(g, self.axis_name)
+            m = self.b1 * state.m + (1.0 - self.b1) * g
+            v = self.b2 * state.v + (1.0 - self.b2) * g * g
+            we, se = state.worker_error, state.server_error
+        else:
+            m_local = self.b1 * state.m + (1.0 - self.b1) * g
+            m, we, se = compressed_allreduce(
+                m_local, state.worker_error, state.server_error,
+                self.axis_name, self.world,
+            )
+            v = state.v  # frozen (reference freezes exp_avg_sq after freeze_step)
+
+        bc1 = 1.0 - self.b1 ** t
+        # variance bias correction freezes with v (reference behaviour)
+        t_v = jnp.minimum(t, jnp.float32(self.freeze_step)) if compressed else t
+        bc2 = 1.0 - self.b2 ** t_v
+        lr_t = _schedule_lr(self.lr, state.step)
+        upd_flat = -lr_t * (m / bc1) / (jnp.sqrt(v / bc2) + self.eps)
+
+        updates = self._unravel(upd_flat[: self._n])
+        if self.weight_decay:
+            wd = self.weight_decay
+            updates = jax.tree.map(
+                lambda u, p: u - lr_t * wd * p if p.ndim >= 2 else u, updates, params
+            )
+        updates = jax.tree.map(lambda u, p: u.astype(p.dtype), updates, params)
+        new_state = OnebitAdamState(step=step, m=m, v=v, worker_error=we, server_error=se)
+        return updates, new_state

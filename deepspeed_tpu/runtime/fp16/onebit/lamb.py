@@ -1,0 +1,119 @@
+"""1-bit LAMB — compressed-momentum LAMB with per-tensor trust ratios.
+
+Analog of reference ``runtime/fp16/onebit/lamb.py`` (OnebitLamb:11, 469 LoC):
+warmup stage = full LAMB with full-precision allreduce; compressed stage =
+momentum averaged via the 1-bit error-feedback collective, variance frozen.
+
+Deviation (documented): the reference approximates the compressed-stage trust
+ratio with per-layer scaling factors frozen from warmup statistics, because
+recomputing norms on GPU costs extra kernels + an allreduce. Here the
+per-tensor ``w_norm / u_norm`` ratio is recomputed live each step — params
+and the averaged update are replicated over dp after the collective, so the
+norms are rank-local math that XLA fuses into the update; no extra
+communication is needed, and the live ratio is strictly closer to true LAMB.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.flatten_util import ravel_pytree
+
+from ...comm.compressed import compressed_allreduce, padded_length
+
+PyTree = Any
+Schedule = Union[float, Callable]
+
+
+class OnebitLambState(NamedTuple):
+    step: jnp.ndarray
+    m: jnp.ndarray  # [n_pad] flat momentum
+    v: jnp.ndarray  # [n_pad] flat variance (frozen in compressed stage)
+    worker_error: jnp.ndarray
+    server_error: jnp.ndarray
+
+
+class OnebitLamb:
+    def __init__(
+        self,
+        lr: Schedule = 1e-3,
+        betas=(0.9, 0.999),
+        eps: float = 1e-6,
+        weight_decay: float = 0.0,
+        freeze_step: int = 100,
+        min_trust: float = 0.01,
+        max_trust: float = 10.0,
+        axis_name: str = "dp",
+        world: int = 1,
+    ):
+        self.lr = lr
+        self.b1, self.b2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.freeze_step = freeze_step
+        self.min_trust = min_trust
+        self.max_trust = max_trust
+        self.axis_name = axis_name
+        self.world = world
+        self._unravel = None
+        self._n = None
+
+    def _flatten(self, tree: PyTree) -> jnp.ndarray:
+        flat, unravel = ravel_pytree(tree)
+        if self._unravel is None:
+            self._unravel = unravel
+            self._n = flat.shape[0]
+        pad = padded_length(flat.shape[0], self.world) - flat.shape[0]
+        return jnp.pad(flat.astype(jnp.float32), (0, pad))
+
+    def init(self, params: PyTree) -> OnebitLambState:
+        flat = self._flatten(params)
+        n = flat.shape[0]
+        z = jnp.zeros(n, jnp.float32)
+        return OnebitLambState(
+            step=jnp.int32(0), m=z, v=z, worker_error=z,
+            server_error=jnp.zeros(n // self.world, jnp.float32),
+        )
+
+    def update(self, grads: PyTree, state: OnebitLambState, params: PyTree, compressed: bool):
+        g = self._flatten(grads)
+        step = state.step + 1
+        t = step.astype(jnp.float32)
+
+        if not compressed:
+            g = lax.pmean(g, self.axis_name)
+            m = self.b1 * state.m + (1.0 - self.b1) * g
+            v = self.b2 * state.v + (1.0 - self.b2) * g * g
+            we, se = state.worker_error, state.server_error
+        else:
+            m_local = self.b1 * state.m + (1.0 - self.b1) * g
+            m, we, se = compressed_allreduce(
+                m_local, state.worker_error, state.server_error,
+                self.axis_name, self.world,
+            )
+            v = state.v
+
+        bc1 = 1.0 - self.b1 ** t
+        t_v = jnp.minimum(t, jnp.float32(self.freeze_step)) if compressed else t
+        bc2 = 1.0 - self.b2 ** t_v
+        raw_flat = (m / bc1) / (jnp.sqrt(v / bc2) + self.eps)
+        raw = self._unravel(raw_flat[: self._n])
+        lr_t = jnp.asarray(self.lr(state.step) if callable(self.lr) else self.lr, jnp.float32)
+
+        def per_tensor(u, p):
+            if self.weight_decay and p.ndim >= 2:
+                u = u + self.weight_decay * p.astype(jnp.float32)
+            w_norm = jnp.linalg.norm(p.astype(jnp.float32))
+            u_norm = jnp.linalg.norm(u)
+            trust = jnp.where(
+                (w_norm > 0) & (u_norm > 0),
+                jnp.clip(w_norm / u_norm, self.min_trust, self.max_trust),
+                1.0,
+            )
+            return (-lr_t * trust * u).astype(p.dtype)
+
+        updates = jax.tree.map(per_tensor, raw, params)
+        return updates, OnebitLambState(step=step, m=m, v=v, worker_error=we, server_error=se)

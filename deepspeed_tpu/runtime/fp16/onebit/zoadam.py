@@ -1,0 +1,148 @@
+"""0/1 Adam — variance freezing + local steps (intermittent sync).
+
+Analog of reference ``runtime/fp16/onebit/zoadam.py`` (ZeroOneAdam:10,
+376 LoC), after the 0/1 Adam paper: on top of 1-bit compression,
+(a) the variance is updated only at exponentially spaced steps until
+``var_freeze_step`` then frozen, and (b) momentum synchronisation happens
+only at interval boundaries ("local steps"), with the interval doubling up
+to ``local_step_clipper``. Between syncs each rank steps on purely local
+momentum; at a boundary the momenta are averaged with the compressed
+error-feedback collective.
+
+TPU-native integration: the *policies* (sync this step? update variance this
+step?) are deterministic functions of the step count, so the engine computes
+them host-side and passes static bools — each of the 4 variants compiles
+once. This keeps collectives out of traced branches entirely: a no-sync step
+compiles to a program with ZERO cross-chip traffic, which is the whole point
+of local steps.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.flatten_util import ravel_pytree
+
+from ...comm.compressed import compressed_allreduce, padded_length
+
+PyTree = Any
+Schedule = Union[float, Callable]
+
+
+class ZeroOneAdamState(NamedTuple):
+    step: jnp.ndarray
+    m: jnp.ndarray  # [n_pad] flat momentum (may be rank-local between syncs)
+    v: jnp.ndarray  # [n_pad] flat variance
+    worker_error: jnp.ndarray
+    server_error: jnp.ndarray
+
+
+class ZeroOneAdam:
+    def __init__(
+        self,
+        lr: Schedule = 1e-3,
+        betas=(0.9, 0.999),
+        eps: float = 1e-8,
+        weight_decay: float = 0.0,
+        var_freeze_step: int = 100,
+        var_update_scaler: int = 16,
+        local_step_scaler: int = 1000,
+        local_step_clipper: int = 16,
+        axis_name: str = "dp",
+        world: int = 1,
+    ):
+        self.lr = lr
+        self.b1, self.b2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.var_freeze_step = var_freeze_step
+        self.var_update_scaler = var_update_scaler
+        self.local_step_scaler = local_step_scaler
+        self.local_step_clipper = local_step_clipper
+        self.axis_name = axis_name
+        self.world = world
+        self._unravel = None
+        self._n = None
+
+    # -- host-side step policies (engine queries these per step) ----------
+    def variance_update_step(self, step: int) -> bool:
+        """Variance updates at exponentially spaced steps until the freeze
+        (reference zoadam exp_avg_sq update policy)."""
+        if step >= self.var_freeze_step:
+            return False
+        # update at steps k * var_update_scaler * 2^j boundaries
+        interval, boundary = self.var_update_scaler, 0
+        while boundary + interval <= step:
+            boundary += interval
+            interval *= 2
+        return step == boundary
+
+    def sync_step(self, step: int) -> bool:
+        """Momentum syncs at doubling intervals after the variance freeze;
+        before the freeze every step syncs (warmup behaviour)."""
+        if step < self.var_freeze_step:
+            return True
+        k = (step - self.var_freeze_step) // max(1, self.local_step_scaler)
+        interval = min(2 ** min(k, 30), 2 ** self.local_step_clipper)
+        return (step - self.var_freeze_step) % interval == 0
+
+    # -- state -------------------------------------------------------------
+    def _flatten(self, tree: PyTree) -> jnp.ndarray:
+        flat, unravel = ravel_pytree(tree)
+        if self._unravel is None:
+            self._unravel = unravel
+            self._n = flat.shape[0]
+        pad = padded_length(flat.shape[0], self.world) - flat.shape[0]
+        return jnp.pad(flat.astype(jnp.float32), (0, pad))
+
+    def init(self, params: PyTree) -> ZeroOneAdamState:
+        flat = self._flatten(params)
+        n = flat.shape[0]
+        z = jnp.zeros(n, jnp.float32)
+        return ZeroOneAdamState(
+            step=jnp.int32(0), m=z, v=z, worker_error=z,
+            server_error=jnp.zeros(n // self.world, jnp.float32),
+        )
+
+    def update(
+        self,
+        grads: PyTree,
+        state: ZeroOneAdamState,
+        params: PyTree,
+        sync: bool,
+        update_var: bool,
+    ):
+        g = self._flatten(grads)
+        step = state.step + 1
+        t = step.astype(jnp.float32)
+
+        m_local = self.b1 * state.m + (1.0 - self.b1) * g
+        we, se = state.worker_error, state.server_error
+        if sync:
+            m, we, se = compressed_allreduce(
+                m_local, we, se, self.axis_name, self.world
+            )
+        else:
+            m = m_local  # local step: rank-local momentum, zero comm
+
+        if update_var:
+            g_avg = lax.pmean(g, self.axis_name)
+            v = self.b2 * state.v + (1.0 - self.b2) * g_avg * g_avg
+        else:
+            v = state.v
+
+        bc1 = 1.0 - self.b1 ** t
+        bc2 = 1.0 - self.b2 ** jnp.minimum(t, jnp.float32(self.var_freeze_step))
+        lr_t = jnp.asarray(self.lr(state.step) if callable(self.lr) else self.lr, jnp.float32)
+        upd_flat = -lr_t * (m / bc1) / (jnp.sqrt(v / bc2) + self.eps)
+        updates = self._unravel(upd_flat[: self._n])
+        if self.weight_decay:
+            updates = jax.tree.map(
+                lambda u, p: u - lr_t * self.weight_decay * p if p.ndim >= 2 else u,
+                updates, params,
+            )
+        updates = jax.tree.map(lambda u, p: u.astype(p.dtype), updates, params)
+        return updates, ZeroOneAdamState(step=step, m=m, v=v, worker_error=we, server_error=se)
