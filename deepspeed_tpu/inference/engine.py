@@ -1,15 +1,23 @@
 """Inference engine — ``deepspeed_tpu.init_inference`` backend.
 
-Analog of reference ``deepspeed/inference/engine.py`` (InferenceEngine:28):
-wraps a model for serving — dtype conversion, tensor-parallel sharding over a
-mesh, compiled forward. Where the reference injects fused CUDA kernels
-(module_inject/replace_module.py) and captures CUDA graphs, the TPU version
-jit-compiles the forward with TP shardings (XLA performs the fusion and the
-"graph capture" is the compiled executable itself).
+Analog of reference ``deepspeed/inference/engine.py`` (InferenceEngine:28,
+549 LoC): wraps a model for serving — dtype conversion, tensor-parallel
+sharding over a mesh, kernel injection, compiled forward with KV cache.
+Reference mechanism → TPU mechanism:
 
-Current scope: compiled sharded forward + greedy/temperature generation by
-full-prefix recompute. The KV-cache incremental decode path (reference
-``softmax_context`` kernels) lands with the Pallas decode-attention kernel.
+- ``_apply_injection_policy`` (engine.py:330) + fused CUDA modules
+  (transformer_inference.py) → ``module_inject.replace_transformer_layer``
+  converts the HF torch model ONCE into a stacked JAX pytree; the fused
+  kernel is the jitted decode function.
+- ``_create_model_parallel_group`` (engine.py:179) + ReplaceWithTensorSlicing
+  → a tp mesh axis and NamedSharding device_put of the converted params.
+- CUDA-graph capture/replay (engine.py:486) → the compiled XLA executable of
+  prefill + lax.scan decode (models/gpt2.generate).
+- ``_convert_to_dtype`` / GroupQuantizer int8 (engine.py:464) → bf16 cast or
+  ``ops.quantizer.quantize_tree`` (weight-only int8, 4x HBM savings).
+
+Accepts either a :class:`ModuleSpec` (JAX model) or an HF torch model (with
+``replace_with_kernel_inject=True``, matching the reference call style).
 """
 
 from __future__ import annotations
@@ -29,42 +37,92 @@ from ..utils.logging import log_dist
 PyTree = Any
 
 
+def _is_torch_module(model) -> bool:
+    mod = type(model).__module__
+    return mod.startswith("transformers") or hasattr(model, "state_dict")
+
+
 class InferenceEngine:
     def __init__(
         self,
-        model: Optional[ModuleSpec] = None,
+        model: Any = None,
         params: Optional[PyTree] = None,
         mp_size: int = 1,
         dtype=jnp.bfloat16,
         mesh: Optional[Mesh] = None,
         replace_with_kernel_inject: bool = False,
+        injection_policy: Optional[type] = None,
+        quantize_bits: int = 0,
+        quantize_groups: int = 64,
+        max_tokens: int = 1024,
         seed: int = 0,
         **kwargs,
     ):
-        assert model is not None and model.apply_fn is not None, (
-            "init_inference requires a ModuleSpec with apply_fn"
-        )
-        self.module = model
         self.dtype = dtype
+        self.max_tokens = max_tokens
         if mesh is None:
             mesh = MeshSpec(dp=1, tp=mp_size, devices=jax.devices()[: max(1, mp_size)]).build_mesh()
         self.mesh = mesh
-        # TP-only sharding (stage 0 → no dp sharding of weights)
-        self.policy = ZeroShardingPolicy(mesh, stage=0)
+        self.policy = ZeroShardingPolicy(mesh, stage=0)  # TP-only weight sharding
+        self.model_config = None
+        self._generate_cache: Dict = {}
 
-        init_rng = jax.random.PRNGKey(seed)
-        abstract = jax.eval_shape(model.init, init_rng)
-        self.param_shardings = self.policy.param_shardings(abstract, model.logical_axes)
-        if params is None:
-            params = jax.jit(model.init, out_shardings=self.param_shardings)(init_rng)
+        if model is not None and not isinstance(model, ModuleSpec) and _is_torch_module(model):
+            # reference path: init_inference(hf_model, replace_with_kernel_inject=True)
+            from ..module_inject import replace_transformer_layer
+            from ..models import gpt2 as gpt2_mod
+
+            kind, mcfg, params = replace_transformer_layer(
+                model,
+                policy=injection_policy,
+                dtype=dtype,
+                quantize_bits=quantize_bits,
+                quantize_groups=quantize_groups,
+            )
+            assert kind == "gpt2", f"unsupported injected model kind {kind}"
+            self.model_config = mcfg
+            model = gpt2_mod.make_module(mcfg)
+            self.quantized = quantize_bits == 8
         else:
-            params = jax.tree.map(jax.device_put, params, self.param_shardings)
-        # dtype conversion (reference _convert_to_dtype, engine.py:464)
-        self.params = jax.tree.map(
-            lambda p: p.astype(dtype) if jnp.issubdtype(p.dtype, jnp.floating) else p, params
+            assert model is not None and model.apply_fn is not None, (
+                "init_inference requires a ModuleSpec with apply_fn or an HF torch model"
+            )
+            self.quantized = False
+            self.model_config = (model.extra or {}).get("config")
+
+        self.module = model
+
+        # --- params: shard over tp, convert dtype (reference engine.py:464)
+        init_rng = jax.random.PRNGKey(seed)
+        if params is None:
+            abstract = jax.eval_shape(model.init, init_rng)
+            shardings = self.policy.param_shardings(abstract, model.logical_axes)
+            params = jax.jit(model.init, out_shardings=shardings)(init_rng)
+            self.param_shardings = shardings
+        else:
+            abstract = jax.eval_shape(lambda: params)
+            try:
+                self.param_shardings = self.policy.param_shardings(abstract, model.logical_axes)
+                params = jax.tree.map(jax.device_put, params, self.param_shardings)
+            except Exception:
+                # quantized trees / trees whose structure diverges from
+                # logical_axes fall back to replicated placement
+                rep = NamedSharding(mesh, PartitionSpec())
+                self.param_shardings = jax.tree.map(lambda _: rep, params)
+                params = jax.tree.map(lambda x: jax.device_put(x, rep), params)
+        if not self.quantized:
+            params = jax.tree.map(
+                lambda p: p.astype(dtype)
+                if hasattr(p, "dtype") and jnp.issubdtype(p.dtype, jnp.floating)
+                else p,
+                params,
+            )
+        self.params = params
+        self._forward = jax.jit(model.apply_fn) if model.apply_fn is not None else None
+        log_dist(
+            f"InferenceEngine: mesh={dict(mesh.shape)} "
+            f"dtype={getattr(dtype, '__name__', dtype)} quantized={self.quantized}"
         )
-        self._forward = jax.jit(model.apply_fn)
-        log_dist(f"InferenceEngine: mesh={dict(mesh.shape)} dtype={dtype.__name__ if hasattr(dtype,'__name__') else dtype}")
 
     def forward(self, batch: PyTree):
         """Compiled forward (reference engine.forward:515)."""
@@ -79,9 +137,37 @@ class InferenceEngine:
         temperature: float = 0.0,
         seed: int = 0,
     ) -> np.ndarray:
-        """Autoregressive generation (full-prefix recompute path)."""
+        """Autoregressive generation.
+
+        KV-cache incremental decode when the model is a gpt2-family config
+        (prefill + compiled lax.scan single-token steps); full-prefix
+        recompute fallback otherwise. Returns prompt + new tokens."""
         ids = jnp.asarray(input_ids)
         rng = jax.random.PRNGKey(seed)
+        from ..models.gpt2 import GPT2Config
+
+        if isinstance(self.model_config, GPT2Config):
+            from ..models import gpt2 as gpt2_mod
+
+            key = (ids.shape, max_new_tokens, float(temperature))
+            gen = self._generate_cache.get(key)
+            if gen is None:
+                cfg = self.model_config
+                cache_dtype = self.dtype
+
+                def gen_fn(params, ids, rng):
+                    return gpt2_mod.generate(
+                        cfg, params, ids, max_new_tokens,
+                        temperature=temperature, rng=rng, cache_dtype=cache_dtype,
+                    )
+
+                gen = jax.jit(gen_fn)
+                self._generate_cache[key] = gen
+            new = gen(self.params, ids, rng)
+            out = jnp.concatenate([ids, new.astype(ids.dtype)], axis=1)
+            return np.asarray(jax.device_get(out))
+
+        # fallback: full-prefix recompute each token
         for _ in range(max_new_tokens):
             logits = self._forward(self.params, {"input_ids": ids})
             last = logits[:, -1, :].astype(jnp.float32)

@@ -23,19 +23,21 @@ written for XLA:
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec
 
+from ..ops.quantizer import maybe_dequantize as _deq
 from ..runtime.module import ModuleSpec
 
 PyTree = Any
 
 
-@dataclass
+@dataclass(frozen=True)
 class GPT2Config:
     vocab_size: int = 50257
     n_positions: int = 1024
@@ -199,7 +201,7 @@ def _dropout(x, rate: float, rng, train: bool):
 def _attention(cfg: GPT2Config, lp, h, train: bool, rng=None):
     B, S, E = h.shape
     H, D = cfg.n_head, cfg.head_dim
-    qkv = h @ lp["c_attn_w"] + lp["c_attn_b"]  # [B,S,3E]
+    qkv = h @ _deq(lp["c_attn_w"], h.dtype) + lp["c_attn_b"]  # [B,S,3E]
     q, k_, v = jnp.split(qkv, 3, axis=-1)
 
     def heads(x):
@@ -217,7 +219,7 @@ def _attention(cfg: GPT2Config, lp, h, train: bool, rng=None):
 
         o = causal_attention(q, k_, v, impl=cfg.attn_impl)  # [B,S,H,D]
     o = o.reshape(B, S, E)
-    out = o @ lp["c_proj_w"] + lp["c_proj_b"]
+    out = o @ _deq(lp["c_proj_w"], o.dtype) + lp["c_proj_b"]
     return out
 
 
@@ -237,9 +239,9 @@ def _mlp(cfg: GPT2Config, lp, h, train: bool, rng=None):
             ),
         )
         return moe_mlp(lp, h, mcfg, rng=rng, train=train)
-    x = h @ lp["c_fc_w"] + lp["c_fc_b"]
+    x = h @ _deq(lp["c_fc_w"], h.dtype) + lp["c_fc_b"]
     x = jax.nn.gelu(x, approximate=True)
-    return x @ lp["c_proj_w"] + lp["c_proj_b"], jnp.float32(0.0)
+    return x @ _deq(lp["c_proj_w"], x.dtype) + lp["c_proj_b"], jnp.float32(0.0)
 
 
 def _block(cfg: GPT2Config, layer_params, h, train: bool, rng=None):
@@ -385,6 +387,134 @@ def pipeline_lm_loss(cfg: GPT2Config, params: PyTree, batch_micro, rng, train: b
 
     total = lax.fori_loop(0, M, per_micro, jnp.float32(0.0))
     return total / M, {}
+
+
+# ---------------------------------------------------------------------------
+# incremental decode with KV cache (reference transformer_inference
+# softmax_context path: ops/transformer/inference/transformer_inference.py:231,
+# csrc/transformer/inference attention kernels with layer_past)
+# ---------------------------------------------------------------------------
+
+class KVCache(NamedTuple):
+    """Per-layer stacked KV cache. ``pos`` is the filled length (i32)."""
+
+    k: jnp.ndarray  # [L, B, Smax, H, D]
+    v: jnp.ndarray  # [L, B, Smax, H, D]
+    pos: jnp.ndarray  # i32
+
+
+def init_cache(cfg: GPT2Config, batch_size: int, max_len: int, dtype=jnp.bfloat16) -> KVCache:
+    shape = (cfg.n_layer, batch_size, max_len, cfg.n_head, cfg.head_dim)
+    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype), pos=jnp.int32(0))
+
+
+def cache_logical_axes() -> KVCache:
+    """Shard the cache over heads (tp) like attention activations."""
+    return KVCache(k=(None, None, None, "heads", None), v=(None, None, None, "heads", None), pos=None)
+
+
+def _attention_cached(cfg: GPT2Config, lp, h, k_cache, v_cache, pos):
+    """Attention for h [B,S,E] against a KV cache.
+
+    Writes this chunk's K/V at [pos, pos+S), attends causally to everything
+    ≤ its absolute position. S=prompt length at prefill, 1 at decode."""
+    B, S, E = h.shape
+    H, D = cfg.n_head, cfg.head_dim
+    qkv = h @ _deq(lp["c_attn_w"], h.dtype) + lp["c_attn_b"]
+    q, k_, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, S, H, D)
+    k_ = k_.reshape(B, S, H, D).astype(k_cache.dtype)
+    v = v.reshape(B, S, H, D).astype(v_cache.dtype)
+
+    k_cache = lax.dynamic_update_slice(k_cache, k_, (0, pos, 0, 0))
+    v_cache = lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+
+    Smax = k_cache.shape[1]
+    scale = 1.0 / np.sqrt(D)
+    scores = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32), k_cache.astype(jnp.float32)) * scale
+    # query i sits at absolute position pos+i; may see keys j <= pos+i
+    j_idx = jnp.arange(Smax)
+    i_idx = pos + jnp.arange(S)
+    mask = j_idx[None, :] <= i_idx[:, None]  # [S, Smax]
+    scores = jnp.where(mask[None, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
+    o = jnp.einsum("bhst,bthd->bshd", probs, v_cache)
+    o = o.reshape(B, S, E).astype(h.dtype)
+    return o @ _deq(lp["c_proj_w"], h.dtype) + lp["c_proj_b"], k_cache, v_cache
+
+
+def forward_cached(
+    cfg: GPT2Config, params: PyTree, input_ids: jnp.ndarray, cache: KVCache
+) -> Tuple[jnp.ndarray, KVCache]:
+    """input_ids [B,S] (S tokens starting at cache.pos) → (last-token logits
+    [B,V], updated cache). One function serves prefill (S=prompt) and decode
+    (S=1) — the reference splits these across qkv_gemm/softmax_context kernels.
+    """
+    B, S = input_ids.shape
+    pos = cache.pos
+    eps = cfg.layer_norm_epsilon
+    positions = pos + jnp.arange(S)
+    h = params["wte"][input_ids] + params["wpe"][positions][None, :, :]
+
+    def body(carry, xs):
+        h = carry
+        lp, k_c, v_c = xs
+        a, k_c, v_c = _attention_cached(
+            cfg, lp["attn"], _layer_norm(h, lp["ln_1"]["scale"], lp["ln_1"]["bias"], eps), k_c, v_c, pos
+        )
+        h = h + a
+        m, _aux = _mlp(cfg, lp["mlp"], _layer_norm(h, lp["ln_2"]["scale"], lp["ln_2"]["bias"], eps), False, None)
+        return h + m, (k_c, v_c)
+
+    h, (new_k, new_v) = lax.scan(body, h, (params["blocks"], cache.k, cache.v))
+    h = _layer_norm(h[:, -1], params["ln_f"]["scale"], params["ln_f"]["bias"], eps)
+    logits = h @ params["wte"].T  # [B, V]
+    return logits, KVCache(k=new_k, v=new_v, pos=pos + S)
+
+
+def generate(
+    cfg: GPT2Config,
+    params: PyTree,
+    input_ids: jnp.ndarray,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    rng=None,
+    max_len: Optional[int] = None,
+    cache_dtype=jnp.bfloat16,
+) -> jnp.ndarray:
+    """Fully jitted autoregressive generation: prefill once, then a
+    ``lax.scan`` of single-token decode steps over the KV cache (the
+    compiled-executable analog of the reference's CUDA-graph decode replay,
+    inference/engine.py:486). Returns [B, max_new_tokens]."""
+    B, S = input_ids.shape
+    max_len = max_len or min(cfg.n_positions, S + max_new_tokens)
+    assert max_len >= S + max_new_tokens, (max_len, S, max_new_tokens)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    cache = init_cache(cfg, B, max_len, dtype=cache_dtype)
+    logits, cache = forward_cached(cfg, params, input_ids, cache)
+
+    def sample(logits, key):
+        if temperature and temperature > 0.0:
+            return jax.random.categorical(key, logits.astype(jnp.float32) / temperature, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    first = sample(logits, rng)
+
+    def step(carry, key):
+        token, cache = carry
+        logits, cache = forward_cached(cfg, params, token[:, None].astype(input_ids.dtype), cache)
+        nxt = sample(logits, key)
+        return (nxt, cache), token
+
+    if max_new_tokens == 1:
+        return first[:, None]
+    # each step consumes token t_i, emits it, and produces t_{i+1};
+    # N-1 steps yield [t_1..t_{N-1}] with t_N left in the carry
+    keys = jax.random.split(jax.random.fold_in(rng, 1), max_new_tokens - 1)
+    (last, _), tokens = lax.scan(step, (first, cache), keys)
+    return jnp.concatenate([jnp.moveaxis(tokens, 0, 1), last[:, None]], axis=1)
 
 
 def make_module(cfg: GPT2Config) -> ModuleSpec:

@@ -1,0 +1,15 @@
+from .replace_module import replace_transformer_layer
+from .replace_policy import (
+    DSPolicy,
+    HFGPT2LayerPolicy,
+    POLICY_REGISTRY,
+    match_policy,
+)
+
+__all__ = [
+    "DSPolicy",
+    "HFGPT2LayerPolicy",
+    "POLICY_REGISTRY",
+    "match_policy",
+    "replace_transformer_layer",
+]

@@ -1,0 +1,68 @@
+"""Model surgery: swap an HF torch model for the TPU-native decode graph.
+
+Analog of reference ``deepspeed/module_inject/replace_module.py``
+(replace_transformer_layer:190, generic walker replace_module:1069,
+ReplaceWithTensorSlicing:18, GroupQuantizer:139, 1124 LoC). The reference
+walks the torch module tree swapping layers for fused-kernel modules and
+hand-slices weights per TP rank. Here the whole model converts ONCE through a
+policy into a stacked JAX pytree; "tensor slicing" is a NamedSharding
+device_put chosen by the model's logical axes (XLA materialises each rank's
+slice), and the fused module is the jitted decode function.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import log_dist
+from .replace_policy import match_policy
+
+PyTree = Any
+
+
+def replace_transformer_layer(
+    hf_model,
+    policy: Optional[type] = None,
+    dtype=jnp.bfloat16,
+    quantize_bits: int = 0,
+    quantize_groups: int = 64,
+) -> Tuple[str, Any, PyTree]:
+    """Convert an HF torch model via its injection policy.
+
+    Returns (model_kind, model_config, params). ``quantize_bits=8`` stores the
+    large matmul weights int8 group-quantized (GroupQuantizer analog);
+    everything else is cast to ``dtype``.
+    """
+    pol = policy or match_policy(hf_model)
+    if pol is None:
+        raise ValueError(
+            f"no injection policy for {type(hf_model).__name__}; known: "
+            "GPT2LMHeadModel/GPT2Model (register more via "
+            "module_inject.replace_policy.register_policy)"
+        )
+    kind, cfg, params_np = pol.convert(hf_model)
+    log_dist(f"module_inject: {type(hf_model).__name__} → {kind} via {pol.__name__}")
+
+    if quantize_bits == 8:
+        from ..ops.quantizer import quantize_tree
+
+        params = quantize_tree(
+            jax.tree.map(jnp.asarray, params_np),
+            groups=quantize_groups,
+            dtype=dtype,
+        )
+    else:
+        params = jax.tree.map(
+            lambda x: jnp.asarray(x, dtype) if np_floating(x) else jnp.asarray(x),
+            params_np,
+        )
+    return kind, cfg, params
+
+
+def np_floating(x) -> bool:
+    import numpy as np
+
+    return np.issubdtype(np.asarray(x).dtype, np.floating)

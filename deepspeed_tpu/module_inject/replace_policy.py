@@ -1,0 +1,118 @@
+"""Per-architecture injection policies: HF torch checkpoints → TPU decode graph.
+
+Analog of reference ``deepspeed/module_inject/replace_policy.py`` (501 LoC:
+HFBertLayerPolicy:66, HFGPTNEOLayerPolicy:129, HFGPTJLayerPolicy:174,
+MegatronLayerPolicy:219, HFGPT2LayerPolicy:299, BLOOMLayerPolicy:339,
+GPTNEOXLayerPolicy:381, HFOPTLayerPolicy:435). The reference's policy returns
+the attention/MLP/LayerNorm tensors of ONE torch layer so replace_module can
+rebuild it around fused CUDA kernels. Here a policy converts the WHOLE model
+once: torch weights → a stacked (scan-over-layers) JAX param pytree + the
+matching model config, after which the decode graph is an ordinary jitted
+function (XLA is the fused kernel).
+
+Policies register in ``POLICY_REGISTRY``; ``match_policy`` picks by HF class
+name so ``init_inference(hf_model)`` needs no explicit policy argument
+(reference ``replace_method="auto"``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+PyTree = Any
+
+
+def _t(x) -> np.ndarray:
+    """torch tensor → numpy fp32 (host-side; conversion happens once)."""
+    return x.detach().cpu().float().numpy()
+
+
+def _stack(layers: List[np.ndarray]) -> np.ndarray:
+    return np.stack(layers, axis=0)
+
+
+class DSPolicy:
+    """Base policy. Subclasses set ``hf_class_names`` and implement
+    ``convert(hf_model) -> (model_kind, config, params)``."""
+
+    hf_class_names: Tuple[str, ...] = ()
+
+    @classmethod
+    def matches(cls, hf_model) -> bool:
+        return type(hf_model).__name__ in cls.hf_class_names
+
+    @classmethod
+    def convert(cls, hf_model):
+        raise NotImplementedError
+
+
+class HFGPT2LayerPolicy(DSPolicy):
+    """transformers GPT2LMHeadModel / GPT2Model → models.gpt2 stacked params.
+
+    HF GPT-2 uses Conv1D with weight stored [in, out] — identical to our
+    matmul layout, so tensors map 1:1 (reference HFGPT2LayerPolicy:299 also
+    relies on this orientation)."""
+
+    hf_class_names = ("GPT2LMHeadModel", "GPT2Model")
+
+    @classmethod
+    def convert(cls, hf_model):
+        from ..models.gpt2 import GPT2Config
+
+        t = hf_model.transformer if hasattr(hf_model, "transformer") else hf_model
+        hf_cfg = hf_model.config
+        cfg = GPT2Config(
+            vocab_size=hf_cfg.vocab_size,
+            n_positions=hf_cfg.n_positions,
+            n_embd=hf_cfg.n_embd,
+            n_layer=hf_cfg.n_layer,
+            n_head=hf_cfg.n_head,
+            layer_norm_epsilon=hf_cfg.layer_norm_epsilon,
+            attn_impl="jnp",
+        )
+        hs = list(t.h)
+        params = {
+            "wte": _t(t.wte.weight),
+            "wpe": _t(t.wpe.weight),
+            "ln_f": {"scale": _t(t.ln_f.weight), "bias": _t(t.ln_f.bias)},
+            "blocks": {
+                "ln_1": {
+                    "scale": _stack([_t(h.ln_1.weight) for h in hs]),
+                    "bias": _stack([_t(h.ln_1.bias) for h in hs]),
+                },
+                "ln_2": {
+                    "scale": _stack([_t(h.ln_2.weight) for h in hs]),
+                    "bias": _stack([_t(h.ln_2.bias) for h in hs]),
+                },
+                "attn": {
+                    "c_attn_w": _stack([_t(h.attn.c_attn.weight) for h in hs]),
+                    "c_attn_b": _stack([_t(h.attn.c_attn.bias) for h in hs]),
+                    "c_proj_w": _stack([_t(h.attn.c_proj.weight) for h in hs]),
+                    "c_proj_b": _stack([_t(h.attn.c_proj.bias) for h in hs]),
+                },
+                "mlp": {
+                    "c_fc_w": _stack([_t(h.mlp.c_fc.weight) for h in hs]),
+                    "c_fc_b": _stack([_t(h.mlp.c_fc.bias) for h in hs]),
+                    "c_proj_w": _stack([_t(h.mlp.c_proj.weight) for h in hs]),
+                    "c_proj_b": _stack([_t(h.mlp.c_proj.bias) for h in hs]),
+                },
+            },
+        }
+        return "gpt2", cfg, params
+
+
+POLICY_REGISTRY: List[type] = [HFGPT2LayerPolicy]
+
+
+def register_policy(policy: type) -> type:
+    POLICY_REGISTRY.append(policy)
+    return policy
+
+
+def match_policy(hf_model) -> Optional[type]:
+    for pol in POLICY_REGISTRY:
+        if pol.matches(hf_model):
+            return pol
+    return None

@@ -1,0 +1,164 @@
+"""Inference stack tests: KV-cache decode, HF injection parity, int8 quant.
+
+Reference analog: tests/unit/inference/test_inference.py (injected vs vanilla
+HF outputs) and csrc quantizer tests.
+"""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models import gpt2
+from deepspeed_tpu.ops.quantizer import (
+    dequantize,
+    quantization_error,
+    quantize,
+    quantize_tree,
+)
+
+warnings.filterwarnings("ignore")
+
+
+@pytest.fixture(scope="module")
+def tiny_cfg():
+    return gpt2.get_config("gpt2-tiny", attn_impl="jnp")
+
+
+@pytest.fixture(scope="module")
+def tiny_params(tiny_cfg):
+    return gpt2.init_params(tiny_cfg, jax.random.PRNGKey(0))
+
+
+class TestKVCacheDecode:
+    def test_prefill_matches_full_forward(self, tiny_cfg, tiny_params):
+        rs = np.random.RandomState(0)
+        ids = jnp.asarray(rs.randint(0, tiny_cfg.vocab_size, (2, 12)), jnp.int32)
+        full = gpt2.forward(tiny_cfg, tiny_params, ids)
+        cache = gpt2.init_cache(tiny_cfg, 2, 32, dtype=jnp.float32)
+        logits, cache = gpt2.forward_cached(tiny_cfg, tiny_params, ids, cache)
+        assert np.allclose(np.asarray(full[:, -1]), np.asarray(logits), atol=1e-5)
+        assert int(cache.pos) == 12
+
+    def test_incremental_decode_matches_recompute(self, tiny_cfg, tiny_params):
+        rs = np.random.RandomState(1)
+        ids = jnp.asarray(rs.randint(0, tiny_cfg.vocab_size, (2, 8)), jnp.int32)
+        cache = gpt2.init_cache(tiny_cfg, 2, 16, dtype=jnp.float32)
+        _, cache = gpt2.forward_cached(tiny_cfg, tiny_params, ids, cache)
+        for t in range(3):
+            nxt = jnp.asarray(rs.randint(0, tiny_cfg.vocab_size, (2, 1)), jnp.int32)
+            dec, cache = gpt2.forward_cached(tiny_cfg, tiny_params, nxt, cache)
+            ids = jnp.concatenate([ids, nxt], axis=1)
+            full = gpt2.forward(tiny_cfg, tiny_params, ids)[:, -1]
+            assert np.allclose(np.asarray(full), np.asarray(dec), atol=1e-4)
+
+    def test_generate_greedy_matches_recompute(self, tiny_cfg, tiny_params):
+        rs = np.random.RandomState(2)
+        ids = jnp.asarray(rs.randint(0, tiny_cfg.vocab_size, (2, 6)), jnp.int32)
+        out = gpt2.generate(tiny_cfg, tiny_params, ids, max_new_tokens=5, cache_dtype=jnp.float32)
+        ref = ids
+        for _ in range(5):
+            lg = gpt2.forward(tiny_cfg, tiny_params, ref)[:, -1]
+            ref = jnp.concatenate([ref, jnp.argmax(lg, -1)[:, None].astype(jnp.int32)], 1)
+        assert np.array_equal(np.asarray(out), np.asarray(ref[:, 6:]))
+
+
+class TestQuantizer:
+    def test_roundtrip_error_bounded(self):
+        rs = np.random.RandomState(0)
+        w = jnp.asarray(rs.randn(128, 64), jnp.float32)
+        assert quantization_error(w, groups=16) < 0.02  # int8 ≈ 0.5% rms
+
+    def test_group_shapes(self):
+        w = jnp.ones((4, 128, 64))
+        qw = quantize(w, groups=16)
+        assert qw.q.dtype == jnp.int8
+        assert qw.q.shape == (4, 16, 8, 64)
+        assert qw.scale.shape == (4, 16, 1, 64)
+        assert np.allclose(np.asarray(dequantize(qw)), np.asarray(w), atol=1e-2)
+
+    def test_quantize_tree_targets_stacked_weights(self, tiny_cfg, tiny_params):
+        from deepspeed_tpu.ops.quantizer import QuantizedWeight
+
+        qt = quantize_tree(tiny_params, groups=8)
+        assert isinstance(qt["blocks"]["attn"]["c_attn_w"], QuantizedWeight)
+        assert qt["wte"].dtype == jnp.bfloat16  # embeddings cast, not quantized
+
+    def test_quantized_forward_close(self, tiny_cfg, tiny_params):
+        rs = np.random.RandomState(3)
+        ids = jnp.asarray(rs.randint(0, tiny_cfg.vocab_size, (2, 8)), jnp.int32)
+        ref = gpt2.forward(tiny_cfg, tiny_params, ids)
+        qparams = quantize_tree(tiny_params, groups=8, dtype=jnp.float32)
+        out = gpt2.forward(tiny_cfg, qparams, ids)
+        ref_p = jax.nn.softmax(np.asarray(ref[:, -1], np.float32))
+        out_p = jax.nn.softmax(np.asarray(out[:, -1], np.float32))
+        assert float(jnp.abs(ref_p - out_p).max()) < 0.05
+
+
+class TestHFInjection:
+    @pytest.fixture(scope="class")
+    def hf_model(self):
+        torch = pytest.importorskip("torch")
+        from transformers import GPT2Config as HFConfig, GPT2LMHeadModel
+
+        torch.manual_seed(0)
+        cfg = HFConfig(
+            n_embd=64, n_layer=2, n_head=4, vocab_size=512, n_positions=128,
+            resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        )
+        model = GPT2LMHeadModel(cfg)
+        model.eval()
+        return model
+
+    def test_policy_match(self, hf_model):
+        from deepspeed_tpu.module_inject import HFGPT2LayerPolicy, match_policy
+
+        assert match_policy(hf_model) is HFGPT2LayerPolicy
+
+    def test_logits_parity_vs_transformers(self, hf_model):
+        import torch
+
+        from deepspeed_tpu.module_inject import replace_transformer_layer
+
+        kind, cfg, params = replace_transformer_layer(hf_model, dtype=jnp.float32)
+        assert kind == "gpt2"
+        rs = np.random.RandomState(0)
+        ids = rs.randint(0, cfg.vocab_size, (2, 10))
+        with torch.no_grad():
+            hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+        ours = np.asarray(gpt2.forward(cfg, params, jnp.asarray(ids, jnp.int32)))
+        assert np.allclose(ours, hf_logits, atol=2e-3), (
+            f"max diff {np.abs(ours - hf_logits).max()}"
+        )
+
+    def test_generate_parity_vs_transformers(self, hf_model):
+        import torch
+
+        from deepspeed_tpu.inference.engine import InferenceEngine
+
+        engine = InferenceEngine(
+            model=hf_model, replace_with_kernel_inject=True, dtype=jnp.float32
+        )
+        rs = np.random.RandomState(1)
+        ids = rs.randint(0, 512, (1, 8))
+        with torch.no_grad():
+            hf_out = hf_model.generate(
+                torch.tensor(ids), max_new_tokens=6, do_sample=False,
+                pad_token_id=0,
+            ).numpy()
+        ours = engine.generate(ids, max_new_tokens=6)
+        assert np.array_equal(ours, hf_out), (ours, hf_out)
+
+    def test_int8_injection_generates(self, hf_model):
+        from deepspeed_tpu.inference.engine import InferenceEngine
+
+        engine = InferenceEngine(
+            model=hf_model, replace_with_kernel_inject=True,
+            dtype=jnp.float32, quantize_bits=8, quantize_groups=8,
+        )
+        assert engine.quantized
+        ids = np.random.RandomState(2).randint(0, 512, (1, 8))
+        out = engine.generate(ids, max_new_tokens=4)
+        assert out.shape == (1, 12)
