@@ -70,7 +70,6 @@ class InferenceEngine:
         if model is not None and not isinstance(model, ModuleSpec) and _is_torch_module(model):
             # reference path: init_inference(hf_model, replace_with_kernel_inject=True)
             from ..module_inject import replace_transformer_layer
-            from ..models import gpt2 as gpt2_mod
 
             kind, mcfg, params = replace_transformer_layer(
                 model,
@@ -79,9 +78,16 @@ class InferenceEngine:
                 quantize_bits=quantize_bits,
                 quantize_groups=quantize_groups,
             )
-            assert kind == "gpt2", f"unsupported injected model kind {kind}"
             self.model_config = mcfg
-            model = gpt2_mod.make_module(mcfg)
+            if kind == "gpt2":
+                from ..models import gpt2 as m_mod
+            elif kind == "decoder":
+                from ..models import decoder as m_mod
+            elif kind == "bert":
+                from ..models import bert as m_mod
+            else:
+                raise ValueError(f"unsupported injected model kind {kind}")
+            model = m_mod.make_module(mcfg)
             self.quantized = quantize_bits == 8
         else:
             assert model is not None and model.apply_fn is not None, (
@@ -144,19 +150,25 @@ class InferenceEngine:
         recompute fallback otherwise. Returns prompt + new tokens."""
         ids = jnp.asarray(input_ids)
         rng = jax.random.PRNGKey(seed)
+        from ..models.decoder import DecoderConfig
         from ..models.gpt2 import GPT2Config
 
+        gen_mod = None
         if isinstance(self.model_config, GPT2Config):
-            from ..models import gpt2 as gpt2_mod
+            from ..models import gpt2 as gen_mod
+        elif isinstance(self.model_config, DecoderConfig):
+            from ..models import decoder as gen_mod
 
+        if gen_mod is not None:
             key = (ids.shape, max_new_tokens, float(temperature))
             gen = self._generate_cache.get(key)
             if gen is None:
                 cfg = self.model_config
                 cache_dtype = self.dtype
+                mod = gen_mod
 
                 def gen_fn(params, ids, rng):
-                    return gpt2_mod.generate(
+                    return mod.generate(
                         cfg, params, ids, max_new_tokens,
                         temperature=temperature, rng=rng, cache_dtype=cache_dtype,
                     )

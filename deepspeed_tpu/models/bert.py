@@ -1,0 +1,180 @@
+"""BERT encoder, TPU-first (scan-over-layers, post-LN).
+
+The reference's BERT support is its oldest surface: the fused training
+transformer kernel (csrc/transformer/ds_transformer_cuda.cpp) is benchmarked
+against BERT modules (tests/unit/test_cuda_forward.py vs tests/unit/
+modeling.py), BERT-large pretraining is the headline number (BASELINE.md),
+and inference injection starts at HFBertLayerPolicy (replace_policy.py:66).
+This module is the TPU workload for those same surfaces: the "fused layer" is
+this jitted block (XLA fuses gemm+bias+gelu+layernorm), driven by the same
+policy-converted HF checkpoints.
+
+Post-LN residual layout (original BERT): h = LN(h + attn(h)); h = LN(h + mlp(h)).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..runtime.module import ModuleSpec
+
+PyTree = Any
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    n_positions: int = 512
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    ffn_dim: int = 3072
+    type_vocab_size: int = 2
+    layer_norm_epsilon: float = 1e-12
+    dropout: float = 0.0
+
+    @property
+    def head_dim(self) -> int:
+        return self.n_embd // self.n_head
+
+
+PRESETS: Dict[str, Dict] = {
+    "bert-tiny": dict(n_embd=64, n_layer=2, n_head=4, ffn_dim=256, vocab_size=512, n_positions=128),
+    "bert-base": dict(n_embd=768, n_layer=12, n_head=12, ffn_dim=3072),
+    "bert-large": dict(n_embd=1024, n_layer=24, n_head=16, ffn_dim=4096),
+}
+
+
+def get_config(name: str, **overrides) -> BertConfig:
+    base = dict(PRESETS[name])
+    base.update(overrides)
+    return BertConfig(**base)
+
+
+def _ln(x, scale, bias, eps):
+    m = jnp.mean(x, axis=-1, keepdims=True)
+    v = jnp.var(x, axis=-1, keepdims=True)
+    return (x - m) * lax.rsqrt(v + eps) * scale + bias
+
+
+def init_params(cfg: BertConfig, rng) -> PyTree:
+    E, L, F = cfg.n_embd, cfg.n_layer, cfg.ffn_dim
+    k = iter(jax.random.split(rng, 16))
+    std = 0.02
+
+    def nrm(key, shape):
+        return jax.random.normal(key, shape) * std
+
+    ln = {"scale": jnp.ones((L, E)), "bias": jnp.zeros((L, E))}
+    return {
+        "wte": nrm(next(k), (cfg.vocab_size, E)),
+        "wpe": nrm(next(k), (cfg.n_positions, E)),
+        "wtt": nrm(next(k), (cfg.type_vocab_size, E)),
+        "emb_ln": {"scale": jnp.ones((E,)), "bias": jnp.zeros((E,))},
+        "blocks": {
+            "attn": {
+                "wq": nrm(next(k), (L, E, E)), "bq": jnp.zeros((L, E)),
+                "wk": nrm(next(k), (L, E, E)), "bk": jnp.zeros((L, E)),
+                "wv": nrm(next(k), (L, E, E)), "bv": jnp.zeros((L, E)),
+                "wo": nrm(next(k), (L, E, E)), "bo": jnp.zeros((L, E)),
+            },
+            "attn_ln": dict(ln),
+            "mlp": {
+                "fc_in_w": nrm(next(k), (L, E, F)), "fc_in_b": jnp.zeros((L, F)),
+                "fc_out_w": nrm(next(k), (L, F, E)), "fc_out_b": jnp.zeros((L, E)),
+            },
+            "out_ln": dict(ln),
+        },
+        "pooler": {"w": nrm(next(k), (E, E)), "b": jnp.zeros((E,))},
+    }
+
+
+def logical_axes(cfg: Optional[BertConfig] = None) -> PyTree:
+    attn = {
+        "wq": ("layers", "embed", "heads"), "bq": ("layers", "heads"),
+        "wk": ("layers", "embed", "heads"), "bk": ("layers", "heads"),
+        "wv": ("layers", "embed", "heads"), "bv": ("layers", "heads"),
+        "wo": ("layers", "heads", "embed"), "bo": ("layers", "embed"),
+    }
+    ln = {"scale": ("layers", "embed"), "bias": ("layers", "embed")}
+    return {
+        "wte": ("vocab", "embed"),
+        "wpe": (None, "embed"),
+        "wtt": (None, "embed"),
+        "emb_ln": {"scale": ("embed",), "bias": ("embed",)},
+        "blocks": {
+            "attn": attn,
+            "attn_ln": ln,
+            "mlp": {
+                "fc_in_w": ("layers", "embed", "mlp"), "fc_in_b": ("layers", "mlp"),
+                "fc_out_w": ("layers", "mlp", "embed"), "fc_out_b": ("layers", "embed"),
+            },
+            "out_ln": ln,
+        },
+        "pooler": {"w": ("embed", "embed"), "b": ("embed",)},
+    }
+
+
+def _block(cfg: BertConfig, lp, h, attn_bias):
+    B, S, E = h.shape
+    H, D = cfg.n_head, cfg.head_dim
+    a = lp["attn"]
+    q = (h @ a["wq"] + a["bq"]).reshape(B, S, H, D)
+    k_ = (h @ a["wk"] + a["bk"]).reshape(B, S, H, D)
+    v = (h @ a["wv"] + a["bv"]).reshape(B, S, H, D)
+    scores = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32), k_.astype(jnp.float32))
+    scores = scores / np.sqrt(D) + attn_bias
+    probs = jax.nn.softmax(scores, axis=-1).astype(h.dtype)
+    o = jnp.einsum("bhst,bthd->bshd", probs, v).reshape(B, S, E)
+    h = _ln(h + (o @ a["wo"] + a["bo"]), lp["attn_ln"]["scale"], lp["attn_ln"]["bias"], cfg.layer_norm_epsilon)
+    m = lp["mlp"]
+    y = jax.nn.gelu(h @ m["fc_in_w"] + m["fc_in_b"], approximate=False)
+    y = y @ m["fc_out_w"] + m["fc_out_b"]
+    return _ln(h + y, lp["out_ln"]["scale"], lp["out_ln"]["bias"], cfg.layer_norm_epsilon)
+
+
+def forward(
+    cfg: BertConfig,
+    params: PyTree,
+    input_ids: jnp.ndarray,
+    attention_mask: Optional[jnp.ndarray] = None,
+    token_type_ids: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+    """→ (last_hidden_state [B,S,E], pooled [B,E] or None)."""
+    B, S = input_ids.shape
+    tt = token_type_ids if token_type_ids is not None else jnp.zeros_like(input_ids)
+    h = params["wte"][input_ids] + params["wpe"][:S][None] + params["wtt"][tt]
+    h = _ln(h, params["emb_ln"]["scale"], params["emb_ln"]["bias"], cfg.layer_norm_epsilon)
+    if attention_mask is not None:
+        bias = (1.0 - attention_mask[:, None, None, :].astype(jnp.float32)) * -1e30
+    else:
+        bias = jnp.zeros((B, 1, 1, S), jnp.float32)
+
+    def body(h, lp):
+        return _block(cfg, lp, h, bias), None
+
+    h, _ = lax.scan(body, h, params["blocks"])
+    pooled = None
+    if params.get("pooler") is not None:
+        pooled = jnp.tanh(h[:, 0] @ params["pooler"]["w"] + params["pooler"]["b"])
+    return h, pooled
+
+
+def make_module(cfg: BertConfig) -> ModuleSpec:
+    return ModuleSpec(
+        init=lambda rng: init_params(cfg, rng),
+        loss_fn=None,
+        apply_fn=lambda params, batch: forward(
+            cfg, params, batch["input_ids"],
+            batch.get("attention_mask"), batch.get("token_type_ids"),
+        )[0],
+        logical_axes=logical_axes(cfg),
+        num_layers=cfg.n_layer,
+        extra={"config": cfg},
+    )

@@ -1,0 +1,356 @@
+"""Unified causal decoder — one scan-over-layers graph for the HF GPT family.
+
+The reference serves OPT/BLOOM/GPT-J/GPT-Neo/GPT-NeoX/Megatron through ONE
+fused CUDA module (``DeepSpeedTransformerInference``) parameterised by policy
+(module_inject/replace_policy.py:129-501 + transformer_inference.py:735:
+rotary/alibi/triangular-masking flags). This is the TPU analog: one jitted
+decode graph whose config covers the architectural axes that differ:
+
+- position encoding: learned | rope (gptj-interleaved / neox-half) | alibi
+- residual topology: sequential (GPT2/OPT/BLOOM) | parallel (GPT-J/NeoX)
+- activation: gelu_new | gelu | relu
+- attention scale override (GPT-Neo uses none), per-layer local windows
+  (GPT-Neo alternating global/local)
+- lm head: tied to embeddings or separate (+optional bias)
+- BLOOM's embedding LayerNorm; OPT's position offset
+
+Params are normalised by policies to: separate per-layer wq/wk/wv/wo
+[L, E, E], mlp fc_in [L, E, F] / fc_out [L, F, E], ln scales/biases — the
+fused-QKV torch layouts (BLOOM/NeoX [H,3,D] interleave) are de-interleaved at
+conversion time so the decode graph never branches on checkpoint layout.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..runtime.module import ModuleSpec
+
+PyTree = Any
+
+
+@dataclass(frozen=True)
+class DecoderConfig:
+    vocab_size: int
+    n_positions: int
+    n_embd: int
+    n_layer: int
+    n_head: int
+    ffn_dim: int
+    layer_norm_epsilon: float = 1e-5
+    pos_emb: str = "learned"  # learned | rope | alibi | none
+    rope_style: str = "gptj"  # gptj (interleaved) | neox (half-split)
+    rotary_dim: int = 0  # 0 → full head_dim
+    activation: str = "gelu_new"  # gelu_new | gelu | relu
+    parallel_residual: bool = False  # GPT-J/NeoX: h + attn(ln(h)) + mlp(ln(h))
+    use_ln2: bool = True  # parallel_residual with a single shared ln (GPT-J) → False
+    tie_embeddings: bool = True
+    lm_head_bias: bool = False
+    embed_ln: bool = False  # BLOOM word_embeddings_layernorm
+    pos_offset: int = 0  # OPT's embed_positions offset (2)
+    attn_scale: Optional[float] = None  # None → 1/sqrt(head_dim); GPT-Neo → 1.0
+    local_windows: Tuple[int, ...] = ()  # per-layer window, 0 = global (GPT-Neo)
+
+    @property
+    def head_dim(self) -> int:
+        return self.n_embd // self.n_head
+
+
+class KVCache(NamedTuple):
+    k: jnp.ndarray  # [L, B, Smax, H, D]
+    v: jnp.ndarray
+    pos: jnp.ndarray
+
+
+def init_cache(cfg: DecoderConfig, batch_size: int, max_len: int, dtype=jnp.bfloat16) -> KVCache:
+    shape = (cfg.n_layer, batch_size, max_len, cfg.n_head, cfg.head_dim)
+    return KVCache(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype), jnp.int32(0))
+
+
+# ---------------------------------------------------------------------------
+# building blocks
+# ---------------------------------------------------------------------------
+
+def _ln(x, scale, bias, eps):
+    m = jnp.mean(x, axis=-1, keepdims=True)
+    v = jnp.var(x, axis=-1, keepdims=True)
+    return (x - m) * lax.rsqrt(v + eps) * scale + bias
+
+
+def _act(cfg: DecoderConfig, x):
+    if cfg.activation == "relu":
+        return jax.nn.relu(x)
+    return jax.nn.gelu(x, approximate=(cfg.activation == "gelu_new"))
+
+
+def alibi_slopes(n_head: int) -> np.ndarray:
+    """Standard ALiBi slopes (power-of-two geometric; BLOOM formula)."""
+
+    def pow2_slopes(n):
+        start = 2.0 ** (-(2.0 ** -(np.log2(n) - 3)))
+        return [start * (start ** i) for i in range(n)]
+
+    if np.log2(n_head).is_integer():
+        return np.asarray(pow2_slopes(n_head), np.float32)
+    closest = 2 ** int(np.floor(np.log2(n_head)))
+    extra = pow2_slopes(2 * closest)[0::2][: n_head - closest]
+    return np.asarray(pow2_slopes(closest) + extra, np.float32)
+
+
+def _rope_angles(cfg: DecoderConfig, positions: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    rot = cfg.rotary_dim or cfg.head_dim
+    inv_freq = 1.0 / (10000.0 ** (jnp.arange(0, rot, 2, dtype=jnp.float32) / rot))
+    ang = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]  # [S, rot/2]
+    return jnp.sin(ang), jnp.cos(ang)
+
+
+def _apply_rope(cfg: DecoderConfig, x: jnp.ndarray, sin, cos) -> jnp.ndarray:
+    """x [B,S,H,D]; rotate the first rotary_dim dims per rope_style."""
+    rot = cfg.rotary_dim or cfg.head_dim
+    xr, xp = x[..., :rot], x[..., rot:]
+    s = sin[None, :, None, :]
+    c = cos[None, :, None, :]
+    if cfg.rope_style == "gptj":  # interleaved pairs (rotate_every_two)
+        x1, x2 = xr[..., 0::2], xr[..., 1::2]
+        r1 = x1 * c - x2 * s
+        r2 = x2 * c + x1 * s
+        rotated = jnp.stack([r1, r2], axis=-1).reshape(xr.shape)
+    else:  # neox: half-split (rotate_half), angles repeated across halves
+        half = rot // 2
+        x1, x2 = xr[..., :half], xr[..., half:]
+        r1 = x1 * c - x2 * s
+        r2 = x2 * c + x1 * s
+        rotated = jnp.concatenate([r1, r2], axis=-1)
+    return jnp.concatenate([rotated, xp], axis=-1).astype(x.dtype)
+
+
+def _attention(cfg: DecoderConfig, lp, h, k_cache, v_cache, pos, layer_window):
+    """Causal (optionally local-windowed / alibi-biased) attention with cache."""
+    B, S, E = h.shape
+    H, D = cfg.n_head, cfg.head_dim
+
+    def proj(w, b):
+        out = h @ w
+        return out + b if b is not None else out
+
+    q = proj(lp["wq"], lp.get("bq")).reshape(B, S, H, D)
+    k_ = proj(lp["wk"], lp.get("bk")).reshape(B, S, H, D)
+    v = proj(lp["wv"], lp.get("bv")).reshape(B, S, H, D)
+
+    if cfg.pos_emb == "rope":
+        sin, cos = _rope_angles(cfg, pos + jnp.arange(S))
+        q = _apply_rope(cfg, q, sin, cos)
+        k_ = _apply_rope(cfg, k_, sin, cos)
+
+    k_cache = lax.dynamic_update_slice(k_cache, k_.astype(k_cache.dtype), (0, pos, 0, 0))
+    v_cache = lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0))
+
+    Smax = k_cache.shape[1]
+    scale = cfg.attn_scale if cfg.attn_scale is not None else 1.0 / np.sqrt(D)
+    scores = jnp.einsum(
+        "bshd,bthd->bhst", q.astype(jnp.float32), k_cache.astype(jnp.float32)
+    ) * scale
+
+    j_idx = jnp.arange(Smax)
+    i_idx = pos + jnp.arange(S)
+    mask = j_idx[None, :] <= i_idx[:, None]
+    # GPT-Neo local layers: window w keeps keys with i - w < j <= i
+    mask = jnp.where(
+        layer_window > 0,
+        mask & (j_idx[None, :] > i_idx[:, None] - layer_window),
+        mask,
+    )
+    if cfg.pos_emb == "alibi":
+        slopes = jnp.asarray(alibi_slopes(H))  # [H]
+        # per-query-row-constant shift makes slopes*j equivalent to slopes*(j-i)
+        scores = scores + slopes[None, :, None, None] * j_idx[None, None, None, :]
+    scores = jnp.where(mask[None, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
+    o = jnp.einsum("bhst,bthd->bshd", probs, v_cache).reshape(B, S, E).astype(h.dtype)
+    out = o @ lp["wo"]
+    if lp.get("bo") is not None:
+        out = out + lp["bo"]
+    return out, k_cache, v_cache
+
+
+def _mlp(cfg: DecoderConfig, lp, x):
+    y = x @ lp["fc_in_w"]
+    if lp.get("fc_in_b") is not None:
+        y = y + lp["fc_in_b"]
+    y = _act(cfg, y)
+    y = y @ lp["fc_out_w"]
+    if lp.get("fc_out_b") is not None:
+        y = y + lp["fc_out_b"]
+    return y
+
+
+def _block(cfg: DecoderConfig, lp, h, k_c, v_c, pos, window):
+    eps = cfg.layer_norm_epsilon
+    ln1 = _ln(h, lp["ln_1"]["scale"], lp["ln_1"]["bias"], eps)
+    a, k_c, v_c = _attention(cfg, lp["attn"], ln1, k_c, v_c, pos, window)
+    if cfg.parallel_residual:
+        mlp_in = ln1 if not cfg.use_ln2 else _ln(h, lp["ln_2"]["scale"], lp["ln_2"]["bias"], eps)
+        return h + a + _mlp(cfg, lp["mlp"], mlp_in), k_c, v_c
+    h = h + a
+    ln2 = _ln(h, lp["ln_2"]["scale"], lp["ln_2"]["bias"], eps)
+    return h + _mlp(cfg, lp["mlp"], ln2), k_c, v_c
+
+
+# ---------------------------------------------------------------------------
+# forward paths
+# ---------------------------------------------------------------------------
+
+def _embed(cfg: DecoderConfig, params, input_ids, pos):
+    S = input_ids.shape[1]
+    h = params["wte"][input_ids]
+    if cfg.pos_emb == "learned":
+        positions = pos + jnp.arange(S) + cfg.pos_offset
+        h = h + params["wpe"][positions][None, :, :]
+    if cfg.embed_ln:
+        h = _ln(h, params["emb_ln"]["scale"], params["emb_ln"]["bias"], cfg.layer_norm_epsilon)
+    return h
+
+
+def _head(cfg: DecoderConfig, params, h):
+    if cfg.tie_embeddings:
+        logits = h @ params["wte"].T
+    else:
+        logits = h @ params["lm_head_w"]
+        if cfg.lm_head_bias:
+            logits = logits + params["lm_head_b"]
+    return logits
+
+
+def _windows(cfg: DecoderConfig) -> jnp.ndarray:
+    if cfg.local_windows:
+        return jnp.asarray(cfg.local_windows, jnp.int32)
+    return jnp.zeros(cfg.n_layer, jnp.int32)
+
+
+def forward_cached(cfg: DecoderConfig, params, input_ids, cache: KVCache):
+    """[B,S] starting at cache.pos → (last-token logits [B,V], cache)."""
+    pos = cache.pos
+    h = _embed(cfg, params, input_ids, pos)
+
+    def body(carry, xs):
+        h = carry
+        lp, k_c, v_c, window = xs
+        h, k_c, v_c = _block(cfg, lp, h, k_c, v_c, pos, window)
+        return h, (k_c, v_c)
+
+    h, (new_k, new_v) = lax.scan(body, h, (params["blocks"], cache.k, cache.v, _windows(cfg)))
+    h = _ln(h[:, -1], params["ln_f"]["scale"], params["ln_f"]["bias"], cfg.layer_norm_epsilon)
+    return _head(cfg, params, h), KVCache(new_k, new_v, pos + input_ids.shape[1])
+
+
+def forward(cfg: DecoderConfig, params, input_ids, train: bool = False, rng=None):
+    """Full-sequence logits [B,S,V] (training/eval path, no cache)."""
+    B, S = input_ids.shape
+    h = _embed(cfg, params, input_ids, 0)
+    k0 = jnp.zeros((cfg.n_layer, B, S, cfg.n_head, cfg.head_dim), h.dtype)
+
+    def body(carry, xs):
+        h = carry
+        lp, k_c, v_c, window = xs
+        h, _, _ = _block(cfg, lp, h, k_c, v_c, 0, window)
+        return h, None
+
+    h, _ = lax.scan(body, h, (params["blocks"], k0, k0, _windows(cfg)))
+    h = _ln(h, params["ln_f"]["scale"], params["ln_f"]["bias"], cfg.layer_norm_epsilon)
+    return _head(cfg, params, h)
+
+
+def generate(
+    cfg: DecoderConfig,
+    params,
+    input_ids,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    rng=None,
+    max_len: Optional[int] = None,
+    cache_dtype=jnp.bfloat16,
+):
+    """Prefill + lax.scan decode (same structure as models/gpt2.generate)."""
+    B, S = input_ids.shape
+    max_len = max_len or min(cfg.n_positions, S + max_new_tokens)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    cache = init_cache(cfg, B, max_len, dtype=cache_dtype)
+    logits, cache = forward_cached(cfg, params, input_ids, cache)
+
+    def sample(logits, key):
+        if temperature and temperature > 0.0:
+            return jax.random.categorical(key, logits.astype(jnp.float32) / temperature, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    first = sample(logits, rng)
+    if max_new_tokens == 1:
+        return first[:, None]
+
+    def step(carry, key):
+        token, cache = carry
+        logits, cache = forward_cached(cfg, params, token[:, None].astype(input_ids.dtype), cache)
+        return (sample(logits, key), cache), token
+
+    keys = jax.random.split(jax.random.fold_in(rng, 1), max_new_tokens - 1)
+    (last, _), tokens = lax.scan(step, (first, cache), keys)
+    return jnp.concatenate([jnp.moveaxis(tokens, 0, 1), last[:, None]], axis=1)
+
+
+def logical_axes(cfg: DecoderConfig) -> PyTree:
+    """Sharding annotations (column-parallel q/k/v/fc_in, row-parallel o/fc_out)."""
+    attn = {
+        "wq": ("layers", "embed", "heads"), "wk": ("layers", "embed", "heads"),
+        "wv": ("layers", "embed", "heads"), "wo": ("layers", "heads", "embed"),
+        "bq": ("layers", "heads"), "bk": ("layers", "heads"),
+        "bv": ("layers", "heads"), "bo": ("layers", "embed"),
+    }
+    mlp = {
+        "fc_in_w": ("layers", "embed", "mlp"), "fc_in_b": ("layers", "mlp"),
+        "fc_out_w": ("layers", "mlp", "embed"), "fc_out_b": ("layers", "embed"),
+    }
+    ln = {"scale": ("layers", "embed"), "bias": ("layers", "embed")}
+    axes = {
+        "wte": ("vocab", "embed"),
+        "ln_f": {"scale": ("embed",), "bias": ("embed",)},
+        "blocks": {"ln_1": ln, "ln_2": ln, "attn": attn, "mlp": mlp},
+    }
+    if cfg.pos_emb == "learned":
+        axes["wpe"] = (None, "embed")
+    if cfg.embed_ln:
+        axes["emb_ln"] = {"scale": ("embed",), "bias": ("embed",)}
+    if not cfg.tie_embeddings:
+        axes["lm_head_w"] = ("embed", "vocab")
+        if cfg.lm_head_bias:
+            axes["lm_head_b"] = ("vocab",)
+    return axes
+
+
+def lm_loss(cfg: DecoderConfig, params, batch, rng, train: bool):
+    ids = batch["input_ids"]
+    logits = forward(cfg, params, ids, train=train, rng=rng)
+    labels = batch.get("labels", ids)[:, 1:]
+    lg = logits[:, :-1].astype(jnp.float32)
+    mask = (labels != -100).astype(jnp.float32)
+    labels = jnp.maximum(labels, 0)
+    logz = jax.nn.logsumexp(lg, axis=-1)
+    gold = jnp.take_along_axis(lg, labels[..., None], axis=-1)[..., 0]
+    nll = (logz - gold) * mask
+    return jnp.sum(nll) / jnp.maximum(jnp.sum(mask), 1.0), {}
+
+
+def make_module(cfg: DecoderConfig) -> ModuleSpec:
+    return ModuleSpec(
+        init=None,  # decoder models are built from converted checkpoints
+        loss_fn=lambda params, batch, rng, train: lm_loss(cfg, params, batch, rng, train),
+        apply_fn=lambda params, batch: forward(cfg, params, batch["input_ids"]),
+        logical_axes=logical_axes(cfg),
+        num_layers=cfg.n_layer,
+        extra={"config": cfg},
+    )
