@@ -1,0 +1,279 @@
+"""Block-sparse flash attention as a Pallas TPU kernel (fwd + bwd).
+
+TPU-native analog of the reference's Triton block-sparse attention
+(``ops/sparse_attention/matmul.py`` SDD/DSD kernels + ``softmax.py``,
+~1350 LoC of Triton 1.0): instead of Triton's lookup tables, the static
+block layout [H, nQ, nK] is compiled into per-row index lists
+(``kidx [H, nQ, maxK]`` + counts) delivered to SMEM via scalar prefetch
+(the splash-attention pattern); each kernel instance walks its list with
+dynamic slices — inactive blocks are never read from HBM, so compute and
+bandwidth scale with layout density, the same asymptotics as the reference
+(docs claim ~6.3x over dense at high sparsity).
+
+The sparsity block size IS the kernel tile size: use >= 64 (ideally 128) on
+real TPUs for MXU efficiency; any multiple of 8 works functionally.
+Within-block causal masking handles the diagonal blocks of unidirectional
+layouts.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def layout_to_index_lists(layout: np.ndarray) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """[H, nQ, nK] bool → (kidx [H,nQ,maxK], kcnt [H,nQ], qidx [H,nK,maxQ],
+    qcnt [H,nK]) — forward walks kidx, backward-dkv walks qidx."""
+    H, nQ, nK = layout.shape
+    kcnt = layout.sum(axis=2).astype(np.int32)
+    qcnt = layout.sum(axis=1).astype(np.int32)
+    maxK = max(1, int(kcnt.max()))
+    maxQ = max(1, int(qcnt.max()))
+    kidx = np.zeros((H, nQ, maxK), np.int32)
+    qidx = np.zeros((H, nK, maxQ), np.int32)
+    for h in range(H):
+        for i in range(nQ):
+            cols = np.nonzero(layout[h, i])[0]
+            kidx[h, i, : len(cols)] = cols
+        for j in range(nK):
+            rows = np.nonzero(layout[h, :, j])[0]
+            qidx[h, j, : len(rows)] = rows
+    return kidx, kcnt, qidx, qcnt
+
+
+def _block_mask(s, qrow0, krow0, causal):
+    if not causal:
+        return s
+    row = qrow0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    col = krow0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(row >= col, s, NEG_INF)
+
+
+def _fwd_kernel(kidx_ref, kcnt_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                sm_scale, causal, blk):
+    h = pl.program_id(1)
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # [blk, D]
+    cnt = kcnt_ref[h, qi]
+
+    def body(j, carry):
+        acc, m_prev, l_prev = carry
+        kj = kidx_ref[h, qi, j]
+        k = k_ref[0, 0, pl.ds(kj * blk, blk), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(kj * blk, blk), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        s = _block_mask(s, qi * blk, kj * blk, causal)
+        m_cur = jnp.max(s, axis=1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    acc0 = jnp.zeros((blk, q_ref.shape[-1]), jnp.float32)
+    m0 = jnp.full((blk,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((blk,), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, cnt, body, (acc0, m0, l0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0, 0] = (acc / l[:, None]).astype(o_ref.dtype)
+    lse_ref[0, 0] = m + jnp.log(l)
+
+
+def _bwd_dq_kernel(kidx_ref, kcnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                   delta_ref, dq_ref, *, sm_scale, causal, blk):
+    h = pl.program_id(1)
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32) * sm_scale
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
+    cnt = kcnt_ref[h, qi]
+
+    def body(j, dq):
+        kj = kidx_ref[h, qi, j]
+        k = k_ref[0, 0, pl.ds(kj * blk, blk), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(kj * blk, blk), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        s = _block_mask(s, qi * blk, kj * blk, causal)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, cnt, body, jnp.zeros((blk, q_ref.shape[-1]), jnp.float32))
+    dq_ref[0, 0] = (dq * sm_scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(qidx_ref, qcnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, *, sm_scale, causal, blk):
+    h = pl.program_id(1)
+    ki = pl.program_id(2)
+    k = k_ref[0, 0].astype(jnp.float32)
+    v = v_ref[0, 0].astype(jnp.float32)
+    cnt = qcnt_ref[h, ki]
+
+    def body(i, carry):
+        dk, dv = carry
+        qi = qidx_ref[h, ki, i]
+        q = q_ref[0, 0, pl.ds(qi * blk, blk), :].astype(jnp.float32) * sm_scale
+        do = do_ref[0, 0, pl.ds(qi * blk, blk), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(qi * blk, blk)]
+        delta = delta_ref[0, 0, pl.ds(qi * blk, blk)]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        s = _block_mask(s, qi * blk, ki * blk, causal)
+        p = jnp.exp(s - lse[:, None])
+        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        return dk, dv
+
+    D = k_ref.shape[-1]
+    dk, dv = jax.lax.fori_loop(
+        0, cnt, body, (jnp.zeros((blk, D), jnp.float32), jnp.zeros((blk, D), jnp.float32))
+    )
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _grid_spec(num_prefetch, grid, in_specs, out_specs):
+    return pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=num_prefetch, grid=grid, in_specs=in_specs, out_specs=out_specs
+    )
+
+
+def _fwd(q4, k4, v4, kidx, kcnt, sm_scale, causal, blk, interpret):
+    """q4: [B, H, S, D]; kidx [H, nQ, maxK] (scalar-prefetched); → (o, lse)."""
+    B, H, S, D = q4.shape
+    grid = (B, H, S // blk)
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal, blk=blk),
+        grid_spec=_grid_spec(
+            2, grid,
+            [
+                pl.BlockSpec((1, 1, blk, D), lambda b, h, i, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, S, D), lambda b, h, i, *_: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, S, D), lambda b, h, i, *_: (b, h, 0, 0)),
+            ],
+            [
+                pl.BlockSpec((1, 1, blk, D), lambda b, h, i, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, blk), lambda b, h, i, *_: (b, h, i)),
+            ],
+        ),
+        interpret=interpret,
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, S, D), q4.dtype),
+            jax.ShapeDtypeStruct((B, H, S), jnp.float32),
+        ],
+    )(kidx, kcnt, q4, k4, v4)
+    return o, lse
+
+
+def _bwd(q4, k4, v4, o4, lse, do4, kidx, kcnt, qidx, qcnt, sm_scale, causal, blk, interpret):
+    B, H, S, D = q4.shape
+    delta = jnp.sum(do4.astype(jnp.float32) * o4.astype(jnp.float32), axis=-1)  # [B,H,S]
+    blk_q = lambda b, h, i, *_: (b, h, i, 0)
+    blk_s = lambda b, h, i, *_: (b, h, i)
+    full = lambda b, h, i, *_: (b, h, 0, 0)
+    full2 = lambda b, h, i, *_: (b, h, 0)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal, blk=blk),
+        grid_spec=_grid_spec(
+            2, (B, H, S // blk),
+            [
+                pl.BlockSpec((1, 1, blk, D), blk_q),
+                pl.BlockSpec((1, 1, S, D), full),
+                pl.BlockSpec((1, 1, S, D), full),
+                pl.BlockSpec((1, 1, blk, D), blk_q),
+                pl.BlockSpec((1, 1, blk), blk_s),
+                pl.BlockSpec((1, 1, blk), blk_s),
+            ],
+            pl.BlockSpec((1, 1, blk, D), blk_q),
+        ),
+        interpret=interpret,
+        out_shape=jax.ShapeDtypeStruct((B, H, S, D), q4.dtype),
+    )(kidx, kcnt, q4, k4, v4, do4, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal, blk=blk),
+        grid_spec=_grid_spec(
+            2, (B, H, S // blk),
+            [
+                pl.BlockSpec((1, 1, S, D), full),
+                pl.BlockSpec((1, 1, blk, D), blk_q),
+                pl.BlockSpec((1, 1, blk, D), blk_q),
+                pl.BlockSpec((1, 1, S, D), full),
+                pl.BlockSpec((1, 1, S), full2),
+                pl.BlockSpec((1, 1, S), full2),
+            ],
+            [
+                pl.BlockSpec((1, 1, blk, D), blk_q),
+                pl.BlockSpec((1, 1, blk, D), blk_q),
+            ],
+        ),
+        interpret=interpret,
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, S, D), q4.dtype),
+            jax.ShapeDtypeStruct((B, H, S, D), q4.dtype),
+        ],
+    )(qidx, qcnt, q4, k4, v4, do4, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10))
+def _sparse(q4, k4, v4, kidx, kcnt, qidx, qcnt, sm_scale, causal, blk, interpret):
+    o, _ = _fwd(q4, k4, v4, kidx, kcnt, sm_scale, causal, blk, interpret)
+    return o
+
+
+def _sparse_fwd_rule(q4, k4, v4, kidx, kcnt, qidx, qcnt, sm_scale, causal, blk, interpret):
+    o, lse = _fwd(q4, k4, v4, kidx, kcnt, sm_scale, causal, blk, interpret)
+    return o, (q4, k4, v4, o, lse, kidx, kcnt, qidx, qcnt)
+
+
+def _sparse_bwd_rule(sm_scale, causal, blk, interpret, res, do4):
+    q4, k4, v4, o4, lse, kidx, kcnt, qidx, qcnt = res
+    dq, dk, dv = _bwd(q4, k4, v4, o4, lse, do4, kidx, kcnt, qidx, qcnt,
+                      sm_scale, causal, blk, interpret)
+    return dq, dk, dv, None, None, None, None
+
+
+_sparse.defvjp(_sparse_fwd_rule, _sparse_bwd_rule)
+
+
+def block_sparse_attention(
+    q, k, v,
+    layout: np.ndarray,
+    block: int,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    interpret: bool = False,
+):
+    """[B,S,H,D] block-sparse attention under a static [H,nQ,nK] layout."""
+    B, S, H, D = q.shape
+    nQ = S // block
+    assert layout.shape == (H, nQ, nQ), (layout.shape, (H, nQ, nQ))
+    scale = sm_scale if sm_scale is not None else 1.0 / (D**0.5)
+    kidx, kcnt, qidx, qcnt = layout_to_index_lists(np.asarray(layout, bool))
+
+    def to4(x):
+        return x.transpose(0, 2, 1, 3)  # [B,H,S,D]
+
+    o4 = _sparse(
+        to4(q), to4(k), to4(v),
+        jnp.asarray(kidx), jnp.asarray(kcnt), jnp.asarray(qidx), jnp.asarray(qcnt),
+        float(scale), bool(causal), int(block), bool(interpret),
+    )
+    return o4.transpose(0, 2, 1, 3)

@@ -1,0 +1,24 @@
+from .sparse_self_attention import SparseSelfAttention, sparse_attention
+from .sparsity_config import (
+    BigBirdSparsityConfig,
+    BSLongformerSparsityConfig,
+    DenseSparsityConfig,
+    FixedSparsityConfig,
+    SparsityConfig,
+    VariableSparsityConfig,
+    layout_density,
+    layout_to_dense_mask,
+)
+
+__all__ = [
+    "BigBirdSparsityConfig",
+    "BSLongformerSparsityConfig",
+    "DenseSparsityConfig",
+    "FixedSparsityConfig",
+    "SparseSelfAttention",
+    "SparsityConfig",
+    "VariableSparsityConfig",
+    "layout_density",
+    "layout_to_dense_mask",
+    "sparse_attention",
+]

@@ -1,0 +1,94 @@
+"""Sparse self-attention over a block-sparsity config.
+
+Analog of reference ``ops/sparse_attention/sparse_self_attention.py``
+(SparseSelfAttention:11) which dispatches to the Triton block-sparse
+matmul/softmax kernels. Here:
+
+- ``impl='pallas'``: the block-sparse flash kernel
+  (``ops/pallas/block_sparse_attention.py``) — inactive blocks are never
+  touched, compute scales with layout density.
+- ``impl='jnp'``: masked dense attention (exact reference semantics, used for
+  parity tests and CPU).
+- ``impl='auto'``: pallas on TPU, jnp elsewhere.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .sparsity_config import SparsityConfig, layout_to_dense_mask
+
+NEG_INF = -1e30
+
+
+def _dense_masked(q, k, v, mask_hss: np.ndarray, causal: bool, sm_scale: float):
+    """[B,S,H,D] dense attention under an [H,S,S] element mask (reference path)."""
+    B, S, H, D = q.shape
+    scores = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32), k.astype(jnp.float32)) * sm_scale
+    mask = jnp.asarray(mask_hss)[None]  # [1,H,S,S]
+    if causal:
+        tri = jnp.tril(jnp.ones((S, S), bool))
+        mask = mask & tri[None, None]
+    scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    # fully-masked rows (possible in exotic layouts): zero them like flash does
+    any_active = jnp.any(mask, axis=-1, keepdims=True)
+    probs = jnp.where(any_active, probs, 0.0)
+    return jnp.einsum("bhst,bthd->bshd", probs.astype(v.dtype), v)
+
+
+def sparse_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    sparsity_config: SparsityConfig,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    impl: str = "auto",
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """q/k/v: [B, S, H, D] → [B, S, H, D]."""
+    B, S, H, D = q.shape
+    assert H == sparsity_config.num_heads, (H, sparsity_config.num_heads)
+    scale = sm_scale if sm_scale is not None else 1.0 / (D**0.5)
+    layout = sparsity_config.make_layout(S)
+
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "jnp"
+    if impl == "pallas":
+        from ..pallas.block_sparse_attention import block_sparse_attention
+
+        return block_sparse_attention(
+            q, k, v, layout, sparsity_config.block,
+            causal=causal, sm_scale=scale, interpret=interpret,
+        )
+    mask = layout_to_dense_mask(layout, sparsity_config.block)
+    return _dense_masked(q, k, v, mask, causal, scale)
+
+
+class SparseSelfAttention:
+    """Callable module mirroring the reference class surface."""
+
+    def __init__(
+        self,
+        sparsity_config: Optional[SparsityConfig] = None,
+        attn_mask_mode: str = "mul",
+        max_seq_length: int = 2048,
+        impl: str = "auto",
+    ):
+        from .sparsity_config import FixedSparsityConfig
+
+        self.sparsity_config = sparsity_config or FixedSparsityConfig(num_heads=4)
+        self.attn_mask_mode = attn_mask_mode
+        self.max_seq_length = max_seq_length
+        self.impl = impl
+
+    def __call__(self, query, key, value, causal: bool = True, sm_scale: Optional[float] = None):
+        return sparse_attention(
+            query, key, value, self.sparsity_config,
+            causal=causal, sm_scale=sm_scale, impl=self.impl,
+        )

@@ -1,0 +1,160 @@
+"""Sparse attention: layout generators + block-sparse kernel parity.
+
+Reference analog: tests/unit/ops/sparse_attention/ (matmul/softmax kernels vs
+dense reference with tolerance sweeps).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.sparse_attention import (
+    BigBirdSparsityConfig,
+    BSLongformerSparsityConfig,
+    DenseSparsityConfig,
+    FixedSparsityConfig,
+    VariableSparsityConfig,
+    layout_density,
+    sparse_attention,
+)
+from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import _dense_masked
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import layout_to_dense_mask
+
+
+class TestLayouts:
+    def test_dense(self):
+        layout = DenseSparsityConfig(num_heads=2, block=16).make_layout(64)
+        assert layout.shape == (2, 4, 4)
+        assert layout.all()
+
+    def test_fixed_local_plus_global(self):
+        cfg = FixedSparsityConfig(
+            num_heads=2, block=16, num_local_blocks=2, num_global_blocks=1,
+            attention="unidirectional",
+        )
+        layout = cfg.make_layout(128)  # 8 blocks, windows of 2
+        assert layout.shape == (2, 8, 8)
+        # causal: upper triangle empty
+        assert not np.triu(layout[0], 1).any()
+        # diagonal always active (own window)
+        assert all(layout[0, i, i] for i in range(8))
+        # global column: window tails (block 1 of window 0) visible to later rows
+        assert layout[0, 7, 1]
+        # sparser than dense
+        assert layout_density(layout) < 0.6
+
+    def test_fixed_different_layout_per_head(self):
+        cfg = FixedSparsityConfig(
+            num_heads=4, block=16, num_local_blocks=4, num_global_blocks=1,
+            different_layout_per_head=True, num_different_global_patterns=4,
+        )
+        layout = cfg.make_layout(256)
+        assert any(not np.array_equal(layout[0], layout[h]) for h in range(1, 4))
+
+    def test_bslongformer(self):
+        cfg = BSLongformerSparsityConfig(
+            num_heads=2, block=16, num_sliding_window_blocks=3,
+            global_block_indices=[0],
+        )
+        layout = cfg.make_layout(128)
+        assert layout[:, :, 0].all()  # global col
+        assert layout[:, 0, :].all()  # global row
+        for i in range(1, 8):  # sliding window
+            assert layout[0, i, max(0, i - 1) : min(8, i + 2)].all()
+        assert layout_density(layout) < 0.7
+
+    def test_bigbird(self):
+        cfg = BigBirdSparsityConfig(
+            num_heads=2, block=16, num_random_blocks=1,
+            num_sliding_window_blocks=3, num_global_blocks=1,
+        )
+        layout = cfg.make_layout(128)
+        assert layout[:, 0, :].all() and layout[:, -1, :].all()
+        assert layout[:, :, 0].all() and layout[:, :, -1].all()
+
+    def test_variable(self):
+        cfg = VariableSparsityConfig(
+            num_heads=2, block=16, local_window_blocks=[1, 3],
+            global_block_indices=[0], horizontal_global_attention=True,
+        )
+        layout = cfg.make_layout(128)
+        assert layout[0, 1:4, 1:4].all()  # second window (size 3)
+        assert layout[:, 0, :].all()  # horizontal global
+        assert not layout[0, 1, 5]  # outside window and globals
+
+    def test_seq_not_divisible_raises(self):
+        with pytest.raises(ValueError, match="multiple of block"):
+            DenseSparsityConfig(num_heads=1, block=16).make_layout(100)
+
+
+def _rand_qkv(B, S, H, D, seed=0):
+    rs = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rs.randn(B, S, H, D), jnp.float32)
+    return mk(), mk(), mk()
+
+
+class TestSparseAttentionParity:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_pallas_matches_masked_dense(self, causal):
+        B, S, H, D = 2, 128, 2, 32
+        blk = 16
+        q, k, v = _rand_qkv(B, S, H, D)
+        cfg = BSLongformerSparsityConfig(
+            num_heads=H, block=blk, num_sliding_window_blocks=3,
+            global_block_indices=[0],
+        )
+        ref = sparse_attention(q, k, v, cfg, causal=causal, impl="jnp")
+        out = sparse_attention(q, k, v, cfg, causal=causal, impl="pallas", interpret=True)
+        assert np.allclose(np.asarray(ref), np.asarray(out), atol=2e-5), (
+            np.abs(np.asarray(ref) - np.asarray(out)).max()
+        )
+
+    def test_pallas_gradients_match(self):
+        B, S, H, D = 1, 64, 2, 16
+        blk = 16
+        q, k, v = _rand_qkv(B, S, H, D, seed=3)
+        cfg = FixedSparsityConfig(
+            num_heads=H, block=blk, num_local_blocks=2, num_global_blocks=1,
+            attention="unidirectional",
+        )
+
+        def loss_ref(q, k, v):
+            return jnp.sum(sparse_attention(q, k, v, cfg, causal=True, impl="jnp") ** 2)
+
+        def loss_pal(q, k, v):
+            return jnp.sum(
+                sparse_attention(q, k, v, cfg, causal=True, impl="pallas", interpret=True) ** 2
+            )
+
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        g_pal = jax.grad(loss_pal, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(g_ref, g_pal, "qkv"):
+            assert np.allclose(np.asarray(a), np.asarray(b), atol=5e-4), (
+                f"d{name} diff {np.abs(np.asarray(a) - np.asarray(b)).max()}"
+            )
+
+    def test_dense_layout_equals_full_attention(self):
+        B, S, H, D = 1, 64, 2, 16
+        q, k, v = _rand_qkv(B, S, H, D, seed=4)
+        cfg = DenseSparsityConfig(num_heads=H, block=16)
+        out = sparse_attention(q, k, v, cfg, causal=True, impl="jnp")
+        # plain causal attention
+        scores = jnp.einsum("bshd,bthd->bhst", q, k) / np.sqrt(D)
+        tri = jnp.tril(jnp.ones((S, S), bool))
+        scores = jnp.where(tri[None, None], scores, -1e30)
+        ref = jnp.einsum("bhst,bthd->bshd", jax.nn.softmax(scores, -1), v)
+        assert np.allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_module_api(self):
+        from deepspeed_tpu.ops.sparse_attention import SparseSelfAttention
+
+        B, S, H, D = 1, 64, 4, 16
+        q, k, v = _rand_qkv(B, S, H, D, seed=5)
+        attn = SparseSelfAttention(
+            FixedSparsityConfig(num_heads=H, block=16, num_local_blocks=2),
+            impl="jnp",
+        )
+        out = attn(q, k, v, causal=True)
+        assert out.shape == (B, S, H, D)
+        assert np.isfinite(np.asarray(out)).all()
