@@ -1,0 +1,16 @@
+"""Locate the offload-optimizer sidecar file inside a checkpoint tag dir."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def offload_npz_path(load_dir: str, tag: Optional[str]) -> Optional[str]:
+    from ..checkpoint.engine import read_latest_tag
+
+    tag = tag or read_latest_tag(load_dir)
+    if tag is None:
+        return None
+    p = os.path.join(load_dir, tag, "offload_optimizer.npz")
+    return p if os.path.exists(p) else None

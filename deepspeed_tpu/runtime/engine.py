@@ -202,11 +202,49 @@ class DeepSpeedEngine:
         self.gradient_accumulation_steps_value = config.gradient_accumulation_steps
         self.train_batch_size_value = config.train_batch_size
 
+        # --- ZeRO-Offload / Infinity host optimizer tier
+        off = zcfg.offload_optimizer
+        self.offload_enabled = off.device in ("cpu", "nvme") and not self.onebit
+        self._offload = None
+        if self.offload_enabled:
+            if config.fp16.enabled:
+                raise ValueError(
+                    "offload_optimizer currently supports bf16/fp32 steps "
+                    "(host-side loss scaling lands with the fp16 offload path)"
+                )
+            from .offload.offload_engine import HostOffloadOptimizer
+
+            p = (opt_cfg.params if opt_cfg else None) or {}
+            self._offload = HostOffloadOptimizer(
+                self.state.params,
+                lr_schedule=self.lr_schedule,
+                betas=tuple(p.get("betas", (0.9, 0.999))),
+                eps=float(p.get("eps", 1e-8)),
+                weight_decay=float(p.get("weight_decay", 0.0)),
+                device=off.device,
+                nvme_path=off.nvme_path,
+                sub_group_size=int(zcfg.sub_group_size),
+                adamw_mode=bool(p.get("adam_w_mode", True)),
+            )
+            # device keeps only the compute-dtype copy; the fp32 master +
+            # moments live host-side (HBM cost drops from 16 to 2 B/param)
+            self.state = self.state._replace(
+                params=_cast_params(self.state.params, self.compute_dtype),
+                opt_state=(),
+            )
+            self.state_shardings = self.state_shardings._replace(opt_state=())
+
         # --- compiled steps
         donate = (0,) if config.tpu.donate_state else ()
         if self.onebit:
             self._onebit_step_cache: Dict[Tuple, Callable] = {}
             self._train_step = self._onebit_dispatch
+        elif self.offload_enabled:
+            self._grad_step = jax.jit(
+                self._make_grad_step(),
+                out_shardings=(None, self.grad_shardings, None),
+            )
+            self._train_step = self._offload_dispatch
         else:
             self._train_step = jax.jit(
                 self._make_train_step(),
@@ -398,6 +436,74 @@ class DeepSpeedEngine:
             return new_state, metrics
 
         return train_step
+
+    # ------------------------------------------------------------------
+    # ZeRO-Offload path: jitted (loss, grads) + host optimizer step
+    # ------------------------------------------------------------------
+    def _make_grad_step(self):
+        """Device program computing (loss, clipped mean grads, gnorm) only —
+        the optimizer update happens on host (reference cpu-offload split:
+        backward on device, DeepSpeedCPUAdam on host)."""
+        model = self.module
+        compute_dtype = self.compute_dtype
+        acc_dtype = self.grad_accum_dtype
+        grad_shardings = self.grad_shardings
+        gas = self.gradient_accumulation_steps_value
+        clip = self.config.gradient_clipping
+
+        def grad_fn_inner(params, micro, mrng):
+            loss, _m = model.loss_fn(_cast_params(params, compute_dtype), micro, mrng, True)
+            return loss.astype(jnp.float32)
+
+        grad_fn = jax.value_and_grad(grad_fn_inner)
+
+        def grad_step(params, batch, rng):
+            def micro_step(carry, i):
+                grads_acc, loss_acc = carry
+                micro = jax.tree.map(lambda x: x[i], batch)
+                loss, grads = grad_fn(params, micro, jax.random.fold_in(rng, i))
+                grads_acc = jax.tree.map(lambda a, g: a + g.astype(acc_dtype), grads_acc, grads)
+                grads_acc = jax.lax.with_sharding_constraint(grads_acc, grad_shardings)
+                return (grads_acc, loss_acc + loss), None
+
+            zero = jax.tree.map(lambda p: jnp.zeros(p.shape, acc_dtype), params)
+            zero = jax.lax.with_sharding_constraint(zero, grad_shardings)
+            (grads, loss_sum), _ = jax.lax.scan(
+                micro_step, (zero, jnp.float32(0.0)), jnp.arange(gas)
+            )
+            grads = jax.tree.map(lambda g: g.astype(jnp.float32) / gas, grads)
+            gnorm = global_norm(grads)
+            if clip > 0.0:
+                coef = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+                grads = jax.tree.map(lambda g: g * coef, grads)
+            return loss_sum / gas, grads, gnorm
+
+        return grad_step
+
+    def _offload_dispatch(self, state: "TrainState", batch: PyTree, rng):
+        loss, grads, gnorm = self._grad_step(state.params, batch, rng)
+        step = self.global_steps
+        # host step over fp32 master (+ NVMe subgroup streaming when tiered)
+        new_params = self._offload.step(
+            jax.device_get(grads), step, compute_dtype=self.compute_dtype
+        )
+        new_params = jax.tree.map(jax.device_put, new_params, self.param_shardings)
+        new_state = TrainState(
+            params=new_params,
+            opt_state=state.opt_state,
+            loss_scale=state.loss_scale,
+            global_step=state.global_step + 1,
+            skipped_steps=state.skipped_steps,
+        )
+        metrics = {
+            "loss": loss,
+            "grad_norm": gnorm,
+            "loss_scale": jnp.float32(1.0),
+            "overflow": jnp.bool_(False),
+            "lr": jnp.asarray(self.lr_schedule(state.global_step), jnp.float32),
+            "global_step": new_state.global_step,
+        }
+        return new_state, metrics
 
     # ------------------------------------------------------------------
     # step construction
@@ -721,6 +827,8 @@ class DeepSpeedEngine:
             save_latest=save_latest,
             async_save=self.config.checkpoint.async_save,
         )
+        if self._offload is not None:
+            np.savez(os.path.join(str(path), "offload_optimizer.npz"), **self._offload.state_dict())
         log_dist(f"saved checkpoint: {path}")
         return path
 
@@ -733,5 +841,11 @@ class DeepSpeedEngine:
         )
         self.state = state
         self.global_steps = int(client_state.get("global_steps", self.get_global_step()))
+        if self._offload is not None and load_optimizer_states:
+            from .checkpoint_utils_offload import offload_npz_path
+
+            npz = offload_npz_path(load_dir, tag)
+            if npz is not None:
+                self._offload.load_state_dict(dict(np.load(npz)))
         log_dist(f"loaded checkpoint from {load_dir} (tag={tag or 'latest'})")
         return load_dir, client_state

@@ -1,0 +1,3 @@
+from .offload_engine import HostOffloadOptimizer
+
+__all__ = ["HostOffloadOptimizer"]

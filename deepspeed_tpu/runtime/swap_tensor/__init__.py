@@ -1,0 +1,13 @@
+from .async_swapper import AsyncTensorSwapper
+from .partitioned_param_swapper import AsyncPartitionedParameterSwapper
+from .partitioned_optimizer_swapper import (
+    PartitionedOptimizerSwapper,
+    PipelinedOptimizerSwapper,
+)
+
+__all__ = [
+    "AsyncTensorSwapper",
+    "AsyncPartitionedParameterSwapper",
+    "PartitionedOptimizerSwapper",
+    "PipelinedOptimizerSwapper",
+]

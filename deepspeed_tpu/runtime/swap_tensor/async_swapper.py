@@ -1,0 +1,44 @@
+"""Generic async tensor swap-out queue over the native aio engine.
+
+Analog of reference ``runtime/swap_tensor/async_swapper.py``
+(AsyncTensorSwapper:17): accepts host buffers to persist to NVMe, issues the
+writes asynchronously through the C++ thread pool (``csrc/aio``), and lets
+callers drain completions when they need the buffers back.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...ops.aio import AsyncIOHandle
+
+
+class AsyncTensorSwapper:
+    def __init__(self, aio_handle: Optional[AsyncIOHandle] = None, numel_alignment: int = 1024):
+        self.handle = aio_handle or AsyncIOHandle()
+        self.numel_alignment = numel_alignment
+        self.pending_paths: List[str] = []
+        self.bytes_written = 0
+        self.bytes_read = 0
+
+    def swap_out_tensors(self, tensors: List[np.ndarray], paths: List[str]) -> None:
+        """Queue async writes; buffers must stay alive until ``synchronize``."""
+        for arr, path in zip(tensors, paths):
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            a = np.ascontiguousarray(arr)
+            self.handle.async_pwrite(a, path)
+            self.pending_paths.append(path)
+            self.bytes_written += a.nbytes
+
+    def swap_in_tensors(self, buffers: List[np.ndarray], paths: List[str]) -> None:
+        for buf, path in zip(buffers, paths):
+            self.handle.async_pread(buf, path)
+            self.bytes_read += buf.nbytes
+
+    def synchronize(self) -> int:
+        n = self.handle.wait()
+        self.pending_paths.clear()
+        return n

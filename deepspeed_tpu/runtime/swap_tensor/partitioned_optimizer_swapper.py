@@ -1,0 +1,129 @@
+"""Optimizer-state subgroup swappers (ZeRO-Infinity optimizer tier).
+
+Analogs of reference ``partitioned_optimizer_swapper.py``
+(PartitionedOptimizerSwapper:27) and ``pipelined_optimizer_swapper.py``
+(PipelinedOptimizerSwapper, 279 LoC — overlaps the swap of subgroup N±1 with
+the optimizer step of subgroup N).
+
+Layout: the flat fp32 master parameters and each optimizer moment are split
+into fixed-size element subgroups; subgroup ``i`` persists as one contiguous
+NVMe file ``[master | m | v | step]``. The pipelined swapper runs read and
+write on separate aio handles so ``step(i)`` overlaps ``prefetch(i+1)`` and
+``writeback(i-1)`` — the reference's three-stage pipeline.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ...ops.aio import AsyncIOHandle
+
+
+class PartitionedOptimizerSwapper:
+    """Synchronous subgroup swapper: swap in → step → swap out."""
+
+    def __init__(self, swap_dir: str, n_tensors: int, aio_handle: Optional[AsyncIOHandle] = None):
+        self.swap_dir = os.path.join(swap_dir, "optimizer")
+        os.makedirs(self.swap_dir, exist_ok=True)
+        self.handle = aio_handle or AsyncIOHandle()
+        self.n_tensors = n_tensors  # tensors per subgroup record (master + moments)
+        self._numel: Dict[int, int] = {}
+        self._buffers: Dict[int, np.ndarray] = {}
+
+    def _path(self, gid: int) -> str:
+        return os.path.join(self.swap_dir, f"subgroup_{gid}.bin")
+
+    def _record_numel(self, numel: int) -> int:
+        # pad each tensor slot to 1024 elements for O_DIRECT friendliness
+        per = ((numel + 1023) // 1024) * 1024
+        return per * self.n_tensors
+
+    def initialize_subgroup(self, gid: int, tensors: List[np.ndarray]) -> None:
+        assert len(tensors) == self.n_tensors
+        numel = tensors[0].size
+        self._numel[gid] = numel
+        buf = self.handle.new_aligned_buffer(self._record_numel(numel) * 4).view(np.float32)
+        per = self._record_numel(numel) // self.n_tensors
+        for i, t in enumerate(tensors):
+            buf[i * per : i * per + numel] = t.reshape(-1)
+        self._buffers[gid] = buf
+        self.swap_out(gid, release=False)
+
+    def swap_in(self, gid: int, async_op: bool = False) -> None:
+        if gid not in self._buffers:
+            buf = self.handle.new_aligned_buffer(
+                self._record_numel(self._numel[gid]) * 4
+            ).view(np.float32)
+            self.handle.async_pread(buf, self._path(gid))
+            self._buffers[gid] = buf
+            if not async_op:
+                self.handle.wait()
+
+    def synchronize(self) -> None:
+        self.handle.wait()
+
+    def tensors(self, gid: int) -> List[np.ndarray]:
+        """Views into the DRAM record: [master, moment_1, ..]."""
+        numel = self._numel[gid]
+        per = self._record_numel(numel) // self.n_tensors
+        buf = self._buffers[gid]
+        return [buf[i * per : i * per + numel] for i in range(self.n_tensors)]
+
+    def swap_out(self, gid: int, release: bool = True, async_op: bool = False) -> None:
+        self.handle.async_pwrite(self._buffers[gid], self._path(gid))
+        if not async_op:
+            self.handle.wait()
+            if release:
+                del self._buffers[gid]
+
+    def dram_bytes(self) -> int:
+        return sum(b.nbytes for b in self._buffers.values())
+
+
+class PipelinedOptimizerSwapper(PartitionedOptimizerSwapper):
+    """Three-stage overlap: prefetch(i+1) ∥ step(i) ∥ writeback(i-1).
+
+    Separate read/write aio handles (each its own C++ thread pool) so the two
+    streams never serialize behind each other — the reference's
+    swap_in_gradients/swap_out_optimizer overlap (pipelined_optimizer_swapper
+    .py:150-region).
+    """
+
+    def __init__(self, swap_dir: str, n_tensors: int,
+                 read_handle: Optional[AsyncIOHandle] = None,
+                 write_handle: Optional[AsyncIOHandle] = None):
+        super().__init__(swap_dir, n_tensors, aio_handle=read_handle)
+        self.write_handle = write_handle or AsyncIOHandle()
+        self._write_pending: List[int] = []
+
+    def swap_out(self, gid: int, release: bool = True, async_op: bool = False) -> None:
+        self.write_handle.async_pwrite(self._buffers[gid], self._path(gid))
+        if async_op:
+            self._write_pending.append(gid) if release else None
+        else:
+            self.write_handle.wait()
+            if release:
+                del self._buffers[gid]
+
+    def drain_writes(self) -> None:
+        self.write_handle.wait()
+        for gid in self._write_pending:
+            del self._buffers[gid]
+        self._write_pending.clear()
+
+    def run_pipeline(self, gids: List[int], step_fn: Callable[[int, List[np.ndarray]], None]) -> None:
+        """Execute ``step_fn(gid, tensors)`` over every subgroup with swap
+        overlap. ``step_fn`` mutates the tensor views in place."""
+        if not gids:
+            return
+        self.swap_in(gids[0], async_op=True)
+        for idx, gid in enumerate(gids):
+            self.synchronize()  # current subgroup resident
+            if idx + 1 < len(gids):
+                self.swap_in(gids[idx + 1], async_op=True)  # prefetch next
+            step_fn(gid, self.tensors(gid))
+            self.swap_out(gid, release=True, async_op=True)  # write back behind
+        self.drain_writes()
