@@ -1,3 +1,4 @@
+from .deepspeed_checkpoint import DeepSpeedCheckpoint
 from .engine import (
     CheckpointEngine,
     OrbaxCheckpointEngine,
@@ -5,3 +6,19 @@ from .engine import (
     read_latest_tag,
     save_train_state,
 )
+from .reshape import merge_tp_state_dicts, reshape_tp, split_tp_state_dict
+from .universal_checkpoint import convert_to_universal, load_universal
+
+__all__ = [
+    "CheckpointEngine",
+    "DeepSpeedCheckpoint",
+    "OrbaxCheckpointEngine",
+    "convert_to_universal",
+    "load_train_state",
+    "load_universal",
+    "merge_tp_state_dicts",
+    "read_latest_tag",
+    "reshape_tp",
+    "save_train_state",
+    "split_tp_state_dict",
+]

@@ -1,0 +1,65 @@
+"""Checkpoint directory introspection.
+
+Analog of reference ``deepspeed/checkpoint/deepspeed_checkpoint.py``
+(DeepSpeedCheckpoint:37): enumerate tags, read client state, inspect the
+stored tree, and answer "what parallelism did this run use" — except our
+checkpoints are *logical* (orbax/tensorstore sharded arrays), so the
+dp/tp/pp degrees recorded in client_state are provenance metadata, not a
+constraint on the restore mesh.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from .engine import LATEST_FILE, read_latest_tag
+
+
+class DeepSpeedCheckpoint:
+    def __init__(self, ckpt_dir: str, tag: Optional[str] = None):
+        self.dir = os.path.abspath(ckpt_dir)
+        if not os.path.isdir(self.dir):
+            raise FileNotFoundError(self.dir)
+        self.tag = tag or read_latest_tag(self.dir)
+        if self.tag is None:
+            tags = self.tags()
+            if not tags:
+                raise FileNotFoundError(f"no checkpoint tags in {self.dir}")
+            self.tag = tags[-1]
+        self.base = os.path.join(self.dir, self.tag)
+
+    def tags(self) -> List[str]:
+        return sorted(
+            d for d in os.listdir(self.dir)
+            if os.path.isdir(os.path.join(self.dir, d, "state"))
+        )
+
+    def client_state(self) -> Dict[str, Any]:
+        p = os.path.join(self.base, "client_state.json")
+        if os.path.exists(p):
+            with open(p) as fh:
+                return json.load(fh)
+        return {}
+
+    def global_steps(self) -> Optional[int]:
+        return self.client_state().get("global_steps")
+
+    def state_path(self) -> str:
+        return os.path.join(self.base, "state")
+
+    def has_offload_state(self) -> bool:
+        return os.path.exists(os.path.join(self.base, "offload_optimizer.npz"))
+
+    def tree_metadata(self) -> Any:
+        """Structure/shape/dtype metadata of the stored tree (no data read)."""
+        import orbax.checkpoint as ocp
+
+        return ocp.StandardCheckpointer().metadata(self.state_path())
+
+    def restore_numpy(self) -> Any:
+        """Restore the whole tree as host numpy arrays (no mesh needed)."""
+        import orbax.checkpoint as ocp
+
+        return ocp.StandardCheckpointer().restore(self.state_path())

@@ -1,0 +1,81 @@
+"""Megatron-style TP shard merge/split (state-dict factory analog).
+
+Analog of reference ``runtime/state_dict_factory.py`` (SDLoaderFactory:20,
+MegatronSDLoader:214) and ``checkpoint/reshape_meg_2d.py``: the reference
+merges/splits ``mp_rank_XX`` torch checkpoint shards when the restore TP
+degree differs from the save degree — concatenating column-parallel tensors
+(QKV, fc1) on the output dim, row-parallel tensors (attn out proj, fc2) on
+the input dim, vocab-parallel embeddings on the vocab dim.
+
+These utilities perform the same merge on plain numpy state dicts (e.g. to
+feed MegatronLayerPolicy from multi-rank Megatron checkpoints) and the
+inverse split (to emit TP-sharded dicts for torch consumers). Our own
+checkpoints never need this — they store logical arrays.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Sequence
+
+import numpy as np
+
+# classification by megatron naming (reference MegatronSDLoader.merge_state_dict)
+COLUMN_PARALLEL_PATTERNS = (  # concat on torch dim 0 (output features)
+    r"attention\.query_key_value\.weight$",
+    r"attention\.query_key_value\.bias$",
+    r"mlp\.dense_h_to_4h\.weight$",
+    r"mlp\.dense_h_to_4h\.bias$",
+)
+ROW_PARALLEL_PATTERNS = (  # concat on torch dim 1 (input features)
+    r"attention\.dense\.weight$",
+    r"mlp\.dense_4h_to_h\.weight$",
+)
+VOCAB_PARALLEL_PATTERNS = (r"word_embeddings\.weight$",)
+
+
+def _axis_for(key: str) -> int | None:
+    for pat in COLUMN_PARALLEL_PATTERNS + VOCAB_PARALLEL_PATTERNS:
+        if re.search(pat, key):
+            return 0
+    for pat in ROW_PARALLEL_PATTERNS:
+        if re.search(pat, key):
+            return 1
+    return None  # replicated (layernorms, biases of row-parallel, positions)
+
+
+def merge_tp_state_dicts(shards: Sequence[Dict[str, Any]]) -> Dict[str, np.ndarray]:
+    """Merge TP-rank state dicts into the full model (MegatronSDLoader merge)."""
+    assert shards, "no shards"
+    out: Dict[str, np.ndarray] = {}
+    for key in shards[0]:
+        parts = [np.asarray(sd[key]) for sd in shards]
+        axis = _axis_for(key)
+        if axis is None or parts[0].ndim == 0:
+            out[key] = parts[0]
+        elif axis < parts[0].ndim:
+            out[key] = np.concatenate(parts, axis=axis)
+        else:  # 1-D tensor classified as row-parallel weight: replicated bias
+            out[key] = parts[0]
+    return out
+
+
+def split_tp_state_dict(sd: Dict[str, Any], tp: int) -> List[Dict[str, np.ndarray]]:
+    """Inverse: split a full state dict into ``tp`` Megatron-style shards."""
+    shards: List[Dict[str, np.ndarray]] = [dict() for _ in range(tp)]
+    for key, val in sd.items():
+        arr = np.asarray(val)
+        axis = _axis_for(key)
+        if axis is None or arr.ndim == 0 or axis >= arr.ndim or arr.shape[axis] % tp:
+            for s in shards:
+                s[key] = arr
+        else:
+            for r, piece in enumerate(np.split(arr, tp, axis=axis)):
+                shards[r][key] = piece
+    return shards
+
+
+def reshape_tp(shards: Sequence[Dict[str, Any]], new_tp: int) -> List[Dict[str, np.ndarray]]:
+    """old-TP shards → new-TP shards (reshape_meg_2d_parallel analog for the
+    TP axis; dp reshape is a no-op for model weights)."""
+    return split_tp_state_dict(merge_tp_state_dicts(shards), new_tp)

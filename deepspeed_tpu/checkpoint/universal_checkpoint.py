@@ -1,0 +1,63 @@
+"""Universal (reshapable) checkpoints.
+
+Analog of reference ``deepspeed/checkpoint/universal_checkpoint.py`` +
+``reshape_meg_2d.py`` + ``zero_checkpoint.py``: the reference must offline-
+convert per-rank torch shard files into a per-parameter "universal" layout
+(hp fragments linked by utils/tensor_fragment.py) before a job may resume on
+a different dp/tp/pp grid.
+
+On TPU this machinery mostly *disappears by design*: checkpoints store
+logically-global arrays (tensorstore), so ``load_train_state`` onto any mesh
+IS the universal restore — the reshape test in tests/unit/test_checkpoint_
+tools.py saves on dp=8 and restores on dp=4×tp=2 byte-identically.
+
+What remains useful and is provided here:
+- ``convert_to_universal``: strip optimizer state / cast to fp32 / re-save a
+  consolidated portable tree (for sharing weights across frameworks).
+- ``load_universal``: restore such a tree onto any engine mesh.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from .deepspeed_checkpoint import DeepSpeedCheckpoint
+from .engine import OrbaxCheckpointEngine
+
+PyTree = Any
+
+UNIVERSAL_DIR = "universal"
+
+
+def convert_to_universal(
+    ckpt_dir: str,
+    tag: Optional[str] = None,
+    output_dir: Optional[str] = None,
+    params_only: bool = True,
+    dtype=np.float32,
+) -> str:
+    """Consolidate a training checkpoint into a portable fp32 tree on disk."""
+    ck = DeepSpeedCheckpoint(ckpt_dir, tag)
+    tree = ck.restore_numpy()
+    if params_only and isinstance(tree, dict) and "params" in tree:
+        tree = tree["params"]
+    elif params_only and hasattr(tree, "params"):
+        tree = tree.params
+
+    def cast(x):
+        a = np.asarray(x)
+        return a.astype(dtype) if np.issubdtype(a.dtype, np.floating) else a
+
+    tree = jax.tree.map(cast, tree)
+    out = output_dir or os.path.join(ck.base, UNIVERSAL_DIR)
+    OrbaxCheckpointEngine().save(out, tree)
+    return out
+
+
+def load_universal(universal_dir: str, abstract_params: PyTree) -> PyTree:
+    """Restore a universal tree onto the engine's current mesh/shardings."""
+    return OrbaxCheckpointEngine().load(universal_dir, abstract_params)
