@@ -1,0 +1,4 @@
+from .autotuner import Autotuner
+from .tuner import GridSearchTuner, ModelBasedTuner, RandomTuner
+
+__all__ = ["Autotuner", "GridSearchTuner", "ModelBasedTuner", "RandomTuner"]

@@ -1,0 +1,115 @@
+"""Autotuner: sweep ZeRO stage / micro-batch configs, measure, pick the best.
+
+Analog of reference ``deepspeed/autotuning/autotuner.py`` (Autotuner:26,
+2760 LoC with ResourceManager-launched experiment jobs). The reference forks
+whole training jobs per experiment because torch state is process-bound; a
+JAX single-controller retunes *in process* — each trial builds an engine,
+measures steady-state throughput of the compiled step, frees it, and moves
+on. OOM during compile/run marks the config infeasible (the reference's
+micro-batch binary sweep, run_tuning_micro_batch_sizes:744).
+
+Metric: samples/sec (reference ``throughput``); results land in
+``autotuning_results.json`` with the winning ds_config.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..utils.logging import log_dist
+from .tuner import GridSearchTuner, ModelBasedTuner, RandomTuner
+
+TUNERS = {"gridsearch": GridSearchTuner, "random": RandomTuner, "model_based": ModelBasedTuner}
+
+
+class Autotuner:
+    def __init__(
+        self,
+        model_factory,  # () -> ModuleSpec
+        base_config: Dict[str, Any],
+        make_batch,  # (train_batch_size) -> host batch pytree
+        mesh=None,
+        zero_stages: Sequence[int] = (0, 1, 2, 3),
+        micro_batches: Sequence[int] = (1, 2, 4, 8),
+        steps_per_trial: int = 3,
+        tuner_type: str = "gridsearch",
+        results_dir: str = "autotuning_results",
+    ):
+        self.model_factory = model_factory
+        self.base_config = base_config
+        self.make_batch = make_batch
+        self.mesh = mesh
+        self.zero_stages = list(zero_stages)
+        self.micro_batches = list(micro_batches)
+        self.steps_per_trial = steps_per_trial
+        self.tuner_type = tuner_type
+        self.results_dir = results_dir
+
+    def _experiments(self) -> List[Dict[str, Any]]:
+        return [
+            {"zero_stage": z, "micro_batch": m}
+            for z, m in itertools.product(self.zero_stages, self.micro_batches)
+        ]
+
+    def _run_experiment(self, exp: Dict[str, Any]) -> float:
+        """Returns samples/sec (−inf when infeasible)."""
+        from ..runtime.config import DeepSpeedConfig
+        from ..runtime.engine import DeepSpeedEngine
+
+        cfg = json.loads(json.dumps(self.base_config))  # deep copy
+        cfg["train_micro_batch_size_per_gpu"] = exp["micro_batch"]
+        cfg.setdefault("zero_optimization", {})["stage"] = exp["zero_stage"]
+        try:
+            engine = DeepSpeedEngine(
+                self.model_factory(), DeepSpeedConfig.load(cfg, dp_world_size=None),
+                mesh=self.mesh,
+            )
+            batch = self.make_batch(engine.train_batch_size)
+            m = engine.train_batch(batch)  # compile + warmup
+            jax.block_until_ready(m["loss"])
+            t0 = time.perf_counter()
+            for _ in range(self.steps_per_trial):
+                m = engine.train_batch(batch)
+            jax.block_until_ready(m["loss"])
+            dt = time.perf_counter() - t0
+            tput = engine.train_batch_size * self.steps_per_trial / dt
+            log_dist(f"autotuner: {exp} → {tput:.1f} samples/s")
+            return float(tput)
+        except (RuntimeError, ValueError, MemoryError) as e:
+            log_dist(f"autotuner: {exp} infeasible ({type(e).__name__}: {e})")
+            return float("-inf")
+
+    def tune(self, max_trials: Optional[int] = None) -> Dict[str, Any]:
+        exps = self._experiments()
+        tuner_cls = TUNERS[self.tuner_type]
+        kwargs = {}
+        if self.tuner_type == "model_based":
+            kwargs = {"features": ["zero_stage", "micro_batch"]}
+        tuner = tuner_cls(exps, self._run_experiment, **kwargs)
+        best_exp, best_metric = tuner.tune(max_trials)
+        result = {
+            "best": best_exp,
+            "throughput": best_metric,
+            "trials": [
+                {"exp": e, "throughput": m if np.isfinite(m) else None}
+                for e, m in tuner.results
+            ],
+        }
+        os.makedirs(self.results_dir, exist_ok=True)
+        with open(os.path.join(self.results_dir, "autotuning_results.json"), "w") as fh:
+            json.dump(result, fh, indent=2)
+        if best_exp is not None:
+            best_cfg = json.loads(json.dumps(self.base_config))
+            best_cfg["train_micro_batch_size_per_gpu"] = best_exp["micro_batch"]
+            best_cfg.setdefault("zero_optimization", {})["stage"] = best_exp["zero_stage"]
+            with open(os.path.join(self.results_dir, "ds_config_optimal.json"), "w") as fh:
+                json.dump(best_cfg, fh, indent=2)
+            result["ds_config"] = best_cfg
+        return result

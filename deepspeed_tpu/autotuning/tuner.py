@@ -1,0 +1,89 @@
+"""Experiment-selection strategies for the autotuner.
+
+Analogs of reference ``autotuning/tuner/index_based_tuner.py``
+(RandomTuner:6, GridSearchTuner:21) and ``model_based_tuner.py``
+(ModelBasedTuner:14 with XGBoostCostModel:9). XGBoost is not in the TPU
+image; the cost model here is a least-squares polynomial over the numeric
+config features — the same explore/exploit structure with a dependency-free
+estimator.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+Experiment = Dict[str, Any]
+
+
+class BaseTuner:
+    def __init__(self, exps: Sequence[Experiment], metric_fn: Callable[[Experiment], float]):
+        self.all_exps = list(exps)
+        self.metric_fn = metric_fn
+        self.results: List[Tuple[Experiment, float]] = []
+        self.best_exp: Optional[Experiment] = None
+        self.best_metric = -np.inf
+
+    def _record(self, exp: Experiment, metric: float) -> None:
+        self.results.append((exp, metric))
+        if metric > self.best_metric:
+            self.best_metric = metric
+            self.best_exp = exp
+
+    def tune(self, max_trials: Optional[int] = None) -> Tuple[Optional[Experiment], float]:
+        for exp in self.order(max_trials):
+            self._record(exp, self.metric_fn(exp))
+        return self.best_exp, self.best_metric
+
+    def order(self, max_trials: Optional[int]) -> List[Experiment]:
+        raise NotImplementedError
+
+
+class GridSearchTuner(BaseTuner):
+    def order(self, max_trials=None):
+        return self.all_exps[: max_trials or len(self.all_exps)]
+
+
+class RandomTuner(BaseTuner):
+    def __init__(self, exps, metric_fn, seed: int = 0):
+        super().__init__(exps, metric_fn)
+        self.seed = seed
+
+    def order(self, max_trials=None):
+        rng = random.Random(self.seed)
+        exps = list(self.all_exps)
+        rng.shuffle(exps)
+        return exps[: max_trials or len(exps)]
+
+
+class ModelBasedTuner(BaseTuner):
+    """Measure a seed set, fit a quadratic cost model over numeric features,
+    then evaluate only the predicted-best remainder."""
+
+    def __init__(self, exps, metric_fn, features: Sequence[str], seed_trials: int = 3, top_k: int = 2):
+        super().__init__(exps, metric_fn)
+        self.features = list(features)
+        self.seed_trials = seed_trials
+        self.top_k = top_k
+
+    def _featurize(self, exp: Experiment) -> np.ndarray:
+        x = np.asarray([float(exp[f]) for f in self.features])
+        return np.concatenate([[1.0], x, x * x])
+
+    def tune(self, max_trials: Optional[int] = None):
+        seed = self.all_exps[: self.seed_trials]
+        rest = self.all_exps[self.seed_trials :]
+        for exp in seed:
+            self._record(exp, self.metric_fn(exp))
+        if rest and len(self.results) >= 2:
+            X = np.stack([self._featurize(e) for e, _ in self.results])
+            y = np.asarray([m for _, m in self.results])
+            coef, *_ = np.linalg.lstsq(X, y, rcond=None)
+            preds = [(float(self._featurize(e) @ coef), e) for e in rest]
+            preds.sort(key=lambda t: -t[0])
+            budget = self.top_k if max_trials is None else max(0, max_trials - len(seed))
+            for _, exp in preds[:budget]:
+                self._record(exp, self.metric_fn(exp))
+        return self.best_exp, self.best_metric

@@ -1,0 +1,19 @@
+from .basic_layer import (
+    head_pruning_mask,
+    quantize_weight_ste,
+    row_pruning_mask,
+    sparse_pruning_mask,
+)
+from .compress import CompressionScheduler, apply_compression, init_compression
+from .scheduler import compression_scheduler_from_config
+
+__all__ = [
+    "CompressionScheduler",
+    "apply_compression",
+    "compression_scheduler_from_config",
+    "head_pruning_mask",
+    "init_compression",
+    "quantize_weight_ste",
+    "row_pruning_mask",
+    "sparse_pruning_mask",
+]

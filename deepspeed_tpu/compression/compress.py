@@ -1,0 +1,129 @@
+"""Compression orchestration over param trees.
+
+Analog of reference ``deepspeed/compression/compress.py``
+(init_compression:97, redundancy_clean:127): walk the model, attach
+compression specs to matching modules, apply them on schedule. Here the
+"module walk" is a path-pattern match over the param pytree, and
+``apply_compression`` returns a new tree (masks and/or fake-quantized
+weights) — pure-functional, jit-compatible.
+
+Config shape (reference ``compression_training`` section vocabulary):
+    {
+      "weight_quantization": {"enabled": true, "bits": 8, "modules": ["attn", "mlp"], "start_step": 100},
+      "sparse_pruning":      {"enabled": true, "ratio": 0.5, "modules": ["mlp"], "start_step": 200},
+      "row_pruning":         {"enabled": false, "ratio": 0.25, "modules": [...]},
+      "head_pruning":        {"enabled": false, "ratio": 0.25, "num_heads": 12, "modules": [...]}
+    }
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .basic_layer import (
+    head_pruning_mask,
+    quantize_weight_ste,
+    row_pruning_mask,
+    sparse_pruning_mask,
+)
+
+PyTree = Any
+
+
+def _leaf_paths(tree: PyTree) -> List[Tuple[str, Any]]:
+    out = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        name = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        out.append((name, leaf))
+    return out
+
+
+def _matches(path: str, modules: List[str]) -> bool:
+    return any(m in path for m in modules) if modules else True
+
+
+@dataclass
+class CompressionScheduler:
+    """Tracks which techniques are active at a given step (reference
+    compression/scheduler.py)."""
+
+    config: Dict[str, Any] = field(default_factory=dict)
+
+    def active(self, technique: str, step: int) -> bool:
+        t = self.config.get(technique, {})
+        if not t.get("enabled", False):
+            return False
+        return step >= int(t.get("start_step", 0)) and (
+            "end_step" not in t or step < int(t["end_step"])
+        )
+
+
+def init_compression(params: PyTree, config: Dict[str, Any]) -> Dict[str, PyTree]:
+    """Precompute pruning masks from the current weights.
+
+    Returns {"sparse": mask_tree, "row": ..., "head": ...} with None where a
+    technique is disabled; masks are static once computed (reference
+    fix_compression semantics)."""
+    masks: Dict[str, Optional[PyTree]] = {}
+
+    def build(technique, fn):
+        t = config.get(technique, {})
+        if not t.get("enabled", False):
+            return None
+        modules = t.get("modules", [])
+
+        def visit(path, leaf):
+            if hasattr(leaf, "ndim") and leaf.ndim >= 2 and _matches(path, modules):
+                return fn(leaf, t)
+            return None
+
+        flat = [(p, l) for p, l in _leaf_paths(params)]
+        return {p: visit(p, l) for p, l in flat}
+
+    masks["sparse"] = build("sparse_pruning", lambda w, t: sparse_pruning_mask(w, float(t.get("ratio", 0.5))))
+    masks["row"] = build("row_pruning", lambda w, t: row_pruning_mask(w, float(t.get("ratio", 0.25))))
+    masks["head"] = build(
+        "head_pruning",
+        lambda w, t: head_pruning_mask(w, float(t.get("ratio", 0.25)), int(t.get("num_heads", 12))),
+    )
+    return masks
+
+
+def apply_compression(
+    params: PyTree,
+    config: Dict[str, Any],
+    masks: Optional[Dict[str, PyTree]] = None,
+    step: int = 0,
+) -> PyTree:
+    """Return the compressed view of ``params`` for this step (QAT forward /
+    redundancy_clean when all techniques are past start_step)."""
+    sched = CompressionScheduler(config)
+    flat = _leaf_paths(params)
+    q = config.get("weight_quantization", {})
+    q_on = sched.active("weight_quantization", step)
+    out = {}
+    for path, leaf in flat:
+        w = leaf
+        if masks:
+            for kind in ("sparse", "row", "head"):
+                tech = {"sparse": "sparse_pruning", "row": "row_pruning", "head": "head_pruning"}[kind]
+                mtree = masks.get(kind)
+                if mtree and mtree.get(path) is not None and sched.active(tech, step):
+                    w = w * mtree[path].astype(w.dtype)
+        if q_on and hasattr(w, "ndim") and w.ndim >= 2 and _matches(path, q.get("modules", [])):
+            w = quantize_weight_ste(w, int(q.get("bits", 8)), bool(q.get("symmetric", True)))
+        out[path] = w
+    # rebuild tree
+    leaves_in_order = [out[p] for p, _ in flat]
+    treedef = jax.tree.structure(params)
+    return jax.tree.unflatten(treedef, leaves_in_order)
+
+
+def redundancy_clean(params: PyTree, config: Dict[str, Any], masks: Dict[str, PyTree]) -> PyTree:
+    """Bake all compression permanently into the weights (reference
+    redundancy_clean:127): final masked+quantized tree for export."""
+    return apply_compression(params, config, masks, step=10**12)

@@ -1,0 +1,11 @@
+"""Compression schedule helper (reference compression/scheduler.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from .compress import CompressionScheduler
+
+
+def compression_scheduler_from_config(ds_config: Dict[str, Any]) -> CompressionScheduler:
+    return CompressionScheduler(config=ds_config.get("compression_training", {}))

@@ -1,0 +1,15 @@
+from .elasticity import (
+    ElasticityConfigError,
+    ElasticityError,
+    compute_elastic_config,
+    get_compatible_gpus,
+)
+from .elastic_agent import ElasticAgent
+
+__all__ = [
+    "ElasticAgent",
+    "ElasticityConfigError",
+    "ElasticityError",
+    "compute_elastic_config",
+    "get_compatible_gpus",
+]

@@ -1,0 +1,75 @@
+"""Elastic restart agent for TPU slices.
+
+Analog of reference ``deepspeed/elasticity/elastic_agent.py`` (DSElasticAgent
+:23, a torch-elastic LocalElasticAgent subclass): keep a training job alive
+across membership changes by restarting from checkpoint at a compatible
+scale. Torch-elastic's rendezvous does not exist on TPU; the equivalent
+events are slice preemption/resize, surfaced to a single-controller JAX job
+as device loss. The agent:
+
+1. derives the compatible-batch ladder once (``compute_elastic_config``),
+2. runs the user's train function,
+3. on a registered failure, re-derives batch/micro-batch for the NEW chip
+   count and reruns from the latest checkpoint — reference semantics
+   (recovery is restart-from-checkpoint, not in-run healing).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from ..utils.logging import log_dist
+from .elasticity import ElasticityError, compute_elastic_config
+
+
+class ElasticAgent:
+    def __init__(
+        self,
+        ds_config: Dict[str, Any],
+        train_fn: Callable[..., Any],
+        max_restarts: int = 100,
+        restart_delay_s: float = 5.0,
+        retryable: Tuple[type, ...] = (RuntimeError, OSError),
+    ):
+        """``train_fn(world_size, train_batch_size, micro_batch)`` runs (and
+        internally resumes from its latest checkpoint); the agent restarts it
+        with recomputed batch geometry after retryable failures."""
+        self.ds_config = ds_config
+        self.train_fn = train_fn
+        self.max_restarts = max_restarts
+        self.restart_delay_s = restart_delay_s
+        self.retryable = retryable
+        self.restart_count = 0
+
+    def _current_world_size(self) -> int:
+        import jax
+
+        return jax.device_count()
+
+    def geometry(self, world_size: int) -> Tuple[int, int]:
+        batch, valid, micro = compute_elastic_config(
+            self.ds_config, world_size=world_size, return_microbatch=True
+        )
+        if micro is None:
+            raise ElasticityError(f"no micro batch for world size {world_size}")
+        return batch, micro
+
+    def run(self) -> Any:
+        while True:
+            ws = self._current_world_size()
+            batch, micro = self.geometry(ws)
+            log_dist(
+                f"elastic agent: starting at world_size={ws} "
+                f"batch={batch} micro={micro} (restart #{self.restart_count})"
+            )
+            try:
+                return self.train_fn(ws, batch, micro)
+            except self.retryable as e:
+                self.restart_count += 1
+                if self.restart_count > self.max_restarts:
+                    raise ElasticityError(
+                        f"exceeded max_restarts={self.max_restarts}"
+                    ) from e
+                log_dist(f"elastic agent: retryable failure {e!r}; restarting")
+                time.sleep(self.restart_delay_s)

@@ -1,0 +1,148 @@
+"""Elastic training: the compatible-batch ladder.
+
+Analog of reference ``deepspeed/elasticity/elasticity.py`` (844 LoC:
+compute_elastic_config:287, _get_compatible_gpus_v01:125 / v02:173). The
+contract: pick ONE effective batch size B such that a job can restart on any
+chip count g in a known set with identical convergence — i.e. for every
+compatible g there is a micro-batch m in the allowed list and integer
+gradient-accumulation k with  B = m * k * g.
+
+On TPU "gpu count" becomes chip count (slice size); v02's
+``num_gpus_per_node`` divisibility constraint maps to hosts (chips per host,
+typically 4) so a restart lands on whole hosts.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+
+class ElasticityError(Exception):
+    pass
+
+
+class ElasticityConfigError(ElasticityError):
+    pass
+
+
+LATEST_ELASTICITY_VERSION = 0.2
+MINIMUM_DEEPSPEED_VERSION = "0.3.8"
+
+
+def _valid_gpus(batch: int, micro_batches: Sequence[int], min_gpus: int, max_gpus: int) -> List[int]:
+    """Chip counts g that can realise ``batch`` with some micro batch:
+    exists m, k >= 1 with batch == m * k * g."""
+    out = []
+    for g in range(min_gpus, max_gpus + 1):
+        if any(batch % (m * g) == 0 for m in micro_batches):
+            out.append(g)
+    return out
+
+
+def get_compatible_gpus(
+    micro_batches: Sequence[int],
+    max_acceptable_batch_size: int,
+    min_gpus: int = 1,
+    max_gpus: Optional[int] = None,
+    prefer_larger: bool = True,
+) -> Tuple[int, List[int]]:
+    """v0.1 algorithm: choose the batch size <= max that maximises the number
+    of compatible chip counts (ties → larger batch when prefer_larger)."""
+    if not micro_batches or any(m <= 0 for m in micro_batches):
+        raise ElasticityConfigError(f"invalid micro_batches {micro_batches}")
+    max_gpus = max_gpus or max_acceptable_batch_size // min(micro_batches)
+    best: Tuple[int, List[int]] = (0, [])
+    for batch in range(1, max_acceptable_batch_size + 1):
+        if not any(batch % m == 0 for m in micro_batches):
+            continue
+        gpus = _valid_gpus(batch, micro_batches, min_gpus, max_gpus)
+        better = len(gpus) > len(best[1]) or (
+            len(gpus) == len(best[1]) and best[0] and (
+                batch > best[0] if prefer_larger else batch < best[0]
+            )
+        )
+        if better:
+            best = (batch, gpus)
+    if best[0] == 0:
+        raise ElasticityError(
+            f"no batch <= {max_acceptable_batch_size} compatible with micro_batches "
+            f"{micro_batches} and gpus [{min_gpus}, {max_gpus}]"
+        )
+    return best
+
+
+def _apply_v02_constraints(
+    gpus: List[int], model_parallel_size: int, num_gpus_per_node: int
+) -> List[int]:
+    """v0.2: world size must be a multiple of mp_size and fill whole nodes
+    (whole TPU hosts)."""
+    step = model_parallel_size * num_gpus_per_node
+    return [g for g in gpus if (g * model_parallel_size) % step == 0]
+
+
+def compute_elastic_config(
+    ds_config: Dict[str, Any],
+    target_deepspeed_version: str = MINIMUM_DEEPSPEED_VERSION,
+    world_size: int = 0,
+    return_microbatch: bool = False,
+):
+    """Reference compute_elastic_config:287 surface.
+
+    Returns (final_batch_size, valid_gpus[, micro_batch]) — and when
+    ``world_size`` > 0 validates it is compatible and computes that world
+    size's micro batch.
+    """
+    e = ds_config.get("elasticity")
+    if not e or not e.get("enabled", False):
+        raise ElasticityConfigError("'elasticity' section missing or disabled")
+    micro_batches = sorted(e.get("micro_batch_sizes", []), reverse=True)
+    max_batch = int(e.get("max_train_batch_size", 0))
+    min_gpus = int(e.get("min_gpus", 1))
+    max_gpus = int(e.get("max_gpus", max_batch // max(1, min(micro_batches or [1]))))
+    prefer_larger = bool(e.get("prefer_larger_batch", True))
+    version = float(e.get("version", 0.1))
+    if not micro_batches or max_batch <= 0:
+        raise ElasticityConfigError("micro_batch_sizes and max_train_batch_size required")
+    min_time = int(e.get("min_time", 0))  # accepted for parity; not used here
+
+    final_batch, valid_gpus = get_compatible_gpus(
+        micro_batches, max_batch, min_gpus, max_gpus, prefer_larger
+    )
+    if version >= 0.2:
+        mp = int(e.get("model_parallel_size", 1))
+        per_node = int(e.get("num_gpus_per_node", 4))  # chips per TPU host
+        constrained = _apply_v02_constraints(valid_gpus, mp, per_node)
+        if constrained:
+            valid_gpus = constrained
+
+    if world_size > 0:
+        if world_size not in valid_gpus:
+            raise ElasticityError(
+                f"world size {world_size} not in compatible set {valid_gpus} "
+                f"for batch {final_batch}"
+            )
+    if return_microbatch or world_size > 0:
+        micro = None
+        candidates = sorted(micro_batches, reverse=prefer_larger)
+        ws = world_size or valid_gpus[-1]
+        for m in candidates:
+            if final_batch % (m * ws) == 0:
+                micro = m
+                break
+        if world_size > 0 and return_microbatch:
+            return final_batch, valid_gpus, micro
+        if return_microbatch:
+            return final_batch, valid_gpus, micro
+    return final_batch, valid_gpus
+
+
+def ensure_immutable_elastic_config(runtime_config: Dict[str, Any], saved_config: Dict[str, Any]):
+    """Restarts must not change the elasticity contract
+    (reference elasticity.py:254)."""
+    for key in ("max_train_batch_size", "micro_batch_sizes", "version"):
+        a = runtime_config.get("elasticity", {}).get(key)
+        b = saved_config.get("elasticity", {}).get(key)
+        if a != b:
+            raise ElasticityConfigError(
+                f"elastic config field {key!r} changed across restart: {b} → {a}"
+            )

@@ -1,0 +1,287 @@
+"""Aux subsystems: elasticity math, autotuner, compression, flops profiler.
+
+Reference analogs: tests/unit/elasticity/test_elastic.py (pure config math),
+autotuning tests, compression tests (261), flops profiler numbers.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.elasticity import (
+    ElasticityError,
+    compute_elastic_config,
+    get_compatible_gpus,
+)
+
+from .simple_model import make_simple_model, random_batches
+
+
+class TestElasticity:
+    def test_compatible_gpus_basic(self):
+        batch, gpus = get_compatible_gpus(
+            micro_batches=[2, 4], max_acceptable_batch_size=48, min_gpus=1, max_gpus=12
+        )
+        assert batch <= 48
+        # every advertised gpu count must actually factor the batch
+        for g in gpus:
+            assert any(batch % (m * g) == 0 for m in [2, 4]), (batch, g)
+        # 48 yields the ladder {1,2,3,4,6,8,12} within 1..12
+        assert len(gpus) == 7
+
+    def test_prefer_larger(self):
+        b_large, _ = get_compatible_gpus([2], 32, 1, 8, prefer_larger=True)
+        b_small, _ = get_compatible_gpus([2], 32, 1, 8, prefer_larger=False)
+        assert b_large >= b_small
+
+    def test_compute_elastic_config_v01(self):
+        cfg = {
+            "elasticity": {
+                "enabled": True,
+                "max_train_batch_size": 64,
+                "micro_batch_sizes": [2, 4],
+                "min_gpus": 1,
+                "max_gpus": 16,
+                "version": 0.1,
+            }
+        }
+        batch, gpus = compute_elastic_config(cfg)
+        assert batch <= 64 and gpus
+
+    def test_compute_elastic_config_v02_node_constraint(self):
+        cfg = {
+            "elasticity": {
+                "enabled": True,
+                "max_train_batch_size": 64,
+                "micro_batch_sizes": [1, 2, 4],
+                "min_gpus": 1,
+                "max_gpus": 16,
+                "version": 0.2,
+                "model_parallel_size": 1,
+                "num_gpus_per_node": 4,
+            }
+        }
+        batch, gpus = compute_elastic_config(cfg)
+        assert all(g % 4 == 0 for g in gpus), gpus  # whole TPU hosts
+
+    def test_world_size_validation(self):
+        cfg = {
+            "elasticity": {
+                "enabled": True,
+                "max_train_batch_size": 16,
+                "micro_batch_sizes": [4],
+                "min_gpus": 1,
+                "max_gpus": 4,
+                "version": 0.1,
+            }
+        }
+        batch, gpus, micro = compute_elastic_config(cfg, world_size=2, return_microbatch=True)
+        assert micro == 4
+        with pytest.raises(ElasticityError):
+            compute_elastic_config(cfg, world_size=3)
+
+    def test_disabled_raises(self):
+        from deepspeed_tpu.elasticity import ElasticityConfigError
+
+        with pytest.raises(ElasticityConfigError):
+            compute_elastic_config({"elasticity": {"enabled": False}})
+
+    def test_elastic_agent_restarts(self, mesh_dp8):
+        from deepspeed_tpu.elasticity import ElasticAgent
+
+        cfg = {
+            "elasticity": {
+                "enabled": True,
+                "max_train_batch_size": 48,
+                "micro_batch_sizes": [2],
+                "min_gpus": 1,
+                "max_gpus": 16,
+                "version": 0.1,
+            }
+        }
+        calls = []
+
+        def train_fn(world_size, batch, micro):
+            calls.append((world_size, batch, micro))
+            if len(calls) < 3:
+                raise RuntimeError("simulated preemption")
+            return "done"
+
+        agent = ElasticAgent(cfg, train_fn, restart_delay_s=0.0)
+        assert agent.run() == "done"
+        assert len(calls) == 3
+        assert agent.restart_count == 2
+        ws, batch, micro = calls[0]
+        assert batch % (micro * ws) == 0  # geometry is always consistent
+
+
+class TestTuners:
+    def test_grid_and_random_cover(self):
+        from deepspeed_tpu.autotuning import GridSearchTuner, RandomTuner
+
+        exps = [{"x": i} for i in range(5)]
+        metric = lambda e: -abs(e["x"] - 3)
+        g = GridSearchTuner(exps, metric)
+        best, m = g.tune()
+        assert best == {"x": 3} and m == 0
+        r = RandomTuner(exps, metric, seed=1)
+        best, m = r.tune()
+        assert best == {"x": 3}
+
+    def test_model_based_finds_optimum_with_fewer_trials(self):
+        from deepspeed_tpu.autotuning import ModelBasedTuner
+
+        exps = [{"x": i} for i in range(10)]
+        evals = []
+
+        def metric(e):
+            evals.append(e["x"])
+            return -((e["x"] - 6) ** 2)
+
+        t = ModelBasedTuner(exps, metric, features=["x"], seed_trials=4, top_k=2)
+        best, _ = t.tune()
+        assert len(evals) <= 6  # fewer than grid's 10
+        assert best["x"] == 6  # quadratic model nails a quadratic objective
+
+    def test_autotuner_end_to_end(self, mesh_dp8, tmp_path):
+        from deepspeed_tpu.autotuning import Autotuner
+
+        base = {
+            "train_micro_batch_size_per_gpu": 1,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "steps_per_print": 10**9,
+        }
+
+        def make_batch(n):
+            return random_batches(1, n)[0]
+
+        tuner = Autotuner(
+            make_simple_model, base, make_batch, mesh=mesh_dp8,
+            zero_stages=(0, 1), micro_batches=(1, 2),
+            steps_per_trial=2, results_dir=str(tmp_path),
+        )
+        result = tuner.tune()
+        assert result["best"] is not None
+        assert result["throughput"] > 0
+        assert len(result["trials"]) == 4
+        assert (tmp_path / "autotuning_results.json").exists()
+        assert (tmp_path / "ds_config_optimal.json").exists()
+
+
+class TestCompression:
+    def test_quantize_ste_grads_pass_through(self):
+        from deepspeed_tpu.compression import quantize_weight_ste
+
+        w = jnp.asarray(np.random.RandomState(0).randn(16, 8), jnp.float32)
+        qw = quantize_weight_ste(w, 8, True)
+        assert float(jnp.abs(qw - w).max()) < 0.05  # 8-bit ≈ small error
+        g = jax.grad(lambda w: jnp.sum(quantize_weight_ste(w, 8, True) ** 2))(w)
+        g_ref = jax.grad(lambda w: jnp.sum(w**2))(jnp.asarray(quantize_weight_ste(w, 8, True)))
+        assert np.allclose(np.asarray(g), np.asarray(g_ref), atol=1e-6)  # STE
+
+    def test_pruning_masks(self):
+        from deepspeed_tpu.compression import (
+            head_pruning_mask,
+            row_pruning_mask,
+            sparse_pruning_mask,
+        )
+
+        w = jnp.asarray(np.random.RandomState(1).randn(32, 16), jnp.float32)
+        m = sparse_pruning_mask(w, 0.5)
+        assert 0.45 <= float(m.mean()) <= 0.55
+        mr = row_pruning_mask(w, 0.25)
+        kept_cols = np.asarray(mr).all(axis=0).sum()
+        assert kept_cols == 12  # 16 * 0.75
+        mh = head_pruning_mask(w, 0.25, num_heads=4)
+        per_head = np.asarray(mh).reshape(4, 8, 16).all(axis=(1, 2))
+        assert per_head.sum() == 3  # one of 4 heads pruned
+
+    def test_scheduled_apply(self):
+        from deepspeed_tpu.compression import apply_compression, init_compression
+
+        params = {"mlp": {"w": jnp.ones((8, 8))}, "ln": {"scale": jnp.ones(8)}}
+        cfg = {
+            "sparse_pruning": {"enabled": True, "ratio": 0.5, "modules": ["mlp"], "start_step": 10},
+            "weight_quantization": {"enabled": True, "bits": 8, "modules": ["mlp"], "start_step": 0},
+        }
+        masks = init_compression(params, cfg)
+        early = apply_compression(params, cfg, masks, step=0)
+        late = apply_compression(params, cfg, masks, step=20)
+        # before start_step pruning is inactive
+        assert float(jnp.count_nonzero(early["mlp"]["w"])) == 64
+        # ln never touched
+        assert np.array_equal(np.asarray(late["ln"]["scale"]), np.ones(8))
+
+    def test_compression_in_training(self, mesh_dp8):
+        """QAT through the engine: compressed forward trains and loss drops."""
+        from deepspeed_tpu.compression import quantize_weight_ste
+        from deepspeed_tpu.runtime.config import DeepSpeedConfig
+        from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+        from deepspeed_tpu.runtime.module import ModuleSpec
+
+        base = make_simple_model()
+
+        def loss_fn(params, batch, rng, train):
+            qparams = jax.tree.map(
+                lambda p: quantize_weight_ste(p, 8, True) if p.ndim >= 2 else p, params
+            )
+            return base.loss_fn(qparams, batch, rng, train)
+
+        model = ModuleSpec(init=base.init, loss_fn=loss_fn)
+        ds = DeepSpeedConfig.load(
+            {
+                "train_micro_batch_size_per_gpu": 2,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 5e-3}},
+                "steps_per_print": 10**9,
+            },
+            dp_world_size=8,
+        )
+        engine = DeepSpeedEngine(model, ds, mesh=mesh_dp8, seed=0)
+        batch = random_batches(1, 16)[0]
+        losses = [float(jax.device_get(engine.train_batch(batch)["loss"])) for _ in range(6)]
+        assert losses[-1] < losses[0]
+
+
+class TestFlopsProfiler:
+    def test_get_model_profile(self):
+        from deepspeed_tpu.profiling import get_model_profile
+
+        W = jnp.ones((64, 64))
+        x = jnp.ones((8, 64))
+        prof = get_model_profile(lambda x: x @ W, (x,), params={"W": W})
+        # matmul flops = 2 * 8 * 64 * 64
+        assert prof["flops"] == pytest.approx(2 * 8 * 64 * 64, rel=0.1)
+        assert prof["params"] == 64 * 64
+        assert prof["latency_s"] > 0
+
+    def test_engine_profile(self, mesh_dp8, capsys):
+        from deepspeed_tpu.profiling import FlopsProfiler
+        from deepspeed_tpu.runtime.config import DeepSpeedConfig
+        from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+        model = make_simple_model()
+        ds = DeepSpeedConfig.load(
+            {
+                "train_micro_batch_size_per_gpu": 2,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 2},
+                "steps_per_print": 10**9,
+            },
+            dp_world_size=8,
+        )
+        engine = DeepSpeedEngine(model, ds, mesh=mesh_dp8, seed=0)
+        prof = FlopsProfiler(engine)
+        batch = random_batches(1, 16)[0]
+        p = prof.profile_train_step(batch)
+        assert p["flops"] > 0
+        assert p["params"] > 0
+        prof.print_model_profile()
+        out = capsys.readouterr().out
+        assert "Flops Profiler" in out
+        # engine still trains after profiling (donated-state handling)
+        m = engine.train_batch(batch)
+        assert np.isfinite(float(jax.device_get(m["loss"])))
