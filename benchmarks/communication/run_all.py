@@ -1,0 +1,118 @@
+"""Collective benchmarks over the device mesh.
+
+Analog of reference ``benchmarks/communication/{all_reduce,all_gather,
+all_to_all,broadcast,pt2pt,run_all}.py`` (~800 LoC): sweep message sizes per
+collective, print algbw/busbw. Collectives run inside jitted shard_map over
+the dp axis (XLA collectives over ICI on real hardware).
+
+    python benchmarks/communication/run_all.py [--maxsize 26] [--trials 5]
+    python benchmarks/communication/run_all.py --collective all_reduce
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from typing import Callable, Dict
+
+# runnable as a standalone script from anywhere in the repo
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import jax  # noqa: E402
+
+# honor JAX_PLATFORMS even when the environment pre-imported jax with a
+# different platform (sitecustomize) — same guard as tests/conftest.py
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax, shard_map
+from jax.sharding import PartitionSpec as P
+
+
+def _mesh():
+    from deepspeed_tpu.parallel.topology import MeshSpec
+
+    return MeshSpec(dp=len(jax.devices())).build_mesh()
+
+
+def _busbw_factor(coll: str, n: int) -> float:
+    """Bus-bandwidth correction (ring-algorithm accounting, reference
+    utils.py calc_bw semantics)."""
+    if coll in ("all_reduce",):
+        return 2.0 * (n - 1) / n
+    if coll in ("all_gather", "reduce_scatter", "all_to_all"):
+        return (n - 1) / n
+    return 1.0  # broadcast / pt2pt
+
+
+def make_ops(mesh) -> Dict[str, Callable]:
+    n = mesh.devices.size
+
+    def wrap(body, out_spec):
+        return jax.jit(
+            shard_map(body, mesh=mesh, in_specs=(P("dp"),), out_specs=out_spec, check_vma=False)
+        )
+
+    return {
+        "all_reduce": wrap(lambda x: lax.psum(x, "dp"), P("dp")),
+        "all_gather": wrap(lambda x: lax.all_gather(x, "dp", tiled=True), P("dp")),
+        "reduce_scatter": wrap(lambda x: lax.psum_scatter(x, "dp", tiled=True), P("dp")),
+        "all_to_all": wrap(
+            lambda x: lax.all_to_all(
+                x.reshape(n, -1), "dp", split_axis=0, concat_axis=0
+            ).reshape(x.shape),
+            P("dp"),
+        ),
+        "broadcast": wrap(
+            lambda x: lax.all_gather(x, "dp")[0] * jnp.ones_like(x), P("dp")
+        ),
+        "pt2pt": wrap(
+            lambda x: lax.ppermute(x, "dp", [(i, (i + 1) % n) for i in range(n)]),
+            P("dp"),
+        ),
+    }
+
+
+def bench_collective(name: str, op, mesh, maxsize_log2: int, trials: int):
+    n = mesh.devices.size
+    print(f"\n--- {name} (world={n}) ---")
+    print(f"{'size':>12} {'latency(us)':>12} {'algbw(GB/s)':>12} {'busbw(GB/s)':>12}")
+    for logsz in range(12, maxsize_log2 + 1, 2):
+        numel = (2**logsz) // 4
+        x = jnp.ones((n * numel,), jnp.float32)
+        out = op(x)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(trials):
+            out = op(x)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / trials
+        nbytes = x.nbytes
+        algbw = nbytes / dt / 1e9
+        busbw = algbw * _busbw_factor(name, n)
+        print(f"{nbytes:>12,} {dt * 1e6:>12.1f} {algbw:>12.2f} {busbw:>12.2f}")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--collective", default="all",
+                    choices=["all", "all_reduce", "all_gather", "reduce_scatter",
+                             "all_to_all", "broadcast", "pt2pt"])
+    ap.add_argument("--maxsize", type=int, default=24, help="log2 max bytes")
+    ap.add_argument("--trials", type=int, default=5)
+    args = ap.parse_args()
+
+    mesh = _mesh()
+    ops = make_ops(mesh)
+    names = list(ops) if args.collective == "all" else [args.collective]
+    for name in names:
+        bench_collective(name, ops[name], mesh, args.maxsize, args.trials)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,68 @@
+"""``ds_report`` — environment and op-compatibility report.
+
+Analog of reference ``deepspeed/env_report.py`` (140 LoC): versions, device
+inventory, native-op build/compat table.
+
+    python -m deepspeed_tpu.env_report
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+GREEN_OK = "\033[92m[OKAY]\033[0m"
+RED_NO = "\033[93m[NO]\033[0m"
+
+
+def main() -> int:
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu.ops.op_builder import op_report
+
+    print("-" * 60)
+    print("DeepSpeed-TPU C++/native op report")
+    print("-" * 60)
+    print(f"{'op name':<20} {'compatible':<12} {'built':<8}")
+    for name, compat, built in op_report():
+        print(f"{name:<20} {GREEN_OK if compat else RED_NO:<21} {GREEN_OK if built else RED_NO}")
+    print("-" * 60)
+    print("General environment:")
+    print(f"deepspeed_tpu ....... {deepspeed_tpu.__version__}")
+    print(f"python .............. {sys.version.split()[0]}")
+    print(f"jax ................. {jax.__version__}")
+    try:
+        import jaxlib
+
+        print(f"jaxlib .............. {jaxlib.__version__}")
+    except Exception:
+        pass
+    try:
+        import flax
+
+        print(f"flax ................ {flax.__version__}")
+    except Exception:
+        pass
+    try:
+        import optax
+
+        print(f"optax ............... {optax.__version__}")
+    except Exception:
+        pass
+    try:
+        import orbax.checkpoint as ocp
+
+        print(f"orbax-checkpoint .... {getattr(ocp, '__version__', 'present')}")
+    except Exception:
+        pass
+    print(f"backend ............. {jax.default_backend()}")
+    devs = jax.devices()
+    print(f"devices ............. {len(devs)} x {devs[0].device_kind if devs else '-'}")
+    print(f"process count ....... {jax.process_count()}")
+    print("-" * 60)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

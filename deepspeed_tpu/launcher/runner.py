@@ -1,0 +1,180 @@
+"""``deepspeed`` CLI — launch training across TPU hosts.
+
+Analog of reference ``deepspeed/launcher/runner.py`` (main:351,
+fetch_hostfile:176, parse_resource_filter:217, 529 LoC). Topology mapping:
+
+- reference: 1 process per GPU, NCCL rendezvous via MASTER_ADDR/PORT.
+- TPU: 1 process per HOST (each host owns its local chips); JAX multi-host
+  init rendezvouses at a coordinator via ``jax.distributed.initialize``
+  driven by env: COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID.
+
+Hostfile syntax is unchanged (``hostname slots=N`` — N = chips on that
+host), and --include/--exclude filters keep reference semantics
+(``host1@host2:0,2`` style). Single host → exec in place; multi-host → ssh
+fan-out (pdsh when available).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import subprocess
+import sys
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+DLTS_HOSTFILE = "/job/hostfile"
+COORD_PORT_DEFAULT = 8476
+
+
+def fetch_hostfile(hostfile_path: str) -> Optional["OrderedDict[str, int]"]:
+    """Parse ``host slots=N`` lines (reference fetch_hostfile:176)."""
+    if not os.path.isfile(hostfile_path):
+        return None
+    resources: "OrderedDict[str, int]" = OrderedDict()
+    with open(hostfile_path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                host, slots = line.split()
+                key, count = slots.split("=")
+                if key != "slots":
+                    raise ValueError(f"expected 'slots=N', got {slots!r}")
+                resources[host] = int(count)
+            except ValueError as e:
+                raise ValueError(f"hostfile line not 'host slots=N': {line!r}") from e
+    return resources or None
+
+
+def _parse_filter(spec: str) -> Dict[str, Optional[List[int]]]:
+    """``worker-0:0,2@worker-1`` → {host: [slot,...] or None=all}."""
+    out: Dict[str, Optional[List[int]]] = {}
+    for part in spec.split("@"):
+        if not part:
+            continue
+        if ":" in part:
+            host, slots = part.split(":")
+            out[host] = [int(s) for s in slots.split(",")]
+        else:
+            out[part] = None
+    return out
+
+
+def parse_resource_filter(
+    resources: "OrderedDict[str, int]",
+    include_str: str = "",
+    exclude_str: str = "",
+) -> "OrderedDict[str, List[int]]":
+    """Apply --include/--exclude (reference parse_resource_filter:217)."""
+    if include_str and exclude_str:
+        raise ValueError("--include and --exclude are mutually exclusive")
+    full: "OrderedDict[str, List[int]]" = OrderedDict(
+        (h, list(range(n))) for h, n in resources.items()
+    )
+    if include_str:
+        inc = _parse_filter(include_str)
+        out: "OrderedDict[str, List[int]]" = OrderedDict()
+        for host, slots in inc.items():
+            if host not in full:
+                raise ValueError(f"include host {host} not in hostfile")
+            chosen = slots if slots is not None else full[host]
+            bad = set(chosen) - set(full[host])
+            if bad:
+                raise ValueError(f"include slots {sorted(bad)} not on {host}")
+            out[host] = sorted(chosen)
+        return out
+    if exclude_str:
+        exc = _parse_filter(exclude_str)
+        out = OrderedDict()
+        for host, slots in full.items():
+            if host in exc:
+                if exc[host] is None:
+                    continue
+                keep = [s for s in slots if s not in exc[host]]
+                if keep:
+                    out[host] = keep
+            else:
+                out[host] = slots
+        return out
+    return full
+
+
+def encode_world_info(active: "OrderedDict[str, List[int]]") -> str:
+    import base64
+    import json
+
+    return base64.urlsafe_b64encode(json.dumps(active).encode()).decode()
+
+
+def build_launch_commands(
+    active: "OrderedDict[str, List[int]]",
+    user_script: str,
+    user_args: List[str],
+    master_addr: Optional[str] = None,
+    master_port: int = COORD_PORT_DEFAULT,
+) -> List[Tuple[str, str]]:
+    """(host, command) per host: each host runs ONE process with JAX
+    multi-host env (process_id = host index)."""
+    hosts = list(active.keys())
+    master_addr = master_addr or hosts[0]
+    n = len(hosts)
+    cmds = []
+    for pid, host in enumerate(hosts):
+        env = (
+            f"COORDINATOR_ADDRESS={master_addr}:{master_port} "
+            f"NUM_PROCESSES={n} PROCESS_ID={pid} "
+            f"TPU_VISIBLE_CHIPS={','.join(map(str, active[host]))}"
+        )
+        cmd = f"{env} {sys.executable} {shlex.quote(user_script)} {' '.join(shlex.quote(a) for a in user_args)}"
+        cmds.append((host, cmd.strip()))
+    return cmds
+
+
+def main(args: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="deepspeed", description="DeepSpeed-TPU launcher"
+    )
+    parser.add_argument("-H", "--hostfile", default=DLTS_HOSTFILE)
+    parser.add_argument("-i", "--include", default="")
+    parser.add_argument("-e", "--exclude", default="")
+    parser.add_argument("--num_nodes", type=int, default=-1)
+    parser.add_argument("--num_gpus", "--num_chips", dest="num_gpus", type=int, default=-1)
+    parser.add_argument("--master_addr", default=None)
+    parser.add_argument("--master_port", type=int, default=COORD_PORT_DEFAULT)
+    parser.add_argument("--launcher", default="ssh", choices=["ssh", "pdsh", "local"])
+    parser.add_argument("--dry_run", action="store_true", help="print commands only")
+    parser.add_argument("user_script")
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    a = parser.parse_args(args)
+
+    resources = fetch_hostfile(a.hostfile)
+    if resources is None:
+        # single-host: exec in place (reference single-node path)
+        cmd = [sys.executable, a.user_script, *a.user_args]
+        if a.dry_run:
+            print(" ".join(cmd))
+            return 0
+        return subprocess.call(cmd)
+
+    active = parse_resource_filter(resources, a.include, a.exclude)
+    if a.num_nodes > 0:
+        active = OrderedDict(list(active.items())[: a.num_nodes])
+    cmds = build_launch_commands(
+        active, a.user_script, a.user_args, a.master_addr, a.master_port
+    )
+    if a.dry_run:
+        for host, cmd in cmds:
+            print(f"[{host}] {cmd}")
+        return 0
+
+    from .multinode_runner import PDSHRunner, SSHRunner
+
+    runner = PDSHRunner() if a.launcher == "pdsh" else SSHRunner()
+    return runner.launch(cmds)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,130 @@
+"""Launcher CLI: hostfile parsing, resource filters, command construction,
+ds_report, comm benchmark smoke.
+
+Reference analog: tests/unit/test_ds_arguments.py + launcher runner tests.
+"""
+
+import os
+import subprocess
+import sys
+from collections import OrderedDict
+
+import pytest
+
+from deepspeed_tpu.launcher.runner import (
+    build_launch_commands,
+    fetch_hostfile,
+    parse_resource_filter,
+)
+
+
+@pytest.fixture
+def hostfile(tmp_path):
+    p = tmp_path / "hostfile"
+    p.write_text(
+        """
+# TPU pod hosts
+worker-0 slots=4
+worker-1 slots=4
+worker-2 slots=4
+"""
+    )
+    return str(p)
+
+
+class TestHostfile:
+    def test_parse(self, hostfile):
+        res = fetch_hostfile(hostfile)
+        assert res == OrderedDict([("worker-0", 4), ("worker-1", 4), ("worker-2", 4)])
+
+    def test_missing_returns_none(self):
+        assert fetch_hostfile("/nonexistent/hostfile") is None
+
+    def test_malformed_raises(self, tmp_path):
+        p = tmp_path / "bad"
+        p.write_text("worker-0 gpus=4\n")
+        with pytest.raises(ValueError):
+            fetch_hostfile(str(p))
+
+
+class TestResourceFilter:
+    def setup_method(self):
+        self.res = OrderedDict([("w0", 4), ("w1", 4)])
+
+    def test_no_filter(self):
+        act = parse_resource_filter(self.res)
+        assert act == OrderedDict([("w0", [0, 1, 2, 3]), ("w1", [0, 1, 2, 3])])
+
+    def test_include_host(self):
+        act = parse_resource_filter(self.res, include_str="w1")
+        assert list(act) == ["w1"]
+
+    def test_include_slots(self):
+        act = parse_resource_filter(self.res, include_str="w0:0,2")
+        assert act == OrderedDict([("w0", [0, 2])])
+
+    def test_exclude(self):
+        act = parse_resource_filter(self.res, exclude_str="w0@w1:3")
+        assert act == OrderedDict([("w1", [0, 1, 2])])
+
+    def test_both_raises(self):
+        with pytest.raises(ValueError):
+            parse_resource_filter(self.res, include_str="w0", exclude_str="w1")
+
+    def test_unknown_host_raises(self):
+        with pytest.raises(ValueError):
+            parse_resource_filter(self.res, include_str="nope")
+
+
+class TestLaunchCommands:
+    def test_one_process_per_host_with_jax_env(self):
+        active = OrderedDict([("w0", [0, 1, 2, 3]), ("w1", [0, 1])])
+        cmds = build_launch_commands(active, "train.py", ["--flag", "v"], master_port=9999)
+        assert len(cmds) == 2
+        h0, c0 = cmds[0]
+        assert h0 == "w0"
+        assert "COORDINATOR_ADDRESS=w0:9999" in c0
+        assert "NUM_PROCESSES=2" in c0
+        assert "PROCESS_ID=0" in c0
+        assert "TPU_VISIBLE_CHIPS=0,1,2,3" in c0
+        _, c1 = cmds[1]
+        assert "PROCESS_ID=1" in c1 and "TPU_VISIBLE_CHIPS=0,1" in c1
+        assert "train.py --flag v" in c0
+
+    def test_cli_dry_run(self, hostfile):
+        out = subprocess.run(
+            [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
+             "-H", hostfile, "--dry_run", "train.py", "--lr", "1e-4"],
+            capture_output=True, text=True, cwd="/root/repo",
+        )
+        assert out.returncode == 0, out.stderr
+        lines = [l for l in out.stdout.splitlines() if l.startswith("[worker-")]
+        assert len(lines) == 3
+        assert "NUM_PROCESSES=3" in lines[0]
+
+
+class TestDsReport:
+    def test_runs(self):
+        out = subprocess.run(
+            [sys.executable, "-m", "deepspeed_tpu.env_report"],
+            capture_output=True, text=True, cwd="/root/repo",
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert out.returncode == 0, out.stderr
+        assert "op report" in out.stdout
+        assert "jax" in out.stdout
+        assert "cpu_adam" in out.stdout
+
+
+class TestCommBenchmarks:
+    def test_smoke(self):
+        out = subprocess.run(
+            [sys.executable, "benchmarks/communication/run_all.py",
+             "--maxsize", "14", "--trials", "2", "--collective", "all_reduce"],
+            capture_output=True, text=True, cwd="/root/repo",
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
+        )
+        assert out.returncode == 0, out.stderr
+        assert "all_reduce (world=8)" in out.stdout
+        assert "busbw" in out.stdout
