@@ -28,6 +28,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+NUM_LANES = 128  # lse/delta carry a broadcast 128-lane trailing dim (Mosaic
+                 # block-tiling requirement; official flash kernel layout)
 
 
 def layout_to_index_lists(layout: np.ndarray) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
@@ -87,7 +89,7 @@ def _fwd_kernel(kidx_ref, kcnt_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     acc, m, l = jax.lax.fori_loop(0, cnt, body, (acc0, m0, l0))
     l = jnp.maximum(l, 1e-30)
     o_ref[0, 0] = (acc / l[:, None]).astype(o_ref.dtype)
-    lse_ref[0, 0] = m + jnp.log(l)
+    lse_ref[0, 0] = jax.lax.broadcast_in_dim(m + jnp.log(l), (l.shape[0], NUM_LANES), (0,))
 
 
 def _bwd_dq_kernel(kidx_ref, kcnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
@@ -96,8 +98,8 @@ def _bwd_dq_kernel(kidx_ref, kcnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     qi = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32) * sm_scale
     do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0]
-    delta = delta_ref[0, 0]
+    lse = lse_ref[0, 0, :, 0:1]  # [blk, 1] (value broadcast across lanes)
+    delta = delta_ref[0, 0, :, 0:1]
     cnt = kcnt_ref[h, qi]
 
     def body(j, dq):
@@ -106,9 +108,9 @@ def _bwd_dq_kernel(kidx_ref, kcnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         v = v_ref[0, 0, pl.ds(kj * blk, blk), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         s = _block_mask(s, qi * blk, kj * blk, causal)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
+        ds = p * (dp - delta)
         return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
     dq = jax.lax.fori_loop(0, cnt, body, jnp.zeros((blk, q_ref.shape[-1]), jnp.float32))
@@ -128,14 +130,14 @@ def _bwd_dkv_kernel(qidx_ref, qcnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         qi = qidx_ref[h, ki, i]
         q = q_ref[0, 0, pl.ds(qi * blk, blk), :].astype(jnp.float32) * sm_scale
         do = do_ref[0, 0, pl.ds(qi * blk, blk), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(qi * blk, blk)]
-        delta = delta_ref[0, 0, pl.ds(qi * blk, blk)]
+        lse = lse_ref[0, 0, pl.ds(qi * blk, blk), 0:1]  # [blk, 1]
+        delta = delta_ref[0, 0, pl.ds(qi * blk, blk), 0:1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         s = _block_mask(s, qi * blk, ki * blk, causal)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - lse)
         dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
+        ds = p * (dp - delta)
         dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         return dk, dv
 
@@ -168,13 +170,13 @@ def _fwd(q4, k4, v4, kidx, kcnt, sm_scale, causal, blk, interpret):
             ],
             [
                 pl.BlockSpec((1, 1, blk, D), lambda b, h, i, *_: (b, h, i, 0)),
-                pl.BlockSpec((1, 1, blk), lambda b, h, i, *_: (b, h, i)),
+                pl.BlockSpec((1, 1, blk, NUM_LANES), lambda b, h, i, *_: (b, h, i, 0)),
             ],
         ),
         interpret=interpret,
         out_shape=[
             jax.ShapeDtypeStruct((B, H, S, D), q4.dtype),
-            jax.ShapeDtypeStruct((B, H, S), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, S, NUM_LANES), jnp.float32),
         ],
     )(kidx, kcnt, q4, k4, v4)
     return o, lse
@@ -183,10 +185,11 @@ def _fwd(q4, k4, v4, kidx, kcnt, sm_scale, causal, blk, interpret):
 def _bwd(q4, k4, v4, o4, lse, do4, kidx, kcnt, qidx, qcnt, sm_scale, causal, blk, interpret):
     B, H, S, D = q4.shape
     delta = jnp.sum(do4.astype(jnp.float32) * o4.astype(jnp.float32), axis=-1)  # [B,H,S]
+    delta = jnp.broadcast_to(delta[..., None], (B, H, S, NUM_LANES))
     blk_q = lambda b, h, i, *_: (b, h, i, 0)
-    blk_s = lambda b, h, i, *_: (b, h, i)
+    blk_lanes = lambda b, h, i, *_: (b, h, i, 0)
     full = lambda b, h, i, *_: (b, h, 0, 0)
-    full2 = lambda b, h, i, *_: (b, h, 0)
+    full_lanes = lambda b, h, i, *_: (b, h, 0, 0)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal, blk=blk),
@@ -197,8 +200,8 @@ def _bwd(q4, k4, v4, o4, lse, do4, kidx, kcnt, qidx, qcnt, sm_scale, causal, blk
                 pl.BlockSpec((1, 1, S, D), full),
                 pl.BlockSpec((1, 1, S, D), full),
                 pl.BlockSpec((1, 1, blk, D), blk_q),
-                pl.BlockSpec((1, 1, blk), blk_s),
-                pl.BlockSpec((1, 1, blk), blk_s),
+                pl.BlockSpec((1, 1, blk, NUM_LANES), blk_lanes),
+                pl.BlockSpec((1, 1, blk, NUM_LANES), blk_lanes),
             ],
             pl.BlockSpec((1, 1, blk, D), blk_q),
         ),
@@ -215,8 +218,8 @@ def _bwd(q4, k4, v4, o4, lse, do4, kidx, kcnt, qidx, qcnt, sm_scale, causal, blk
                 pl.BlockSpec((1, 1, blk, D), blk_q),
                 pl.BlockSpec((1, 1, blk, D), blk_q),
                 pl.BlockSpec((1, 1, S, D), full),
-                pl.BlockSpec((1, 1, S), full2),
-                pl.BlockSpec((1, 1, S), full2),
+                pl.BlockSpec((1, 1, S, NUM_LANES), full_lanes),
+                pl.BlockSpec((1, 1, S, NUM_LANES), full_lanes),
             ],
             [
                 pl.BlockSpec((1, 1, blk, D), blk_q),

@@ -26,6 +26,9 @@ from jax.experimental.pallas import tpu as pltpu
 
 BQ = 128
 BK = 128
+NUM_LANES = 128  # lse/delta carry a broadcast 128-lane trailing dim (Mosaic
+                 # requires >=(8,128)-tileable blocks; same layout as the
+                 # official jax TPU flash kernel)
 NEG_INF = -1e30
 
 
@@ -88,7 +91,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale: float, causal:
     acc, m, l = jax.lax.fori_loop(0, hi, body, (acc0, m0, l0))
     l = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l)
+    lse_ref[0] = jax.lax.broadcast_in_dim(m + jnp.log(l), (BQ, NUM_LANES), (0,))
 
 
 def _fwd(q3, k3, v3, sm_scale: float, causal: bool, interpret: bool = False):
@@ -107,11 +110,11 @@ def _fwd(q3, k3, v3, sm_scale: float, causal: bool, interpret: bool = False):
         ],
         out_specs=[
             pl.BlockSpec((1, BQ, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, BQ), lambda b, i: (b, i)),
+            pl.BlockSpec((1, BQ, NUM_LANES), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, S, D), q3.dtype),
-            jax.ShapeDtypeStruct((BH, S), jnp.float32),
+            jax.ShapeDtypeStruct((BH, S, NUM_LANES), jnp.float32),
         ],
     )(q3, k3, v3)
     return o, lse
@@ -125,8 +128,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, s
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * sm_scale
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]
-    delta = delta_ref[0]
+    lse = lse_ref[0, :, 0:1]  # [BQ, 1] (value broadcast across lanes)
+    delta = delta_ref[0, :, 0:1]
 
     num_k_blocks = pl.cdiv(seq_len, BK)
     hi = _causal_hi(qi, num_k_blocks) if causal else num_k_blocks
@@ -137,9 +140,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, s
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         if causal:
             s = _causal_mask(s, qi, j)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
+        ds = p * (dp - delta)
         return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
     dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((BQ, q_ref.shape[-1]), jnp.float32))
@@ -158,15 +161,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         dk, dv = carry
         q = q_ref[0, pl.ds(i * BQ, BQ), :].astype(jnp.float32) * sm_scale
         do = do_ref[0, pl.ds(i * BQ, BQ), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(i * BQ, BQ)]
-        delta = delta_ref[0, pl.ds(i * BQ, BQ)]
+        lse = lse_ref[0, pl.ds(i * BQ, BQ), 0:1]  # [BQ, 1]
+        delta = delta_ref[0, pl.ds(i * BQ, BQ), 0:1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         if causal:
             s = _causal_mask(s, i, ki)
-        p = jnp.exp(s - lse[:, None])  # [BQ, BK]
+        p = jnp.exp(s - lse)  # [BQ, BK]
         dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
+        ds = p * (dp - delta)
         dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         return dk, dv
 
@@ -181,9 +184,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
 def _bwd(q3, k3, v3, o3, lse, do3, sm_scale: float, causal: bool, interpret: bool = False):
     BH, S, D = q3.shape
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1)  # [BH,S]
+    delta = jnp.broadcast_to(delta[..., None], (BH, S, NUM_LANES))
 
     full = lambda b, i: (b, 0, 0)
-    full2 = lambda b, i: (b, 0)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal, seq_len=S),
         grid=(BH, S // BQ),
@@ -193,8 +196,8 @@ def _bwd(q3, k3, v3, o3, lse, do3, sm_scale: float, causal: bool, interpret: boo
             pl.BlockSpec((1, S, D), full),
             pl.BlockSpec((1, S, D), full),
             pl.BlockSpec((1, BQ, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, BQ), lambda b, i: (b, i)),
-            pl.BlockSpec((1, BQ), lambda b, i: (b, i)),
+            pl.BlockSpec((1, BQ, NUM_LANES), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, BQ, NUM_LANES), lambda b, i: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, BQ, D), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, S, D), q3.dtype),
@@ -209,8 +212,8 @@ def _bwd(q3, k3, v3, o3, lse, do3, sm_scale: float, causal: bool, interpret: boo
             pl.BlockSpec((1, BK, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, BK, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, S, D), full),
-            pl.BlockSpec((1, S), full2),
-            pl.BlockSpec((1, S), full2),
+            pl.BlockSpec((1, S, NUM_LANES), full),
+            pl.BlockSpec((1, S, NUM_LANES), full),
         ],
         out_specs=[
             pl.BlockSpec((1, BK, D), lambda b, i: (b, i, 0)),

@@ -1,0 +1,79 @@
+"""MoQ — Mixture-of-Quantization training-time weight quantization scheduler.
+
+Analog of reference ``deepspeed/runtime/quantize.py`` (Quantizer:9) +
+``weight_quantizer.py``: progressively narrows weight precision during
+training (start_bits → target_bits), halving the bit budget every
+``quantize_period`` steps (period doubles after each drop), optionally
+modulated by loss-surface curvature from the eigenvalue estimator
+(runtime/eigenvalue.py) — flatter curvature → safe to quantize harder.
+
+Functional surface: ``quantize_params(params, step)`` returns the
+fake-quantized view for this step (STE gradients), composing with any
+engine path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..compression.basic_layer import quantize_weight_ste
+
+PyTree = Any
+
+
+class Quantizer:
+    def __init__(
+        self,
+        q_start_bits: int = 16,
+        q_target_bits: int = 8,
+        q_period: int = 100,
+        q_type: str = "symmetric",
+        q_groups: int = 1,
+        use_quantizer_kernel: bool = True,
+        modules: Optional[List[str]] = None,
+    ):
+        self.start_bits = q_start_bits
+        self.target_bits = q_target_bits
+        self.period = q_period
+        self.symmetric = q_type == "symmetric"
+        self.groups = q_groups
+        self.modules = modules or []
+        # precompute the (step, bits) staircase: bits drop by 1 at each
+        # boundary, boundaries double (reference quantize_period doubling)
+        self._schedule = []
+        step, period, bits = 0, q_period, q_start_bits
+        while bits > q_target_bits:
+            step += period
+            period *= 2
+            bits -= 1
+            self._schedule.append((step, bits))
+
+    def bits_at(self, step: int, eigenvalue_ratio: float = 1.0) -> int:
+        """Current bit width; ``eigenvalue_ratio`` < 1 (flat curvature)
+        accelerates the schedule (reference eigenvalue modulation)."""
+        eff = int(step / max(eigenvalue_ratio, 1e-6))
+        bits = self.start_bits
+        for boundary, b in self._schedule:
+            if eff >= boundary:
+                bits = b
+        return max(bits, self.target_bits)
+
+    def _match(self, path: str) -> bool:
+        return any(m in path for m in self.modules) if self.modules else True
+
+    def quantize_params(self, params: PyTree, step: int, eigenvalue_ratio: float = 1.0) -> PyTree:
+        bits = self.bits_at(step, eigenvalue_ratio)
+        if bits >= 16:
+            return params
+        flat = jax.tree_util.tree_flatten_with_path(params)[0]
+        out = []
+        for path, leaf in flat:
+            name = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+            if hasattr(leaf, "ndim") and leaf.ndim >= 2 and self._match(name):
+                out.append(quantize_weight_ste(leaf, bits, self.symmetric))
+            else:
+                out.append(leaf)
+        return jax.tree.unflatten(jax.tree.structure(params), out)
