@@ -487,8 +487,14 @@ def generate(
     compiled-executable analog of the reference's CUDA-graph decode replay,
     inference/engine.py:486). Returns [B, max_new_tokens]."""
     B, S = input_ids.shape
-    max_len = max_len or min(cfg.n_positions, S + max_new_tokens)
-    assert max_len >= S + max_new_tokens, (max_len, S, max_new_tokens)
+    if max_len is None:
+        max_len = S + max_new_tokens
+    if max_len > cfg.n_positions or max_len < S + max_new_tokens:
+        raise ValueError(
+            f"prompt ({S}) + max_new_tokens ({max_new_tokens}) needs a cache of "
+            f"{S + max_new_tokens} but max_len={max_len} (n_positions={cfg.n_positions}); "
+            "a shorter cache would silently overwrite KV entries"
+        )
     if rng is None:
         rng = jax.random.PRNGKey(0)
 

@@ -87,9 +87,15 @@ def _fwd_kernel(kidx_ref, kcnt_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     m0 = jnp.full((blk,), NEG_INF, jnp.float32)
     l0 = jnp.zeros((blk,), jnp.float32)
     acc, m, l = jax.lax.fori_loop(0, cnt, body, (acc0, m0, l0))
+    # A query row whose every active block is fully masked (a custom layout
+    # with only above-diagonal blocks) leaves m at NEG_INF, where p=exp(0)=1
+    # would average V instead of producing 0 — match the dense path: zero the
+    # output and poison lse to +inf so backward contributions vanish too.
+    valid = m > NEG_INF * 0.5
     l = jnp.maximum(l, 1e-30)
-    o_ref[0, 0] = (acc / l[:, None]).astype(o_ref.dtype)
-    lse_ref[0, 0] = jax.lax.broadcast_in_dim(m + jnp.log(l), (l.shape[0], NUM_LANES), (0,))
+    o_ref[0, 0] = jnp.where(valid[:, None], acc / l[:, None], 0.0).astype(o_ref.dtype)
+    lse = jnp.where(valid, m + jnp.log(l), -NEG_INF)
+    lse_ref[0, 0] = jax.lax.broadcast_in_dim(lse, (l.shape[0], NUM_LANES), (0,))
 
 
 def _bwd_dq_kernel(kidx_ref, kcnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,

@@ -174,9 +174,12 @@ class DeepSpeedEngine:
         else:
             params = jax.tree.map(jax.device_put, params, self.param_shardings)
 
-        abstract_opt = jax.eval_shape(self.optimizer.init, abstract_params)
-        self.opt_shardings = self.policy.opt_state_shardings(abstract_opt, abstract_params, model.logical_axes)
-        opt_state = jax.jit(self.optimizer.init, out_shardings=self.opt_shardings)(params)
+        if self.onebit:
+            opt_state, self.opt_shardings = self._init_onebit_opt_state(params)
+        else:
+            abstract_opt = jax.eval_shape(self.optimizer.init, abstract_params)
+            self.opt_shardings = self.policy.opt_state_shardings(abstract_opt, abstract_params, model.logical_axes)
+            opt_state = jax.jit(self.optimizer.init, out_shardings=self.opt_shardings)(params)
 
         scale_state = ls.from_config(config.fp16)
         replicated = NamedSharding(mesh, PartitionSpec())
@@ -295,6 +298,41 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # 1-bit optimizer path (explicit compressed collectives via shard_map)
     # ------------------------------------------------------------------
+    def _init_onebit_opt_state(self, params):
+        """Init 1-bit optimizer state with rank-local buffers stored per-rank.
+
+        Error-feedback buffers (and ZeroOneAdam's momentum between syncs)
+        legitimately differ across dp ranks. Claiming them replicated through
+        ``shard_map(out_specs=P())`` is undefined behaviour: any reshard,
+        donation, or checkpoint round-trip silently collapses all ranks to
+        device 0's values, corrupting the compensated compression. Instead
+        they get a leading [dp] axis sharded P('dp'): each rank's shard IS
+        its buffer, and checkpoints save/restore every rank's state.
+        """
+        replicated = NamedSharding(self.mesh, PartitionSpec())
+        dp_sharded = NamedSharding(self.mesh, PartitionSpec("dp"))
+        per_rank = set(self.optimizer.PER_RANK_STATE_FIELDS)
+        world = self.dp_world_size
+
+        base = jax.jit(self.optimizer.init)(params)
+        leaves, shardings = {}, {}
+        for f in base._fields:
+            leaf = getattr(base, f)
+            if f in per_rank:
+                # initial buffers are zeros; a jitted sharded-out zeros
+                # creates each [1, ...] shard on its own device — no
+                # [world, n] materialization on device 0 first
+                shape, dtype = (world,) + leaf.shape, leaf.dtype
+                leaves[f] = jax.jit(
+                    lambda shape=shape, dtype=dtype: jnp.zeros(shape, dtype),
+                    out_shardings=dp_sharded,
+                )()
+                shardings[f] = dp_sharded
+            else:
+                leaves[f] = jax.device_put(leaf, replicated)
+                shardings[f] = replicated
+        return type(base)(**leaves), type(base)(**shardings)
+
     def _build_onebit_optimizer(self, name: str, opt_cfg, lr_schedule):
         from .fp16.onebit import OnebitAdam, OnebitLamb, ZeroOneAdam
 
@@ -336,10 +374,23 @@ class DeepSpeedEngine:
 
         step = self.global_steps
         if isinstance(self.optimizer, ZeroOneAdam):
+            sync = self.optimizer.sync_step(step)
+            # Local steps make params rank-divergent (rank-local momentum,
+            # zero comm — the point of 0/1 Adam). Re-averaging params on the
+            # (exponentially rare) sync steps restores exact replication at
+            # every sync boundary; the host-side flag pays the dense
+            # allreduce only when a local step actually ran since the last
+            # resync. Between a local step and the next sync, params carry
+            # bounded per-rank drift and a checkpoint/eval reads device 0's
+            # copy — the same rank-0-saves semantics as the reference's
+            # per-process torch params.
+            resync = sync and getattr(self, "_zoadam_divergent", False)
             flags = {
-                "sync": self.optimizer.sync_step(step),
+                "sync": sync,
                 "update_var": self.optimizer.variance_update_step(step),
+                "resync_params": resync,
             }
+            self._zoadam_divergent = not sync
         else:
             flags = {"compressed": step >= self.optimizer.freeze_step}
         key = tuple(sorted(flags.items()))
@@ -359,8 +410,16 @@ class DeepSpeedEngine:
         mesh = self.mesh
         world = self.dp_world_size
 
+        per_rank_fields = tuple(opt.PER_RANK_STATE_FIELDS)
+        resync_params = opt_flags.pop("resync_params", False)
+
         def per_rank(params, opt_state, batch, rng):
             rank = jax.lax.axis_index("dp")
+            # per-rank buffers arrive as [1, ...] blocks of the [dp, ...]
+            # global; the optimizer sees its rank's flat buffer
+            opt_state = opt_state._replace(
+                **{f: getattr(opt_state, f)[0] for f in per_rank_fields}
+            )
 
             def scaled_loss(p, micro, mrng):
                 loss, metrics = model.loss_fn(_cast_params(p, compute_dtype), micro, mrng, True)
@@ -386,13 +445,26 @@ class DeepSpeedEngine:
 
             gnorm_local = global_norm(grads)
             updates, new_opt_state = opt.update(grads, opt_state, params, **opt_flags)
+            new_opt_state = new_opt_state._replace(
+                **{f: getattr(new_opt_state, f)[None] for f in per_rank_fields}
+            )
             new_params = optax.apply_updates(params, updates)
+            if resync_params:
+                new_params = jax.tree.map(
+                    lambda p: jax.lax.pmean(p, "dp"), new_params
+                )
             loss_mean = jax.lax.pmean(loss_sum / gas, "dp")
             gnorm = jax.lax.pmean(gnorm_local, "dp")
             return new_params, new_opt_state, loss_mean, gnorm
 
         replicated_spec = PartitionSpec()
         batch_specs = None  # filled per call via tree mapping
+
+        def opt_state_specs(opt_state):
+            return type(opt_state)(**{
+                f: PartitionSpec("dp") if f in per_rank_fields else replicated_spec
+                for f in opt_state._fields
+            })
 
         def train_step(state: TrainState, batch: PyTree, rng):
             in_batch_specs = jax.tree.map(
@@ -403,13 +475,13 @@ class DeepSpeedEngine:
                 mesh=mesh,
                 in_specs=(
                     jax.tree.map(lambda _: replicated_spec, state.params),
-                    jax.tree.map(lambda _: replicated_spec, state.opt_state),
+                    opt_state_specs(state.opt_state),
                     in_batch_specs,
                     replicated_spec,
                 ),
                 out_specs=(
                     jax.tree.map(lambda _: replicated_spec, state.params),
-                    jax.tree.map(lambda _: replicated_spec, state.opt_state),
+                    opt_state_specs(state.opt_state),
                     replicated_spec,
                     replicated_spec,
                 ),

@@ -49,6 +49,12 @@ class OnebitAdam:
     dp axis context (call inside shard_map) and a static ``compressed`` flag.
     """
 
+    # State fields that legitimately differ across dp ranks (error-feedback
+    # buffers). The engine stores them with a leading [dp] axis sharded
+    # P('dp') so reshard/donate/checkpoint preserves every rank's values
+    # instead of silently collapsing to device 0's (falsely-replicated UB).
+    PER_RANK_STATE_FIELDS = ("worker_error", "server_error")
+
     def __init__(
         self,
         lr: Schedule = 1e-3,

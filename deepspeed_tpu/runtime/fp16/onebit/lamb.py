@@ -37,6 +37,9 @@ class OnebitLambState(NamedTuple):
 
 
 class OnebitLamb:
+    # error-feedback buffers are rank-local; see OnebitAdam.PER_RANK_STATE_FIELDS
+    PER_RANK_STATE_FIELDS = ("worker_error", "server_error")
+
     def __init__(
         self,
         lr: Schedule = 1e-3,

@@ -41,6 +41,11 @@ class ZeroOneAdamState(NamedTuple):
 
 
 class ZeroOneAdam:
+    # Besides the error-feedback buffers, momentum is rank-local between
+    # syncs (local steps update m from LOCAL grads with zero comm), so it
+    # must also be stored per-rank; see OnebitAdam.PER_RANK_STATE_FIELDS.
+    PER_RANK_STATE_FIELDS = ("m", "worker_error", "server_error")
+
     def __init__(
         self,
         lr: Schedule = 1e-3,

@@ -123,6 +123,11 @@ class HostOffloadOptimizer:
             self.opt._step.setdefault(gid, 0)
             self.opt.step(master, g[start:end], key=gid, lr=lr)
             out[start:end] = master
+            # Drop the moment views: they alias the swapped-in record, and a
+            # live view keeps the whole allocation resident after swap_out
+            # (defeating the "2 subgroup records" DRAM high-water). The step
+            # counter (self.opt._step) is the only DRAM-resident state.
+            del self.opt._m[gid], self.opt._v[gid]
 
         self.swapper.run_pipeline(list(range(len(self._subgroups))), step_fn)
         return self._unflatten(out, compute_dtype)

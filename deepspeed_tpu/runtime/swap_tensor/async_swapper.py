@@ -23,22 +23,29 @@ class AsyncTensorSwapper:
         self.pending_paths: List[str] = []
         self.bytes_written = 0
         self.bytes_read = 0
+        # buffers the C++ thread pool may still be reading/writing; a
+        # temporary (e.g. a contiguous copy of a strided input) must not be
+        # garbage-collected before the write completes
+        self._inflight_buffers: List[np.ndarray] = []
 
     def swap_out_tensors(self, tensors: List[np.ndarray], paths: List[str]) -> None:
-        """Queue async writes; buffers must stay alive until ``synchronize``."""
+        """Queue async writes; buffers are kept alive until ``synchronize``."""
         for arr, path in zip(tensors, paths):
             os.makedirs(os.path.dirname(path), exist_ok=True)
             a = np.ascontiguousarray(arr)
             self.handle.async_pwrite(a, path)
+            self._inflight_buffers.append(a)
             self.pending_paths.append(path)
             self.bytes_written += a.nbytes
 
     def swap_in_tensors(self, buffers: List[np.ndarray], paths: List[str]) -> None:
         for buf, path in zip(buffers, paths):
             self.handle.async_pread(buf, path)
+            self._inflight_buffers.append(buf)
             self.bytes_read += buf.nbytes
 
     def synchronize(self) -> int:
         n = self.handle.wait()
         self.pending_paths.clear()
+        self._inflight_buffers.clear()
         return n

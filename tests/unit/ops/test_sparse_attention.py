@@ -134,6 +134,52 @@ class TestSparseAttentionParity:
                 f"d{name} diff {np.abs(np.asarray(a) - np.asarray(b)).max()}"
             )
 
+    def test_fully_masked_row_is_zero(self):
+        """A custom layout whose first query-block only sees blocks strictly
+        above the diagonal: under the runtime causal mask every score in the
+        row is masked, and the kernel must emit 0 (not the mean of V)."""
+        from deepspeed_tpu.ops.pallas.block_sparse_attention import block_sparse_attention
+
+        B, S, H, D = 1, 64, 1, 16
+        blk = 16
+        q, k, v = _rand_qkv(B, S, H, D, seed=7)
+        nb = S // blk
+        layout = np.zeros((H, nb, nb), bool)
+        layout[0, 0, 1] = True  # q-block 0 attends only above the diagonal
+        for i in range(1, nb):
+            layout[0, i, : i + 1] = True  # other rows normal causal
+        out = block_sparse_attention(
+            q, k, v, layout, blk, causal=True, sm_scale=1.0 / D**0.5, interpret=True
+        )
+        ref = _dense_masked(
+            q, k, v, layout_to_dense_mask(layout, blk), causal=True, sm_scale=1.0 / D**0.5
+        )
+        assert np.allclose(np.asarray(out)[:, :blk], 0.0), "masked rows must be zero"
+        assert np.allclose(np.asarray(ref), np.asarray(out), atol=2e-5)
+
+        # gradients through fully-masked rows must also match (be zero)
+        def loss_pal(q, k, v):
+            return jnp.sum(
+                block_sparse_attention(
+                    q, k, v, layout, blk, causal=True, sm_scale=1.0 / D**0.5, interpret=True
+                ) ** 2
+            )
+
+        def loss_ref(q, k, v):
+            return jnp.sum(
+                _dense_masked(
+                    q, k, v, layout_to_dense_mask(layout, blk), causal=True,
+                    sm_scale=1.0 / D**0.5,
+                ) ** 2
+            )
+
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        g_pal = jax.grad(loss_pal, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(g_ref, g_pal, "qkv"):
+            assert np.allclose(np.asarray(a), np.asarray(b), atol=5e-4), (
+                f"d{name} diff {np.abs(np.asarray(a) - np.asarray(b)).max()}"
+            )
+
     def test_dense_layout_equals_full_attention(self):
         B, S, H, D = 1, 64, 2, 16
         q, k, v = _rand_qkv(B, S, H, D, seed=4)
