@@ -1,12 +1,19 @@
-"""Benchmark: GPT-2 training throughput on the available TPU chip(s).
+"""Benchmark: GPT-2 training throughput under ZeRO on the available chip(s).
 
-Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}.
 
-Primary metric (BASELINE.json): tokens/sec/chip for GPT-2 under ZeRO. The
-A100 reference point for GPT-2-XL-class models with ZeRO-3 + bf16 is roughly
-~4-5k tokens/sec/chip at seq 1024; we report tokens/sec/chip and the ratio
-vs a 4500 tok/s/chip baseline, scaled by model size when a smaller preset is
-used to fit the available chip.
+Primary metric (BASELINE.json): tokens/sec/chip for GPT-2-XL-class training
+under ZeRO-3. The A100 reference point is ~4500 tokens/sec/chip for GPT-2-XL
+(1.5B) at seq 1024 (BASELINE.md). When a smaller preset is benched (one v5e
+chip has 16 GB HBM; XL's fp32 master + moments alone need ~18 GB),
+``vs_baseline`` is FLOPs-normalized: we convert our sustained model-FLOP/s
+into the equivalent GPT-2-XL tokens/sec and divide by 4500.
+
+Sanity harness (VERDICT r1 item 2):
+- the timed loop blocks on each step's loss (strictly serialized; a second
+  un-blocked pass measures the pipelined rate for comparison),
+- MFU is cross-checked from the compiled step's XLA ``cost_analysis()``
+  flops — an MFU above ~70% means the harness is broken, not fast.
 """
 
 from __future__ import annotations
@@ -18,8 +25,39 @@ import time
 
 import numpy as np
 
+# bf16 peak TFLOP/s per chip by TPU generation
+PEAK_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0}
 
-def main():
+# presets largest-first; picked by free-HBM fit estimate with OOM fallback
+CANDIDATES = ("gpt2-xl", "gpt2-large", "gpt2-medium", "gpt2")
+
+
+def analytic_train_flops_per_token(L: int, h: int, vocab: int, S: int) -> float:
+    """fwd matmul flops/token = 2*(12*L*h^2 + vocab*h) + 4*L*S*h (QK^T + PV);
+    train = 3x fwd (bwd is 2x fwd). Embedding lookups are free."""
+    fwd = 2.0 * (12.0 * L * h * h + vocab * h) + 4.0 * L * S * h
+    return 3.0 * fwd
+
+
+def param_count(L: int, h: int, vocab: int, S: int) -> float:
+    return 12.0 * L * h * h + vocab * h + S * h
+
+
+def pick_model(hbm_bytes: float, seq: int):
+    """Largest preset whose train-state footprint fits: fp32 params + Adam
+    m/v (12 B) + transient fp32 grads (4) + bf16 compute copy (2) = 18 B per
+    param, plus ~2 GB activation/workspace headroom (remat on)."""
+    from deepspeed_tpu.models import gpt2
+
+    for name in CANDIDATES:
+        p = gpt2.PRESETS[name]
+        n = param_count(p["n_layer"], p["n_embd"], 50257, seq)
+        if n * 18 + 2e9 < hbm_bytes * 0.92:
+            return name
+    return "gpt2"
+
+
+def build_engine(model_name: str, seq: int, micro: int, n_dev: int, zero_stage: int):
     import jax
 
     from deepspeed_tpu.models import gpt2
@@ -27,16 +65,10 @@ def main():
     from deepspeed_tpu.runtime.config import DeepSpeedConfig
     from deepspeed_tpu.runtime.engine import DeepSpeedEngine
 
-    n_dev = len(jax.devices())
-    on_tpu = jax.default_backend() not in ("cpu",)
-
-    # pick a size that exercises the chip; v5e-1 has 16 GB HBM.
-    model_name = os.environ.get("BENCH_MODEL", "gpt2" if on_tpu else "gpt2-tiny")
-    seq = int(os.environ.get("BENCH_SEQ", "1024" if on_tpu else "128"))
-    micro = int(os.environ.get("BENCH_MICRO", "8" if on_tpu else "2"))
-    steps = int(os.environ.get("BENCH_STEPS", "20" if on_tpu else "3"))
-
-    cfg = gpt2.get_config(model_name, n_positions=seq)
+    # remat only where activations wouldn't fit; it lengthens the (remote,
+    # slow) first compile, so smaller presets skip it
+    remat = model_name in ("gpt2-large", "gpt2-xl")
+    cfg = gpt2.get_config(model_name, n_positions=seq, remat=remat)
     module = gpt2.make_module(cfg)
     mesh = MeshSpec(dp=n_dev).build_mesh()
     ds = DeepSpeedConfig.load(
@@ -44,7 +76,7 @@ def main():
             "train_micro_batch_size_per_gpu": micro,
             "gradient_accumulation_steps": 1,
             "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.01}},
-            "zero_optimization": {"stage": 1 if n_dev > 1 else 0},
+            "zero_optimization": {"stage": zero_stage},
             "gradient_clipping": 1.0,
             "bf16": {"enabled": True},
             "steps_per_print": 10**9,
@@ -52,31 +84,125 @@ def main():
         dp_world_size=n_dev,
     )
     engine = DeepSpeedEngine(module, ds, mesh=mesh, seed=0)
-    rs = np.random.RandomState(0)
-    batch = {
-        "input_ids": rs.randint(0, cfg.vocab_size, size=(engine.train_batch_size, seq)).astype(np.int32)
-    }
+    return cfg, engine
 
-    # warmup / compile
-    m = engine.train_batch(batch)
+
+def main():
+    import jax
+
+    n_dev = len(jax.devices())
+    on_tpu = jax.default_backend() not in ("cpu",)
+
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        hbm = float(stats.get("bytes_limit", 16e9))
+    except Exception:
+        hbm = 16e9
+
+    seq = int(os.environ.get("BENCH_SEQ", "1024" if on_tpu else "128"))
+    micro = int(os.environ.get("BENCH_MICRO", "8" if on_tpu else "2"))
+    steps = int(os.environ.get("BENCH_STEPS", "10" if on_tpu else "3"))
+    zero_stage = int(os.environ.get("BENCH_ZERO", "3" if n_dev > 1 else "1"))
+    # default to the compile-proven 124M preset on a single chip (the remote
+    # first compile of larger presets can exceed the driver's budget);
+    # BENCH_MODEL=auto engages the largest-that-fits ladder
+    model_name = os.environ.get("BENCH_MODEL", "gpt2" if on_tpu else "gpt2-tiny")
+    if model_name == "auto":
+        model_name = pick_model(hbm, seq)
+
+    # build with OOM fallback down the preset ladder
+    tried = []
+    cfg = engine = None
+    ladder = [model_name] + [c for c in CANDIDATES if CANDIDATES.index(c) > (CANDIDATES.index(model_name) if model_name in CANDIDATES else -1)]
+    for name in ladder:
+        try:
+            cfg, engine = build_engine(name, seq, micro, n_dev, zero_stage)
+            rs = np.random.RandomState(0)
+            batch = {
+                "input_ids": rs.randint(
+                    0, cfg.vocab_size, size=(engine.train_batch_size, seq)
+                ).astype(np.int32)
+            }
+            m = engine.train_batch(batch)  # compile + warmup step 0
+            jax.block_until_ready(m["loss"])
+            model_name = name
+            break
+        except Exception as e:  # OOM at compile or run: drop a size
+            tried.append(f"{name}: {type(e).__name__}")
+            cfg = engine = None
+            if name == ladder[-1]:
+                raise
+    assert engine is not None, tried
+
+    m = engine.train_batch(batch)  # warmup step 1
     jax.block_until_ready(m["loss"])
+    first_loss = float(jax.device_get(m["loss"]))
 
+    # --- strictly serialized timing: block on every step's loss ----------
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        m = engine.train_batch(batch)
+        jax.block_until_ready(m["loss"])
+    dt_blocked = time.perf_counter() - t0
+    last_loss = float(jax.device_get(m["loss"]))
+
+    # --- pipelined timing (state threading still serializes the chain) ---
     t0 = time.perf_counter()
     for _ in range(steps):
         m = engine.train_batch(batch)
     jax.block_until_ready(m["loss"])
-    dt = time.perf_counter() - t0
+    dt_pipelined = time.perf_counter() - t0
 
+    # headline = blocked (defensible); pipelined reported for comparison
+    dt = dt_blocked
     tokens = engine.train_batch_size * seq * steps
     tok_per_sec_chip = tokens / dt / n_dev
+    step_ms = dt / steps * 1e3
 
-    baseline = 4500.0  # per-A100 tokens/sec/chip reference point (BASELINE.md)
+    # --- MFU cross-check from the compiled step's XLA flops --------------
+    device_batch = engine.shard_batch(batch)
+    rng = jax.random.PRNGKey(0)
+    xla_flops = None
+    try:
+        compiled = engine._train_step.lower(engine.state, device_batch, rng).compile()
+        ca = compiled.cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+        xla_flops = float(ca.get("flops", 0.0)) or None
+    except Exception:
+        pass
+
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+    peak = float(os.environ.get("BENCH_PEAK_TFLOPS", PEAK_TFLOPS.get(gen, 197.0))) * 1e12
+    analytic_flops = (
+        analytic_train_flops_per_token(cfg.n_layer, cfg.n_embd, cfg.vocab_size, seq)
+        * engine.train_batch_size * seq
+    )
+    flops_per_step = xla_flops if xla_flops else analytic_flops
+    sustained = flops_per_step / (dt / steps)  # model FLOP/s, all chips
+    mfu = sustained / (peak * n_dev)
+
+    # --- FLOPs-normalized vs_baseline ------------------------------------
+    xl_per_tok = analytic_train_flops_per_token(48, 1600, 50257, 1024)
+    model_per_tok = analytic_train_flops_per_token(cfg.n_layer, cfg.n_embd, cfg.vocab_size, seq)
+    xl_equiv_tok_per_sec_chip = tok_per_sec_chip * (model_per_tok / xl_per_tok)
+    baseline = 4500.0  # per-A100 GPT-2-XL tokens/sec/chip (BASELINE.md)
     result = {
-        "metric": f"tokens/sec/chip {model_name} seq{seq} zero{ds.zero_optimization.stage} bf16",
+        "metric": f"tokens/sec/chip {model_name} seq{seq} zero{zero_stage} bf16 (XL-equivalent vs A100)",
         "value": round(tok_per_sec_chip, 1),
         "unit": "tokens/sec/chip",
-        "vs_baseline": round(tok_per_sec_chip / baseline, 3),
+        "vs_baseline": round(xl_equiv_tok_per_sec_chip / baseline, 3),
+        "model": model_name,
+        "n_chips": n_dev,
+        "step_ms": round(step_ms, 2),
+        "step_ms_pipelined": round(dt_pipelined / steps * 1e3, 2),
+        "mfu": round(mfu, 4),
+        "flops_per_step": flops_per_step,
+        "flops_source": "xla_cost_analysis" if xla_flops else "analytic",
+        "xl_equiv_tokens_per_sec_chip": round(xl_equiv_tok_per_sec_chip, 1),
+        "loss_first_to_last": [round(first_loss, 4), round(last_loss, 4)],
     }
+    if tried:
+        result["oom_fallbacks"] = tried
     print(json.dumps(result))
 
 

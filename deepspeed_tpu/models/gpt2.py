@@ -523,6 +523,89 @@ def generate(
     return jnp.concatenate([jnp.moveaxis(tokens, 0, 1), last[:, None]], axis=1)
 
 
+def make_block_api(cfg: GPT2Config):
+    """Block-structured view for ZeRO-Infinity parameter streaming
+    (runtime/zero/infinity.py) — the analog of the reference's per-submodule
+    fetch/release cycle (partitioned_param_coordinator.py:237,356) expressed
+    as explicit embed/block/head programs. Persistent part = wte/wpe/ln_f
+    (tied head), matching stage3_param_persistence_threshold semantics."""
+    from ..runtime.zero.infinity import BlockAPI
+
+    assert not cfg.is_moe, "block streaming: dense blocks only (v1)"
+    E, V, P, L = cfg.n_embd, cfg.vocab_size, cfg.n_positions, cfg.n_layer
+    std = 0.02
+    pstd = std / float(np.sqrt(2.0 * L))
+    dt = cfg.dtype
+    eps = cfg.layer_norm_epsilon
+
+    def init_persistent(rng):
+        k1, k2 = jax.random.split(rng)
+        return {
+            "wte": (jax.random.normal(k1, (V, E)) * std).astype(dt),
+            "wpe": (jax.random.normal(k2, (P, E)) * std).astype(dt),
+            "ln_f": {"scale": jnp.ones((E,), dt), "bias": jnp.zeros((E,), dt)},
+        }
+
+    def init_block(rng, i):
+        k = iter(jax.random.split(jax.random.fold_in(rng, i), 8))
+
+        def normal(key, shape, s):
+            return (jax.random.normal(key, shape) * s).astype(dt)
+
+        return {
+            "ln_1": {"scale": jnp.ones((E,), dt), "bias": jnp.zeros((E,), dt)},
+            "ln_2": {"scale": jnp.ones((E,), dt), "bias": jnp.zeros((E,), dt)},
+            "attn": {
+                "c_attn_w": normal(next(k), (E, 3 * E), std),
+                "c_attn_b": jnp.zeros((3 * E,), dt),
+                "c_proj_w": normal(next(k), (E, E), pstd),
+                "c_proj_b": jnp.zeros((E,), dt),
+            },
+            "mlp": {
+                "c_fc_w": normal(next(k), (E, 4 * E), std),
+                "c_fc_b": jnp.zeros((4 * E,), dt),
+                "c_proj_w": normal(next(k), (4 * E, E), pstd),
+                "c_proj_b": jnp.zeros((E,), dt),
+            },
+        }
+
+    def embed_fwd(pers, batch, rng, train):
+        ids = batch["input_ids"]
+        S = ids.shape[1]
+        h = pers["wte"][ids] + pers["wpe"][:S][None, :, :]
+        if train and cfg.dropout > 0.0:
+            h = _dropout(h, cfg.dropout, rng, train)
+        return h
+
+    def block_fwd(blk, h, rng, train):
+        key = rng if (train and cfg.dropout > 0.0) else None
+        h, _aux = _block(cfg, blk, h, train, key)
+        return h
+
+    def head_loss(pers, h, batch):
+        h = _layer_norm(h, pers["ln_f"]["scale"], pers["ln_f"]["bias"], eps)
+        logits = h @ pers["wte"].T  # tied embeddings
+        loss, _ntok = _token_loss(cfg, None, logits, batch)
+        return loss
+
+    def split_params(params):
+        pers = {"wte": params["wte"], "wpe": params["wpe"], "ln_f": params["ln_f"]}
+        blocks = [
+            jax.tree.map(lambda x: x[i], params["blocks"]) for i in range(L)
+        ]
+        return pers, blocks
+
+    return BlockAPI(
+        num_blocks=L,
+        init_persistent=init_persistent,
+        init_block=init_block,
+        embed_fwd=embed_fwd,
+        block_fwd=block_fwd,
+        head_loss=head_loss,
+        split_params=split_params,
+    )
+
+
 def make_module(cfg: GPT2Config) -> ModuleSpec:
     return ModuleSpec(
         init=lambda rng: init_params(cfg, rng),
@@ -533,5 +616,9 @@ def make_module(cfg: GPT2Config) -> ModuleSpec:
         pipeline_loss_fn=None if cfg.is_moe else (
             lambda params, batch, rng, train, mesh: pipeline_lm_loss(cfg, params, batch, rng, train, mesh)
         ),
-        extra={"config": cfg},
+        extra={
+            "config": cfg,
+            # lazy: built only when the engine engages the param-offload tier
+            "block_api": (None if cfg.is_moe else (lambda: make_block_api(cfg))),
+        },
     )

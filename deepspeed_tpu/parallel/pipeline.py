@@ -126,3 +126,182 @@ def pipeline_apply(
     # jit so eager grad-of-shard_map works (jax requires jit around shard_map
     # for autodiff; nested jit is free when already inside a trace).
     return jax.jit(sharded)(layer_params, x_micro)
+
+
+def make_head_grad(head_loss_fn: Callable) -> Callable:
+    """Wrap ``(head_params, h, aux) -> loss`` into the ``head_grad_fn``
+    contract of ``pipeline_train_1f1b``. The cotangent seed is built with
+    ``ones_like(loss)`` so it inherits the varying-over-pp type required
+    inside shard_map (a plain 1.0 is rejected by the VJP type check)."""
+
+    def head_grad(head_params, h, aux):
+        loss, vjp = jax.vjp(lambda hp, hh: head_loss_fn(hp, hh, aux), head_params, h)
+        d_hp, dh = vjp(jnp.ones_like(loss))
+        return loss, d_hp, dh
+
+    return head_grad
+
+
+def pipeline_train_1f1b(
+    stage_fn: Callable[..., jnp.ndarray],
+    head_grad_fn: Callable,
+    layer_params: PyTree,
+    head_params: PyTree,
+    x_micro: jnp.ndarray,
+    aux_micro: PyTree,
+    mesh: Mesh,
+    *,
+    layer_axis_specs: Optional[PyTree] = None,
+    rng=None,
+) -> Tuple[jnp.ndarray, PyTree, PyTree, jnp.ndarray]:
+    """Memory-bounded 1F1B pipeline step: loss AND grads in one schedule.
+
+    The fill-drain path (``pipeline_apply`` + autodiff) keeps every tick's
+    boundary activation alive for the whole backward — O(M + P) microbatch
+    slots per stage. The reference's ``TrainSchedule``
+    (runtime/pipe/schedule.py:182, num_pipe_buffers:243) interleaves one
+    backward after each forward so at most ~P microbatches are in flight.
+    This is that schedule as a single SPMD ``lax.scan``: each tick every
+    stage runs one forward sub-step and one backward sub-step (lockstep
+    1F1B), with
+
+    - a **ring buffer of 2P-1 boundary inputs** per stage (the
+      ``num_pipe_buffers`` analog) instead of a [T, ...] activation stack —
+      stage p's input for microbatch m is stored at tick m+p and consumed by
+      its own backward at tick m + 2(P-1) - p, a liveness window ≤ 2P-1
+      independent of M;
+    - forward activations ``ppermute``d down the ring, grad-activations
+      ``ppermute``d up (p2p.py send/recv in both directions);
+    - backward = per-tick ``jax.vjp`` of the stage body (residuals live for
+      one tick only — rematerialization inside the schedule);
+    - the head (final norm + logits + loss) evaluated on the last stage the
+      tick a microbatch's forward completes, seeding its backward wave.
+
+    Args:
+      stage_fn: ``(local_layers, h[, key]) -> h``.
+      head_grad_fn: ``(head_params, h, aux) -> (loss, d_head_params, dh)``
+        where ``loss`` is this microbatch's mean loss scaled by
+        ``loss_seed/M`` contributions (caller builds it via jax.vjp).
+      layer_params: [L, ...]-leading pytree, sharded over pp.
+      head_params: replicated head/norm params (grads psum'd from last stage).
+      x_micro: [M, mb, ...] embedded stage-0 inputs.
+      aux_micro: [M, ...] per-microbatch targets for the head (seed the
+        backward inside head_grad_fn with scale/M for mean semantics).
+
+    Returns ``(loss_sum, d_layer_params, d_head_params, dx_micro)``:
+      loss_sum — sum of per-microbatch head losses (caller divides by M);
+      d_layer_params — layer-dim-sharded grads (match layer_params specs);
+      d_head_params / dx_micro — replicated (psum from owning stage).
+    """
+    Pn = num_pp_stages(mesh)
+    M = x_micro.shape[0]
+    if layer_axis_specs is None:
+        layer_axis_specs = jax.tree.map(lambda _: P("pp"), layer_params)
+    R = 2 * Pn - 1  # ring slots: max boundary-input liveness window
+    T = M + 2 * (Pn - 1)  # fill + steady 1F1B + drain
+
+    def pipe(local_layers, head_p, xm, auxm):
+        p = lax.axis_index("pp")
+        is_last = p == Pn - 1
+        f32 = lambda t: jax.tree.map(lambda x: jnp.zeros(x.shape, jnp.float32), t)
+
+        def run_stage(Lp, h, m_idx):
+            # dropout keys derive from (microbatch, stage), NOT the tick, so
+            # the backward sub-step's recompute replays the forward's masks
+            if rng is None:
+                return stage_fn(Lp, h)
+            key = jax.random.fold_in(jax.random.fold_in(rng, m_idx), p)
+            return stage_fn(Lp, h, key)
+
+        def masked_add(acc, upd, valid):
+            return jax.tree.map(
+                lambda a, u: a + jnp.where(valid, u, 0).astype(a.dtype), acc, upd
+            )
+
+        def tick(carry, t):
+            ring, recv_act, recv_dh, gL, gH, loss_sum, dx_buf = carry
+
+            # ---- forward sub-step: stage p runs microbatch m_f = t - p ----
+            m_f = t - p
+            fwd_valid = (m_f >= 0) & (m_f < M)
+            m_f_c = jnp.clip(m_f, 0, M - 1)
+            inp = jnp.where(p == 0, xm[m_f_c], recv_act)
+            out = run_stage(local_layers, inp, m_f_c)
+            ring = lax.dynamic_update_index_in_dim(ring, inp, t % R, axis=0)
+
+            # head on the last stage the tick a microbatch's forward lands;
+            # cond (not where) so other stages skip the logits matmul —
+            # head_grad_fn must be collective-free
+            aux_f = jax.tree.map(lambda x: x[m_f_c], auxm)
+            head_valid = fwd_valid & is_last
+
+            def do_head(_):
+                return head_grad_fn(head_p, out, aux_f)
+
+            def skip_head(_):
+                # pcast: branch outputs must match do_head's varying-over-pp
+                # type (its results depend on the stage-local ``out``)
+                vary = lambda x: lax.pcast(x, ("pp",), to="varying")
+                return (
+                    vary(jnp.float32(0.0)),
+                    jax.tree.map(lambda x: vary(jnp.zeros_like(x)), head_p),
+                    jnp.zeros_like(out),  # already varying (out is stage-local)
+                )
+
+            loss_m, d_hp, dh_head = lax.cond(head_valid, do_head, skip_head, None)
+            loss_sum = loss_sum + loss_m
+            gH = masked_add(gH, d_hp, head_valid)
+
+            # ---- backward sub-step: stage p bwds m_b = t - 2(P-1) + p -----
+            m_b = t - 2 * (Pn - 1) + p
+            bwd_valid = (m_b >= 0) & (m_b < M)
+            m_b_c = jnp.clip(m_b, 0, M - 1)
+            # last stage's dh comes from THIS tick's head (m_b == m_f there);
+            # other stages consume the dh ppermuted up from stage p+1
+            dh_in = jnp.where(is_last, dh_head.astype(jnp.float32), recv_dh)
+            saved_inp = ring[(m_b_c + p) % R]
+            _, stage_vjp = jax.vjp(
+                lambda Lp, x: run_stage(Lp, x, m_b_c), local_layers, saved_inp
+            )
+            dL, dx_s = stage_vjp(dh_in.astype(saved_inp.dtype))
+            dx_f32 = dx_s.astype(jnp.float32)
+            gL = masked_add(gL, dL, bwd_valid)
+            dx_buf = jnp.where(
+                bwd_valid & (p == 0),
+                lax.dynamic_update_index_in_dim(dx_buf, dx_f32, m_b_c, axis=0),
+                dx_buf,
+            )
+
+            # ---- p2p for the next tick (p2p.py:48,69 analog) --------------
+            next_act = lax.ppermute(out, "pp", [(i, (i + 1) % Pn) for i in range(Pn)])
+            next_dh = lax.ppermute(dx_f32, "pp", [(i, (i - 1) % Pn) for i in range(Pn)])
+            return (ring, next_act, next_dh, gL, gH, loss_sum, dx_buf), None
+
+        mb_shape = xm.shape[1:]
+        varying = lambda x: lax.pcast(x, ("pp",), to="varying")
+        carry0 = (
+            varying(jnp.zeros((R,) + mb_shape, xm.dtype)),  # ring
+            varying(jnp.zeros(mb_shape, xm.dtype)),  # recv_act
+            varying(jnp.zeros(mb_shape, jnp.float32)),  # recv_dh
+            varying(f32(local_layers)),  # gL
+            varying(f32(head_p)),  # gH
+            varying(jnp.float32(0.0)),  # loss_sum
+            varying(jnp.zeros(xm.shape, jnp.float32)),  # dx_buf
+        )
+        (ring, _, _, gL, gH, loss_sum, dx_buf), _ = lax.scan(
+            tick, carry0, jnp.arange(T)
+        )
+        # loss/head grads/dx live on one stage each; psum broadcasts them
+        loss = lax.psum(loss_sum, "pp")
+        gH = jax.tree.map(lambda g: lax.psum(g, "pp"), gH)
+        dx = lax.psum(dx_buf, "pp")
+        return loss, gL, gH, dx
+
+    sharded = jax.shard_map(
+        pipe,
+        mesh=mesh,
+        in_specs=(layer_axis_specs, P(), P(), P()),
+        out_specs=(P(), layer_axis_specs, P(), P()),
+        axis_names={"pp"},
+    )
+    return jax.jit(sharded)(layer_params, head_params, x_micro, aux_micro)

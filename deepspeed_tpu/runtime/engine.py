@@ -164,6 +164,106 @@ class DeepSpeedEngine:
                 learning_rate=lr_schedule,
             )
 
+        # --- ZeRO-Infinity parameter tier (offload_param; stage3.py:465 analog)
+        offp = zcfg.offload_param
+        self.param_offload_enabled = (
+            offp.device in ("cpu", "nvme") and not self.onebit
+        )
+        if self.param_offload_enabled:
+            # params never materialize on device: blocks stream host/NVMe ->
+            # HBM per layer (runtime/zero/infinity.py). Everything below that
+            # builds device param/opt state is bypassed.
+            self._init_param_offload(model, config, zcfg, seed, params)
+            self._rng = jax.random.PRNGKey(seed + 1)
+        else:
+            self._init_device_state(model, config, zcfg, seed, params, opt_cfg)
+            self._rng = jax.random.PRNGKey(seed + 1)
+
+        # --- observability (reference EngineTimers / ThroughputTimer / Monitor)
+        self.timers = SynchronizedWallClockTimer()
+        self.tput_timer = ThroughputTimer(
+            batch_size=self.train_batch_size_value, steps_per_output=config.steps_per_print
+        )
+        self.steps_per_print = config.steps_per_print
+        self.wall_clock_breakdown = config.wall_clock_breakdown
+        self.global_steps = 0  # host-side count of train_batch calls
+        self.monitor = None  # wired by deepspeed_tpu.initialize when configured
+        self._finish_init(model, config, training_data, collate_fn)
+
+    def _init_param_offload(self, model, config, zcfg, seed, params) -> None:
+        """Engage the block-streaming Infinity engine (params on host/NVMe)."""
+        from .zero.infinity import InfinityEngine
+
+        api = (model.extra or {}).get("block_api")
+        if callable(api):
+            api = api()
+        if api is None:
+            raise ValueError(
+                "zero_optimization.offload_param requires a model exposing a "
+                "block API (ModuleSpec.extra['block_api'])"
+            )
+        if config.fp16.enabled:
+            raise ValueError("offload_param supports bf16/fp32 (no fp16 loss scaling)")
+        if zcfg.stage != 3:
+            raise ValueError(
+                "offload_param requires ZeRO stage 3 (reference: param offload "
+                "is a stage-3 feature, zero/config.py)"
+            )
+        if any(mesh_axis_size(self.mesh, ax) > 1 for ax in self.mesh.axis_names):
+            raise ValueError(
+                "offload_param streams blocks on a single chip per host; "
+                "use a 1-device mesh (dp composes at the host level)"
+            )
+        offp = zcfg.offload_param
+        off = zcfg.offload_optimizer
+        opt_cfg = config.optimizer
+        p = (opt_cfg.params if opt_cfg else None) or {}
+        self._infinity = InfinityEngine(
+            api,
+            lr_schedule=self.lr_schedule,
+            betas=tuple(p.get("betas", (0.9, 0.999))),
+            eps=float(p.get("eps", 1e-8)),
+            weight_decay=float(p.get("weight_decay", 0.0)),
+            device=offp.device,
+            opt_device=off.device if off.device in ("cpu", "nvme") else "cpu",
+            nvme_path=offp.nvme_path,
+            gradient_clipping=float(config.gradient_clipping or 0.0),
+            compute_dtype=self.compute_dtype,
+            seed=seed,
+            initial_params=params,
+        )
+        self.offload_enabled = False
+        self._offload = None
+        replicated = NamedSharding(self.mesh, PartitionSpec())
+        scale_state = ls.from_config(config.fp16)
+        self.param_shardings = ()
+        self.grad_shardings = ()
+        self.opt_shardings = ()
+        self.state = TrainState(
+            params=(),
+            opt_state=(),
+            loss_scale=jax.device_put(scale_state, replicated),
+            global_step=jax.device_put(jnp.int32(0), replicated),
+            skipped_steps=jax.device_put(jnp.int32(0), replicated),
+        )
+        self.state_shardings = TrainState(
+            params=(),
+            opt_state=(),
+            loss_scale=jax.tree.map(lambda _: replicated, scale_state),
+            global_step=replicated,
+            skipped_steps=replicated,
+        )
+        self._replicated = replicated
+        self.batch_spec = PartitionSpec(None, "dp")
+        self.micro_batch_size = config.train_micro_batch_size_per_gpu
+        self.gradient_accumulation_steps_value = config.gradient_accumulation_steps
+        self.train_batch_size_value = config.train_batch_size
+        self._train_step = self._infinity_dispatch
+        self._eval_step = None  # eval_batch routes through the streamed sweep
+
+    def _init_device_state(self, model, config, zcfg, seed, params, opt_cfg) -> None:
+        """Standard path: params + optimizer state live on device (sharded)."""
+        mesh = self.mesh
         # --- params: born sharded (zero.Init analog)
         init_rng = jax.random.PRNGKey(seed)
         abstract_params = jax.eval_shape(model.init, init_rng)
@@ -255,18 +355,8 @@ class DeepSpeedEngine:
                 out_shardings=(self.state_shardings, None),
             )
         self._eval_step = jax.jit(self._make_eval_step())
-        self._rng = jax.random.PRNGKey(seed + 1)
 
-        # --- observability (reference EngineTimers / ThroughputTimer / Monitor)
-        self.timers = SynchronizedWallClockTimer()
-        self.tput_timer = ThroughputTimer(
-            batch_size=self.train_batch_size_value, steps_per_output=config.steps_per_print
-        )
-        self.steps_per_print = config.steps_per_print
-        self.wall_clock_breakdown = config.wall_clock_breakdown
-        self.global_steps = 0  # host-side count of train_batch calls
-        self.monitor = None  # wired by deepspeed_tpu.initialize when configured
-
+    def _finish_init(self, model, config, training_data, collate_fn) -> None:
         # --- curriculum learning (reference engine.py:1643-1649 hook)
         self.curriculum_scheduler = None
         if config.curriculum_learning.enabled:
@@ -290,7 +380,7 @@ class DeepSpeedEngine:
             self.training_dataloader = self.deepspeed_io(training_data, collate_fn=collate_fn)
 
         log_dist(
-            f"DeepSpeedEngine initialized: mesh={dict(mesh.shape)} zero_stage={self.zero_stage} "
+            f"DeepSpeedEngine initialized: mesh={dict(self.mesh.shape)} zero_stage={self.zero_stage} "
             f"precision={'fp16' if self.fp16_enabled else ('bf16' if self.bf16_enabled else str(self.compute_dtype))} "
             f"batch=({self.train_batch_size_value}={self.micro_batch_size}x{self.gradient_accumulation_steps_value}x{self.dp_world_size})"
         )
@@ -552,14 +642,40 @@ class DeepSpeedEngine:
 
         return grad_step
 
+    def _infinity_dispatch(self, state: "TrainState", batch: PyTree, rng):
+        """Block-streamed step: fwd/bwd sweeps fetch params per layer from
+        host/NVMe; host SIMD Adam updates the masters (zero/infinity.py)."""
+        out = self._infinity.train_step(batch, self.global_steps, rng)
+        new_state = TrainState(
+            params=(),
+            opt_state=(),
+            loss_scale=state.loss_scale,
+            global_step=state.global_step + 1,
+            skipped_steps=state.skipped_steps,
+        )
+        metrics = {
+            "loss": jnp.float32(out["loss"]),
+            "grad_norm": jnp.float32(out["grad_norm"]),
+            "loss_scale": jnp.float32(1.0),
+            "overflow": jnp.bool_(False),
+            "lr": jnp.float32(out["lr"]),
+            "global_step": new_state.global_step,
+        }
+        return new_state, metrics
+
     def _offload_dispatch(self, state: "TrainState", batch: PyTree, rng):
         loss, grads, gnorm = self._grad_step(state.params, batch, rng)
         step = self.global_steps
-        # host step over fp32 master (+ NVMe subgroup streaming when tiered)
+        # pipelined host step: grads stream D2H per subgroup while earlier
+        # subgroups run the SIMD Adam; updated leaves upload H2D immediately
+        # (see offload_engine.step docstring)
+        shard_leaves = jax.tree.leaves(self.param_shardings)
         new_params = self._offload.step(
-            jax.device_get(grads), step, compute_dtype=self.compute_dtype
+            grads,
+            step,
+            compute_dtype=self.compute_dtype,
+            put_leaf=lambda li, arr: jax.device_put(arr, shard_leaves[li]),
         )
-        new_params = jax.tree.map(jax.device_put, new_params, self.param_shardings)
         new_state = TrainState(
             params=new_params,
             opt_state=state.opt_state,
@@ -826,6 +942,8 @@ class DeepSpeedEngine:
     def eval_batch(self, batch: PyTree) -> jnp.ndarray:
         device_batch = self.shard_batch(batch)
         self._rng, step_rng = jax.random.split(self._rng)
+        if self.param_offload_enabled:
+            return jnp.float32(self._infinity.eval_loss(device_batch, step_rng))
         return self._eval_step(self.state.params, device_batch, step_rng)
 
     def predict(self, batch: PyTree):

@@ -1,0 +1,525 @@
+"""ZeRO-Infinity parameter tier: block-streamed training with params on host/NVMe.
+
+Analog of the reference's NVMe parameter path — ``AsyncPartitionedParameterSwapper``
+engaged from stage 3 (``/root/reference/deepspeed/runtime/zero/stage3.py:465``,
+``swap_tensor/partitioned_param_swapper.py:35``) plus the param-coordinator
+fetch/release cycle (``partitioned_param_coordinator.py:237,356``). The torch
+design hooks every submodule to allgather params just-in-time and re-partition
+after use. The TPU-native formulation exploits the model's block structure
+directly:
+
+- **persistent part** (embeddings, final norm, tied head — the analog of
+  ``stage3_param_persistence_threshold`` keeping small params resident):
+  bf16 copy stays in HBM for the whole step.
+- **streamed blocks**: each transformer block's bf16 params live on host DRAM
+  (``offload_param.device="cpu"``) or NVMe files via the aio engine
+  (``"nvme"``). The forward sweep runs block-at-a-time with a two-deep
+  prefetch window (``device_put`` of block i+1 is dispatched before block i's
+  compute, so the H2D copy overlaps the matmuls); the backward sweep re-fetches
+  blocks in reverse and streams each block's grads back to host as soon as the
+  next block's VJP is dispatched.
+- **optimizer tier**: fp32 master + Adam moments per block live in DRAM or in
+  NVMe ``[master|m|v]`` records through ``PipelinedOptimizerSwapper`` (step(i)
+  overlaps prefetch(i+1)/writeback(i-1) — reference
+  ``pipelined_optimizer_swapper.py``); the update runs on host cores through
+  the SIMD C++ Adam (``csrc/adam``).
+
+HBM high-water = persistent part + ~2 blocks (current + prefetch) + one
+block's grads + the L boundary activations — the property that lets a 13-20B
+model train on one 16 GB chip (see ``memory_math`` and
+tests/unit/test_infinity.py).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.cpu_adam import DeepSpeedCPUAdam
+from ...utils.logging import log_dist
+
+PyTree = Any
+
+try:  # numpy has no native bfloat16; jax ships ml_dtypes
+    import ml_dtypes
+
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+except Exception:  # pragma: no cover
+    _BF16 = np.dtype(np.float16)
+
+
+@dataclass
+class BlockAPI:
+    """Block-structured view of a model for parameter streaming.
+
+    All block params must have identical pytree structure/shapes so one
+    compiled ``block_fwd``/VJP serves every layer (scan-over-layers unrolled
+    into a host loop).
+    """
+
+    num_blocks: int
+    init_persistent: Callable[[Any], PyTree]  # rng -> persistent params
+    init_block: Callable[[Any, int], PyTree]  # (rng, layer_idx) -> block params
+    embed_fwd: Callable  # (persistent, batch, rng, train) -> h
+    block_fwd: Callable  # (block_params, h, rng, train) -> h
+    head_loss: Callable  # (persistent, h, batch) -> scalar mean loss
+    # full-params pytree -> (persistent, [block_0 .. block_{L-1}]); lets the
+    # engine adopt externally initialized weights (and the parity tests start
+    # both engines from identical values)
+    split_params: Optional[Callable[[PyTree], Tuple[PyTree, List[PyTree]]]] = None
+
+
+def memory_math(
+    n_layer: int,
+    n_embd: int,
+    vocab_size: int,
+    seq: int,
+    micro_batch: int,
+    n_positions: Optional[int] = None,
+    mlp_ratio: int = 4,
+) -> Dict[str, float]:
+    """HBM footprint estimate (bytes) for the streamed step; the demo that a
+    13-20B model fits one 16 GB chip (BASELINE.md ZeRO-Infinity row)."""
+    P = n_positions or seq
+    block_params = 12 * n_embd * n_embd  # attn 4E^2 + mlp 2*ratio*E^2 (=8E^2 at 4x)
+    persistent_params = vocab_size * n_embd + P * n_embd + 2 * n_embd
+    total_params = n_layer * block_params + persistent_params
+    bf16 = 2
+    act = micro_batch * seq * n_embd * bf16
+    hbm = {
+        "persistent_bf16": persistent_params * bf16,
+        "blocks_resident_bf16": 2 * block_params * bf16,  # current + prefetch
+        "block_grads_fp32": 2 * block_params * 4,  # vjp out for 2 in-flight blocks
+        "boundary_acts_bf16": (n_layer + 1) * act,
+        # vjp workspace: recomputed internals of ONE block (qkv, attn probs
+        # tiled by flash, mlp hidden) ~ 8 activations deep
+        "vjp_workspace": 8 * act + micro_batch * seq * mlp_ratio * n_embd * bf16,
+        "logits_fp32": micro_batch * seq * vocab_size * 4,
+    }
+    hbm["total_hbm"] = float(sum(hbm.values()))
+    hbm["total_params"] = float(total_params)
+    hbm["dram_or_nvme_bytes"] = float(total_params * (2 + 12))  # bf16 copy + fp32 m/v/master
+    return hbm
+
+
+class InfinityEngine:
+    """Single-chip (per-host) block-streaming train step.
+
+    dp>1 composes by giving each host its batch shard and pmean-ing host grads
+    through the comm backend before the optimizer step; v1 targets the
+    BASELINE single-chip capacity row ("OPT-13B on one chip").
+    """
+
+    def __init__(
+        self,
+        api: BlockAPI,
+        lr_schedule,
+        betas=(0.9, 0.999),
+        eps: float = 1e-8,
+        weight_decay: float = 0.0,
+        device: str = "cpu",  # offload_param.device: cpu | nvme
+        opt_device: str = "cpu",  # offload_optimizer.device
+        nvme_path: str = "/tmp/ds_tpu_nvme",
+        gradient_clipping: float = 0.0,
+        compute_dtype=jnp.bfloat16,
+        seed: int = 0,
+        initial_params: Optional[PyTree] = None,
+    ):
+        assert device in ("cpu", "nvme"), device
+        assert opt_device in ("cpu", "nvme"), opt_device
+        self.api = api
+        self.device = device
+        self.opt_device = opt_device
+        self.lr_schedule = lr_schedule
+        self.clip = float(gradient_clipping)
+        self.compute_dtype = compute_dtype
+        self.opt = DeepSpeedCPUAdam(
+            lr=1e-3, betas=betas, eps=eps, weight_decay=weight_decay, adamw_mode=True
+        )
+        L = api.num_blocks
+
+        # ---- host-side parameter storage --------------------------------
+        rng = jax.random.PRNGKey(seed)
+        pers_rng, *block_rngs = jax.random.split(rng, L + 1)
+        init_blocks = None
+        if initial_params is not None:
+            assert api.split_params is not None, "block API lacks split_params"
+            pers, init_blocks = api.split_params(jax.device_get(initial_params))
+            pers = jax.device_get(pers)
+        else:
+            # persistent part: fp32 master pytree in DRAM (small)
+            pers = jax.device_get(jax.jit(api.init_persistent)(pers_rng))
+        self._pers_leaves, self._pers_tree = jax.tree.flatten(pers)
+        # np.array forces a writable copy (zero-copy views of jax buffers are
+        # read-only and the SIMD Adam updates masters in place)
+        self._pers_master = [np.array(l, dtype=np.float32) for l in self._pers_leaves]
+        self._pers_shapes = [l.shape for l in self._pers_leaves]
+
+        # block template: flatten/unflatten spec shared by every block
+        b0 = (
+            jax.device_get(init_blocks[0])
+            if init_blocks is not None
+            else jax.device_get(jax.jit(lambda k: api.init_block(k, 0))(block_rngs[0]))
+        )
+        b0_leaves, self._blk_tree = jax.tree.flatten(b0)
+        self._blk_shapes = [l.shape for l in b0_leaves]
+        self._blk_sizes = [int(np.prod(s)) if s else 1 for s in self._blk_shapes]
+        self._blk_offsets = np.cumsum([0] + self._blk_sizes)
+        self.block_numel = int(self._blk_offsets[-1])
+
+        # bf16 compute copies per block (DRAM or NVMe)
+        self._param_swapper = None
+        self._blk_bf16: List[Optional[np.ndarray]] = [None] * L
+        # fp32 master + moments per block (DRAM or NVMe [master|m|v] records)
+        self._opt_swapper = None
+        self._blk_master: List[Optional[np.ndarray]] = [None] * L
+        if device == "nvme" or opt_device == "nvme":
+            os.makedirs(nvme_path, exist_ok=True)
+        if device == "nvme":
+            from ..swap_tensor.partitioned_param_swapper import (
+                AsyncPartitionedParameterSwapper,
+            )
+
+            self._param_swapper = AsyncPartitionedParameterSwapper(
+                os.path.join(nvme_path, "infinity"), dtype=_BF16
+            )
+        if opt_device == "nvme":
+            from ..swap_tensor.partitioned_optimizer_swapper import (
+                PipelinedOptimizerSwapper,
+            )
+
+            self._opt_swapper = PipelinedOptimizerSwapper(
+                os.path.join(nvme_path, "infinity_opt"), n_tensors=3
+            )
+
+        for i in range(L):
+            if init_blocks is not None:
+                blk = jax.device_get(init_blocks[i]) if i else b0
+            else:
+                blk = b0 if i == 0 else jax.device_get(
+                    jax.jit(lambda k, i=i: api.init_block(k, i))(block_rngs[i])
+                )
+            flat = np.concatenate(
+                [np.asarray(l, np.float32).reshape(-1) for l in jax.tree.leaves(blk)]
+            )
+            self._store_block_master(i, flat, init=True)
+            self._store_block_bf16(i, flat.astype(_BF16))
+        del b0
+
+        self._g_pers_acc: Optional[List[np.ndarray]] = None
+        self._g_blk_acc: Dict[int, np.ndarray] = {}
+        # device-resident persistent bf16 copy, refreshed after each step
+        self._pers_dev = None
+        # instrumentation: how many block-param device buffers are live at
+        # once (the "window"); the memory-bound test asserts <= 2
+        self._resident_blocks = 0
+        self.max_resident_blocks = 0
+        self._build_jits()
+        total = L * self.block_numel + sum(int(np.prod(s)) for s in self._pers_shapes)
+        log_dist(
+            f"ZeRO-Infinity param tier: {total} params, {L} streamed blocks "
+            f"({self.block_numel} params each) on {device}; optimizer tier on "
+            f"{opt_device}; HBM window = persistent + 2 blocks"
+        )
+
+    # ---- block storage ----------------------------------------------------
+    def _store_block_bf16(self, i: int, flat_bf16: np.ndarray) -> None:
+        if self._param_swapper is not None:
+            # register adopts the array into an aligned buffer; swap_out
+            # persists + frees the DRAM copy
+            self._param_swapper.register(i, flat_bf16)
+            self._param_swapper.swap_out([i], release=True)
+        else:
+            self._blk_bf16[i] = flat_bf16
+
+    def _load_block_bf16(self, i: int) -> np.ndarray:
+        if self._param_swapper is not None:
+            self._param_swapper.swap_in([i])
+            return self._param_swapper.get(i)
+        return self._blk_bf16[i]
+
+    def _release_block_bf16(self, i: int) -> None:
+        if self._param_swapper is not None and self._param_swapper.available(i):
+            # drop the DRAM copy without rewriting (params unchanged since load)
+            self._param_swapper._buffers.pop(i, None)
+            self._param_swapper._available.discard(i)
+
+    def _store_block_master(self, i: int, master: np.ndarray, init: bool = False) -> None:
+        if self._opt_swapper is not None:
+            if init:
+                z = np.zeros_like(master)
+                self._opt_swapper.initialize_subgroup(i, [master, z, z])
+                self._opt_swapper.swap_out(i, release=True)
+            # non-init: run_pipeline writes back via its own swap_out
+        else:
+            self._blk_master[i] = master
+            if init:
+                pass  # moments lazy-init inside DeepSpeedCPUAdam
+
+    # ---- compiled per-block programs --------------------------------------
+    def _build_jits(self) -> None:
+        api = self.api
+
+        self._j_embed = jax.jit(api.embed_fwd, static_argnums=3)
+
+        self._j_block = jax.jit(api.block_fwd, static_argnums=3)
+
+        def blk_bwd(blk, h, rng, dh):
+            _, vjp = jax.vjp(lambda b, x: api.block_fwd(b, x, rng, True), blk, h)
+            gb, dx = vjp(dh)
+            return gb, dx
+
+        self._j_block_bwd = jax.jit(blk_bwd)
+
+        def head(pers, h, batch):
+            return api.head_loss(pers, h, batch)
+
+        self._j_head = jax.jit(jax.value_and_grad(head, argnums=(0, 1)))
+        self._j_head_loss = jax.jit(head)
+
+        def embed_bwd(pers, batch, rng, dh):
+            _, vjp = jax.vjp(lambda p: api.embed_fwd(p, batch, rng, True), pers)
+            (gp,) = vjp(dh)
+            return gp
+
+        self._j_embed_bwd = jax.jit(embed_bwd)
+
+    # ---- device staging ----------------------------------------------------
+    def _put_block(self, i: int):
+        flat = self._load_block_bf16(i)
+        leaves = [
+            jnp.asarray(
+                flat[self._blk_offsets[j] : self._blk_offsets[j + 1]].reshape(
+                    self._blk_shapes[j]
+                )
+            )
+            for j in range(len(self._blk_shapes))
+        ]
+        self._release_block_bf16(i)
+        self._resident_blocks += 1
+        self.max_resident_blocks = max(self.max_resident_blocks, self._resident_blocks)
+        return jax.tree.unflatten(self._blk_tree, leaves)
+
+    def _mark_block_released(self) -> None:
+        """Caller drops its reference; XLA frees the buffers once the last
+        dispatched computation using them retires."""
+        self._resident_blocks -= 1
+
+    def _persistent_device(self):
+        if self._pers_dev is None:
+            leaves = [
+                jnp.asarray(m.astype(_BF16).reshape(s))
+                for m, s in zip(self._pers_master, self._pers_shapes)
+            ]
+            self._pers_dev = jax.tree.unflatten(self._pers_tree, leaves)
+        return self._pers_dev
+
+    # ---- the streamed step -------------------------------------------------
+    def _micro_sweep(self, batch_dev: PyTree, rng) -> jnp.ndarray:
+        """One microbatch fwd+bwd; accumulates host grads. Returns loss."""
+        L = self.api.num_blocks
+        pers = self._persistent_device()
+        rngs = jax.random.split(rng, L + 1)
+
+        h = self._j_embed(pers, batch_dev, rngs[L], True)
+        acts = [h]
+        nxt = self._put_block(0)
+        for i in range(L):
+            cur, nxt = nxt, None
+            if i + 1 < L:
+                nxt = self._put_block(i + 1)  # async H2D overlaps compute
+            h = self._j_block(cur, h, rngs[i], True)
+            acts.append(h)
+            cur = None
+            self._mark_block_released()
+
+        (loss, (g_pers, dh)) = self._j_head(pers, acts[L], batch_dev)
+        self._acc_pers(g_pers)
+
+        nxt = self._put_block(L - 1)
+        pending: Optional[Tuple[int, Any]] = None
+        for i in range(L - 1, -1, -1):
+            cur, nxt = nxt, None
+            if i - 1 >= 0:
+                nxt = self._put_block(i - 1)
+            g_blk, dh = self._j_block_bwd(cur, acts[i], rngs[i], dh)
+            acts[i + 1] = None  # boundary act consumed
+            if pending is not None:
+                # D2H of block i+1's grads overlaps block i's VJP on device
+                self._acc_block(*pending)
+            pending = (i, g_blk)
+            cur = None
+            self._mark_block_released()
+        if pending is not None:
+            self._acc_block(*pending)
+
+        g_pers_embed = self._j_embed_bwd(pers, batch_dev, rngs[L], dh)
+        self._acc_pers(g_pers_embed)
+        return loss
+
+    def _acc_pers(self, g_pers_dev: PyTree) -> None:
+        leaves = [np.asarray(l, np.float32).reshape(-1) for l in jax.tree.leaves(
+            jax.device_get(g_pers_dev)
+        )]
+        if self._g_pers_acc is None:
+            self._g_pers_acc = leaves
+        else:
+            for a, g in zip(self._g_pers_acc, leaves):
+                a += g
+
+    def _acc_block(self, i: int, g_blk_dev: PyTree) -> None:
+        flat = np.concatenate(
+            [np.asarray(l, np.float32).reshape(-1) for l in jax.tree.leaves(
+                jax.device_get(g_blk_dev)
+            )]
+        )
+        if i in self._g_blk_acc:
+            self._g_blk_acc[i] += flat
+        else:
+            self._g_blk_acc[i] = flat
+
+    def train_step(self, batch_gas: PyTree, global_step: int, rng) -> Dict[str, Any]:
+        """batch_gas leaves are [gas, micro, ...] device (or host) arrays."""
+        gas = int(jax.tree.leaves(batch_gas)[0].shape[0])
+        self._g_pers_acc = None
+        self._g_blk_acc = {}
+        losses = []
+        for g in range(gas):
+            micro = jax.tree.map(lambda x: x[g], batch_gas)
+            losses.append(self._micro_sweep(micro, jax.random.fold_in(rng, g)))
+        loss = float(np.mean([float(jax.device_get(l)) for l in losses]))
+
+        # mean over gas + global grad norm (host side, all grads staged)
+        inv = 1.0 / gas
+        sq = 0.0
+        for gacc in self._g_blk_acc.values():
+            gacc *= inv
+            sq += float(np.dot(gacc, gacc))
+        for gacc in self._g_pers_acc:
+            gacc *= inv
+            sq += float(np.dot(gacc, gacc))
+        gnorm = float(np.sqrt(sq))
+        coef = 1.0
+        if self.clip > 0.0 and gnorm > self.clip:
+            coef = self.clip / (gnorm + 1e-6)
+
+        lr = (
+            float(self.lr_schedule(global_step))
+            if callable(self.lr_schedule)
+            else float(self.lr_schedule)
+        )
+
+        # ---- per-block optimizer tier (pipelined when NVMe) -------------
+        L = self.api.num_blocks
+
+        if self._opt_swapper is not None:
+
+            def step_fn(i, tensors):
+                master, m, v = tensors
+                self.opt.set_state(i, [m, v])
+                self.opt._step.setdefault(i, 0)
+                g = self._g_blk_acc[i]
+                if coef != 1.0:
+                    g = g * coef
+                self.opt.step(master, g, key=i, lr=lr)
+                self._store_block_bf16(i, master.astype(_BF16))
+                del self.opt._m[i], self.opt._v[i]  # views into the record
+                del self._g_blk_acc[i]
+
+            self._opt_swapper.run_pipeline(list(range(L)), step_fn)
+        else:
+            for i in range(L):
+                g = self._g_blk_acc.pop(i)
+                if coef != 1.0:
+                    g = g * coef
+                self.opt.step(self._blk_master[i], g, key=i, lr=lr)
+                self._store_block_bf16(i, self._blk_master[i].astype(_BF16))
+
+        # ---- persistent part (always DRAM; key space above the blocks) --
+        for j, (m, g) in enumerate(zip(self._pers_master, self._g_pers_acc)):
+            if coef != 1.0:
+                g = g * coef
+            self.opt.step(m.reshape(-1), g, key=L + j, lr=lr)
+        self._pers_dev = None  # refresh device copy next step
+        self._g_pers_acc = None
+        return {"loss": loss, "grad_norm": gnorm * coef, "lr": lr}
+
+    def eval_loss(self, batch_gas: PyTree, rng) -> float:
+        """Forward-only streamed sweep (train=False), mean loss over gas."""
+        L = self.api.num_blocks
+        pers = self._persistent_device()
+        gas = int(jax.tree.leaves(batch_gas)[0].shape[0])
+        losses = []
+        for g in range(gas):
+            micro = jax.tree.map(lambda x: x[g], batch_gas)
+            h = self._j_embed(pers, micro, rng, False)
+            nxt = self._put_block(0)
+            for i in range(L):
+                cur, nxt = nxt, None
+                if i + 1 < L:
+                    nxt = self._put_block(i + 1)
+                h = self._j_block(cur, h, rng, False)
+                cur = None
+                self._mark_block_released()
+            losses.append(float(jax.device_get(self._j_head_loss(pers, h, micro))))
+        return float(np.mean(losses))
+
+    # ---- checkpoint surface ------------------------------------------------
+    def state_dict(self) -> Dict[str, Any]:
+        L = self.api.num_blocks
+        blocks = np.empty((L, self.block_numel), np.float32)
+        ms = np.empty((L, self.block_numel), np.float32)
+        vs = np.empty((L, self.block_numel), np.float32)
+        for i in range(L):
+            if self._opt_swapper is not None:
+                self._opt_swapper.swap_in(i)
+                master, m, v = self._opt_swapper.tensors(i)
+                blocks[i], ms[i], vs[i] = master, m, v
+                self._opt_swapper.swap_out(i, release=True)
+            else:
+                blocks[i] = self._blk_master[i]
+                m, v = self.opt.state_tensors(i, self.block_numel)
+                ms[i], vs[i] = m, v
+        pers_state = [
+            self.opt.state_tensors(L + j, m.size) for j, m in enumerate(self._pers_master)
+        ]
+        return {
+            "blocks": blocks,
+            "block_m": ms,
+            "block_v": vs,
+            "persistent": [m.copy() for m in self._pers_master],
+            "persistent_m": [m.copy() for m, _ in pers_state],
+            "persistent_v": [v.copy() for _, v in pers_state],
+            "steps": {k: int(s) for k, s in self.opt._step.items()},
+        }
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        L = self.api.num_blocks
+        for i in range(L):
+            master = np.asarray(sd["blocks"][i], np.float32)
+            if self._opt_swapper is not None:
+                self._opt_swapper.swap_in(i)
+                t_master, t_m, t_v = self._opt_swapper.tensors(i)
+                t_master[:] = master
+                t_m[:] = sd["block_m"][i]
+                t_v[:] = sd["block_v"][i]
+                self._opt_swapper.swap_out(i, release=True)
+            else:
+                self._blk_master[i] = master.copy()
+                self.opt.set_state(i, [np.array(sd["block_m"][i]), np.array(sd["block_v"][i])])
+            self._store_block_bf16(i, master.astype(_BF16))
+        for j, (m, saved) in enumerate(zip(self._pers_master, sd["persistent"])):
+            m[:] = saved
+            if "persistent_m" in sd:
+                self.opt.set_state(
+                    L + j,
+                    [np.array(sd["persistent_m"][j]), np.array(sd["persistent_v"][j])],
+                )
+        for k, s in sd.get("steps", {}).items():
+            self.opt._step[int(k)] = int(s)
+        self._pers_dev = None

@@ -1,0 +1,185 @@
+"""ZeRO-Infinity parameter tier: block streaming, NVMe tiers, memory math.
+
+Reference analog: the stage-3 offload tests in tests/unit/test_zero.py
+(offload combos) and the swap-tensor tests; here the property under test is
+the VERDICT r1 item-3 contract — HBM high-water = persistent part + a
+2-block window while params live on host/NVMe.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models import gpt2
+from deepspeed_tpu.parallel.topology import MeshSpec
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+from deepspeed_tpu.runtime.zero.infinity import InfinityEngine, memory_math
+
+
+def _cfg(n_layer=3):
+    return gpt2.get_config(
+        "gpt2-tiny", n_layer=n_layer, n_positions=64, attn_impl="jnp"
+    )
+
+
+def _ds(offload_param_device, offload_opt_device="none", nvme_path="/tmp/ds_tpu_test_nvme"):
+    return DeepSpeedConfig.load(
+        {
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3, "weight_decay": 0.0}},
+            "zero_optimization": {
+                "stage": 3,
+                "offload_param": {"device": offload_param_device, "nvme_path": nvme_path},
+                "offload_optimizer": {"device": offload_opt_device, "nvme_path": nvme_path},
+            },
+            "bf16": {"enabled": True},
+            "steps_per_print": 10**9,
+        },
+        dp_world_size=1,
+    )
+
+
+def _batch(cfg, rs, n=4, seq=32):
+    return {"input_ids": rs.randint(0, cfg.vocab_size, size=(n, seq)).astype(np.int32)}
+
+
+class TestInfinityEngine:
+    def test_streamed_step_matches_host_offload_engine(self, mesh_single, rng):
+        """Same init, same batches: the block-streamed step must track the
+        (already parity-tested) host-offload engine — both run the SIMD CPU
+        Adam over bf16-compute grads, so trajectories stay close."""
+        cfg = _cfg()
+        module = gpt2.make_module(cfg)
+        params = jax.jit(module.init)(jax.random.PRNGKey(7))
+
+        ds_ref = DeepSpeedConfig.load(
+            {
+                "train_micro_batch_size_per_gpu": 2,
+                "gradient_accumulation_steps": 2,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3, "weight_decay": 0.0}},
+                "zero_optimization": {"stage": 0, "offload_optimizer": {"device": "cpu"}},
+                "bf16": {"enabled": True},
+                "steps_per_print": 10**9,
+            },
+            dp_world_size=1,
+        )
+        eng_ref = DeepSpeedEngine(module, ds_ref, mesh=mesh_single, seed=0, params=params)
+        eng_inf = DeepSpeedEngine(
+            gpt2.make_module(cfg), _ds("cpu"), mesh=mesh_single, seed=0, params=params
+        )
+        assert eng_inf.param_offload_enabled
+
+        losses_ref, losses_inf = [], []
+        for step in range(4):
+            batch = _batch(cfg, np.random.RandomState(step))
+            losses_ref.append(float(jax.device_get(eng_ref.train_batch(batch)["loss"])))
+            losses_inf.append(float(jax.device_get(eng_inf.train_batch(batch)["loss"])))
+        np.testing.assert_allclose(losses_inf, losses_ref, rtol=0.05, atol=0.05)
+        # learning check: repeat one batch — loss must drop
+        fixed = _batch(cfg, np.random.RandomState(99))
+        repeat = [
+            float(jax.device_get(eng_inf.train_batch(fixed)["loss"])) for _ in range(5)
+        ]
+        assert repeat[-1] < repeat[0], f"no learning: {repeat}"
+
+    def test_hbm_window_is_two_blocks(self, mesh_single):
+        cfg = _cfg(n_layer=4)
+        eng = DeepSpeedEngine(gpt2.make_module(cfg), _ds("cpu"), mesh=mesh_single, seed=0)
+        batch = _batch(cfg, np.random.RandomState(0))
+        eng.train_batch(batch)
+        eng.train_batch(batch)
+        inf = eng._infinity
+        # the load-bearing claim: never more than current + prefetch resident
+        assert inf.max_resident_blocks <= 2, inf.max_resident_blocks
+        assert inf._resident_blocks == 0  # all released between steps
+
+    def test_nvme_tier_roundtrip(self, mesh_single, tmp_path):
+        cfg = _cfg()
+        ds = _ds("nvme", "nvme", nvme_path=str(tmp_path))
+        eng = DeepSpeedEngine(gpt2.make_module(cfg), ds, mesh=mesh_single, seed=0)
+        inf = eng._infinity
+        assert inf._param_swapper is not None and inf._opt_swapper is not None
+        batch = _batch(cfg, np.random.RandomState(1))
+        l0 = float(jax.device_get(eng.train_batch(batch)["loss"]))
+        l1 = float(jax.device_get(eng.train_batch(batch)["loss"]))
+        assert np.isfinite(l0) and np.isfinite(l1)
+        assert l1 < l0  # same batch twice: must improve
+        # params + optimizer records must be swapped OUT of DRAM between steps
+        assert not inf._param_swapper._buffers, "bf16 block copies left in DRAM"
+        assert inf._param_swapper.in_dram_bytes() == 0
+        # NVMe files exist for every block
+        for i in range(cfg.n_layer):
+            assert os.path.exists(inf._param_swapper._path(i))
+
+    def test_checkpoint_state_roundtrip(self, mesh_single):
+        cfg = _cfg()
+        eng = DeepSpeedEngine(gpt2.make_module(cfg), _ds("cpu"), mesh=mesh_single, seed=0)
+        batch = _batch(cfg, np.random.RandomState(2))
+        eng.train_batch(batch)
+        sd = eng._infinity.state_dict()
+
+        eng2 = DeepSpeedEngine(gpt2.make_module(cfg), _ds("cpu"), mesh=mesh_single, seed=1)
+        eng2._infinity.load_state_dict(sd)
+        # identical continued trajectories
+        b2 = _batch(cfg, np.random.RandomState(3))
+        m1 = eng.train_batch(b2)
+        m2 = eng2.train_batch(b2)
+        np.testing.assert_allclose(
+            float(jax.device_get(m1["loss"])), float(jax.device_get(m2["loss"])), rtol=1e-5
+        )
+
+    def test_eval_loss_matches_train_loss_scale(self, mesh_single):
+        cfg = _cfg()
+        eng = DeepSpeedEngine(gpt2.make_module(cfg), _ds("cpu"), mesh=mesh_single, seed=0)
+        batch = _batch(cfg, np.random.RandomState(4))
+        train_loss = float(jax.device_get(eng.train_batch(batch)["loss"]))
+        eval_loss = float(jax.device_get(eng.eval_batch(batch)))
+        # one update on the same batch: eval loss finite and in the ballpark
+        assert np.isfinite(eval_loss)
+        assert abs(eval_loss - train_loss) < 1.0
+
+    def test_requires_stage3_and_block_api(self, mesh_single):
+        cfg = _cfg()
+        bad = DeepSpeedConfig.load(
+            {
+                "train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 1, "offload_param": {"device": "cpu"}},
+                "bf16": {"enabled": True},
+            },
+            dp_world_size=1,
+        )
+        with pytest.raises(ValueError, match="stage 3"):
+            DeepSpeedEngine(gpt2.make_module(cfg), bad, mesh=mesh_single, seed=0)
+
+
+class TestMemoryMath:
+    """The BASELINE.md ZeRO-Infinity row: 13 B params on one 16 GB chip
+    (stretch 20 B). The streamed-step footprint makes the capacity claim
+    checkable arithmetic instead of a benchmark we can't run on CI."""
+
+    def test_opt13b_fits_16gb(self):
+        # OPT-13B: L=40, h=5120, vocab 50272, seq 2048
+        m = memory_math(40, 5120, 50272, 2048, micro_batch=1)
+        assert 12e9 < m["total_params"] < 14e9, m["total_params"]
+        assert m["total_hbm"] < 16e9, f"13B streamed step needs {m['total_hbm']/1e9:.1f} GB"
+
+    def test_20b_fits_16gb(self):
+        # 20B-class: 62 layers at h=5120
+        m = memory_math(62, 5120, 50272, 2048, micro_batch=1)
+        assert m["total_params"] > 19e9
+        assert m["total_hbm"] < 16e9, f"20B streamed step needs {m['total_hbm']/1e9:.1f} GB"
+
+    def test_gpt2xl_fits_with_room(self):
+        m = memory_math(48, 1600, 50257, 1024, micro_batch=8)
+        assert m["total_hbm"] < 8e9
+
+    def test_host_bytes_accounting(self):
+        m = memory_math(40, 5120, 50272, 2048, micro_batch=1)
+        # host tier stores bf16 copy + fp32 master/m/v = 14 B/param
+        assert m["dram_or_nvme_bytes"] == pytest.approx(m["total_params"] * 14)

@@ -184,6 +184,44 @@ class TestEngineOffload:
             l_dev = float(jax.device_get(e_dev.train_batch(batch)["loss"]))
         assert l_off == pytest.approx(l_dev, rel=5e-3), (l_off, l_dev)
 
+    def test_pipelined_step_matches_synchronous(self, mesh_dp8, tmp_path):
+        """The subgroup-pipelined step (async D2H + interleaved H2D, VERDICT
+        r1 item 4) must be numerically identical to a fully synchronous
+        drain, and not grossly slower on the CPU mesh. (The actual overlap
+        win is a TPU property: on the CPU backend device_get is zero-copy so
+        there is no transfer to hide.)"""
+        import time
+
+        from deepspeed_tpu.runtime.offload import HostOffloadOptimizer
+
+        rs = np.random.RandomState(0)
+        params = {
+            f"w{i}": jnp.asarray(rs.randn(50_000).astype(np.float32)) for i in range(6)
+        }
+        grads = jax.tree.map(lambda p: p * 0.01, params)
+        opt_p = HostOffloadOptimizer(params, 1e-3, sub_group_size=100_000)
+        opt_s = HostOffloadOptimizer(params, 1e-3, sub_group_size=100_000)
+        assert len(opt_p._groups) == 3  # leaf-aligned, 2 leaves per group
+
+        t0 = time.perf_counter()
+        out_p = opt_p.step(
+            grads, 0, compute_dtype=jnp.float32,
+            put_leaf=lambda li, a: jax.device_put(a),
+        )
+        jax.block_until_ready(out_p)
+        t_pipe = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        g_host = jax.device_get(grads)
+        out_s = opt_s.step(g_host, 0, compute_dtype=jnp.float32)
+        out_s = jax.tree.map(jax.device_put, out_s)
+        jax.block_until_ready(out_s)
+        t_sync = time.perf_counter() - t0
+
+        for k in params:
+            np.testing.assert_array_equal(np.asarray(out_p[k]), np.asarray(out_s[k]))
+        assert t_pipe < max(t_sync * 3, 1.0), (t_pipe, t_sync)
+
     def test_offload_checkpoint_roundtrip(self, mesh_dp8, tmp_path):
         model = make_simple_model()
         ds = DeepSpeedConfig.load(self._config("cpu", tmp_path / "nv"), dp_world_size=8)
