@@ -18,6 +18,15 @@ Reference mechanism → TPU mechanism:
 
 Accepts either a :class:`ModuleSpec` (JAX model) or an HF torch model (with
 ``replace_with_kernel_inject=True``, matching the reference call style).
+
+MoE serving (reference ``DeepSpeedMoEInference``,
+``ops/transformer/inference/moe_inference.py:205``): pass the trained MoE
+``ModuleSpec`` + checkpoint params with ``ep_size>1`` — expert-stacked weights
+shard over the ep mesh axis, decode flows through ``moe_mlp`` with
+eval-capacity routing and the KV cache, and the dispatch/combine einsums
+lower to the same ICI all-to-all the reference issues by hand. (There is no
+HF torch MoE-GPT source architecture, so the injection path for MoE starts
+from our own checkpoints, like the reference serving DeepSpeed-MoE ckpts.)
 """
 
 from __future__ import annotations
@@ -48,6 +57,7 @@ class InferenceEngine:
         model: Any = None,
         params: Optional[PyTree] = None,
         mp_size: int = 1,
+        ep_size: int = 1,
         dtype=jnp.bfloat16,
         mesh: Optional[Mesh] = None,
         replace_with_kernel_inject: bool = False,
@@ -61,7 +71,20 @@ class InferenceEngine:
         self.dtype = dtype
         self.max_tokens = max_tokens
         if mesh is None:
-            mesh = MeshSpec(dp=1, tp=mp_size, devices=jax.devices()[: max(1, mp_size)]).build_mesh()
+            # ep axis serves MoE models: expert-stacked weights shard over ep
+            # and the dispatch/combine einsums ride the ICI all-to-all
+            # (reference DeepSpeedMoEInference, moe_inference.py:205, creates
+            # expert-parallel groups the same way)
+            n = max(1, mp_size) * max(1, ep_size)
+            mesh = MeshSpec(
+                dp=1, tp=mp_size, ep=ep_size, devices=jax.devices()[:n]
+            ).build_mesh()
+        elif ep_size > 1 and mesh.shape.get("ep", 1) != ep_size:
+            raise ValueError(
+                f"ep_size={ep_size} conflicts with the provided mesh "
+                f"(ep axis size {mesh.shape.get('ep', 1)}); pass a mesh with a "
+                "matching ep axis or omit ep_size"
+            )
         self.mesh = mesh
         self.policy = ZeroShardingPolicy(mesh, stage=0)  # TP-only weight sharding
         self.model_config = None

@@ -60,6 +60,9 @@ class GPT2Config:
     moe_capacity_factor: float = 1.25
     moe_eval_capacity_factor: Optional[float] = None  # None → moe_capacity_factor
     moe_aux_loss_weight: float = 0.01
+    moe_drop_tokens: bool = True  # False → static no-drop capacity (C = T)
+    moe_use_rts: bool = True  # Random Token Selection on capacity overflow
+    moe_second_policy: str = "random"  # top-2 second expert: random | argmax
 
     @property
     def head_dim(self) -> int:
@@ -237,8 +240,11 @@ def _mlp(cfg: GPT2Config, lp, h, train: bool, rng=None):
                 if cfg.moe_eval_capacity_factor is not None
                 else cfg.moe_capacity_factor
             ),
+            drop_tokens=cfg.moe_drop_tokens,
+            use_rts=cfg.moe_use_rts,
+            second_policy=cfg.moe_second_policy,
         )
-        return moe_mlp(lp, h, mcfg, rng=rng, train=train)
+        return moe_mlp(lp, h, mcfg, rng=rng, train=train, mesh=cfg.mesh)
     x = h @ _deq(lp["c_fc_w"], h.dtype) + lp["c_fc_b"]
     x = jax.nn.gelu(x, approximate=True)
     return x @ _deq(lp["c_proj_w"], x.dtype) + lp["c_proj_b"], jnp.float32(0.0)
@@ -256,32 +262,67 @@ def _block(cfg: GPT2Config, layer_params, h, train: bool, rng=None):
     return h + _dropout(m, cfg.dropout, r3, train), aux
 
 
+def _pld_block(cfg: GPT2Config, layer_params, h, train: bool, key, theta, layer_id, pld_key):
+    """Stochastic-depth block for Progressive Layer Drop (reference
+    progressive_layer_drop.py:5). Layer i of L keeps with probability
+    ``1 - (i/L)*(1-theta)``; ``lax.cond`` actually skips the dropped block's
+    FLOPs (the training-speedup point of PLD), and the kept output's residual
+    delta is scaled by 1/keep_prob so the eval forward (all layers, no
+    scaling) matches in expectation."""
+    kp = 1.0 - (layer_id / cfg.n_layer) * (1.0 - theta)
+    keep = jax.random.bernoulli(pld_key, kp)
+    hb, aux = lax.cond(
+        keep,
+        lambda hh: _block(cfg, layer_params, hh, train, key),
+        lambda hh: (hh, jnp.float32(0.0)),
+        h,
+    )
+    # both the residual delta and the MoE aux loss are inverse-scaled so their
+    # expectations match the all-layers forward (aux fires only when kept)
+    return h + (hb - h) / kp.astype(h.dtype), aux / kp
+
+
 def forward_with_aux(
     cfg: GPT2Config,
     params: PyTree,
     input_ids: jnp.ndarray,
     train: bool = False,
     rng=None,
+    pld_theta=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """input_ids [B,S] → (logits [B,S,V], moe_aux_loss scalar)."""
+    """input_ids [B,S] → (logits [B,S,V], moe_aux_loss scalar). ``pld_theta``
+    (traced scalar) engages progressive layer drop during training."""
     B, S = input_ids.shape
     h = params["wte"][input_ids] + params["wpe"][:S][None, :, :]
     # rng per layer when dropout or MoE stochastic routing needs it
     need_rng = rng is not None and (
-        (train and cfg.dropout > 0.0) or (cfg.is_moe and cfg.moe_top_k == 2)
+        (train and cfg.dropout > 0.0)
+        or (cfg.is_moe and train and (cfg.moe_top_k == 2 or cfg.moe_use_rts))
     )
-    if need_rng:
+    use_pld = pld_theta is not None and train and rng is not None
+    if need_rng or use_pld:
         if train and cfg.dropout > 0.0:
             h = _dropout(h, cfg.dropout, jax.random.fold_in(rng, cfg.n_layer), train)
-        layer_keys = jax.random.split(jax.random.fold_in(rng, 0), cfg.n_layer)
+        xs = {
+            "lp": params["blocks"],
+            "key": jax.random.split(jax.random.fold_in(rng, 0), cfg.n_layer),
+        }
+        if use_pld:
+            theta = jnp.asarray(pld_theta, jnp.float32)
+            xs["pld_key"] = jax.random.split(jax.random.fold_in(rng, 1), cfg.n_layer)
+            xs["layer_id"] = jnp.arange(cfg.n_layer, dtype=jnp.float32)
 
         def body(carry, x):
-            layer_params, key = x
             h, aux_sum = carry
-            h, aux = _block(cfg, layer_params, h, train, key)
+            key = x["key"] if need_rng else None
+            if use_pld:
+                h, aux = _pld_block(
+                    cfg, x["lp"], h, train, key, theta, x["layer_id"], x["pld_key"]
+                )
+            else:
+                h, aux = _block(cfg, x["lp"], h, train, key)
             return (h, aux_sum + aux), None
 
-        xs = (params["blocks"], layer_keys)
     else:
 
         def body(carry, layer_params):
@@ -304,11 +345,20 @@ def forward(cfg: GPT2Config, params: PyTree, input_ids: jnp.ndarray, train: bool
     return forward_with_aux(cfg, params, input_ids, train=train, rng=rng)[0]
 
 
-def lm_loss(cfg: GPT2Config, params: PyTree, batch: Dict[str, jnp.ndarray], rng, train: bool) -> Tuple[jnp.ndarray, Dict]:
+def lm_loss(
+    cfg: GPT2Config,
+    params: PyTree,
+    batch: Dict[str, jnp.ndarray],
+    rng,
+    train: bool,
+    pld_theta=None,
+) -> Tuple[jnp.ndarray, Dict]:
     """Next-token cross-entropy. batch: {"input_ids": [B,S]} and optional
     {"labels": [B,S]} (-100 = ignore, HF convention) / {"attention_mask"}."""
     ids = batch["input_ids"]
-    full_logits, moe_aux = forward_with_aux(cfg, params, ids, train=train, rng=rng)
+    full_logits, moe_aux = forward_with_aux(
+        cfg, params, ids, train=train, rng=rng, pld_theta=pld_theta
+    )
     loss, ntokens = _token_loss(cfg, params, full_logits, batch)
     # aux load-balancing penalty only shapes the training objective; eval loss
     # stays pure LM cross-entropy (comparable to dense baselines)
@@ -610,6 +660,9 @@ def make_module(cfg: GPT2Config) -> ModuleSpec:
     return ModuleSpec(
         init=lambda rng: init_params(cfg, rng),
         loss_fn=lambda params, batch, rng, train: lm_loss(cfg, params, batch, rng, train),
+        pld_loss_fn=lambda params, batch, rng, train, theta: lm_loss(
+            cfg, params, batch, rng, train, pld_theta=theta
+        ),
         apply_fn=lambda params, batch: forward(cfg, params, batch["input_ids"], train=False),
         logical_axes=logical_axes(cfg),
         num_layers=cfg.n_layer,

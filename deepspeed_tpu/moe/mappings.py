@@ -1,0 +1,81 @@
+"""Token drop/gather across the tensor-parallel axis for MoE blocks.
+
+Capability analog of reference ``deepspeed/moe/mappings.py`` (``drop_tokens``
+:95, ``gather_tokens``:103): with tensor parallelism the activations entering
+an MoE block are replicated across the tp group, so the expert dispatch would
+do tp× redundant routing work and tp× redundant all-to-all traffic. The
+reference scatters the token dim across tp ranks before the MoE layer and
+all-gathers the expert outputs after.
+
+The TPU-native mechanism is a sharding constraint instead of an explicit
+collective: ``drop_tokens`` pins the token dim of the activation to the
+``tp`` mesh axis (XLA then keeps each tp shard's slice local — the "drop"),
+and ``gather_tokens`` pins it back to replicated (XLA inserts the all-gather
+over ICI). Under ``jit`` these are zero-copy annotations; the collectives
+appear only where the data flow actually crosses them.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def _tp_size(mesh: Optional[Mesh]) -> int:
+    if mesh is None or "tp" not in mesh.axis_names:
+        return 1
+    return mesh.shape["tp"]
+
+
+def _token_axes(mesh: Optional[Mesh], with_tp: bool) -> tuple:
+    """Mesh axes the token dim shards over: always keep dp (the batch dim was
+    dp-sharded before the tokens were flattened — replicating it here would
+    all-gather activations across dp and redo routing dp-fold), plus tp when
+    engaged."""
+    if mesh is None:
+        return ()
+    axes = [a for a in ("dp",) if a in mesh.axis_names and mesh.shape[a] > 1]
+    if with_tp and _tp_size(mesh) > 1:
+        axes.append("tp")
+    return tuple(axes)
+
+
+def drop_tokens(x: jnp.ndarray, mesh: Optional[Mesh], dim: int = 0) -> jnp.ndarray:
+    """Shard the token dim over (dp, tp) (reference drop_tokens,
+    mappings.py:95 splits over tp; dp sharding is preserved, not undone).
+
+    No-op when the mesh has no tp axis, tp == 1, or the dim isn't divisible
+    (an indivisible token count would force padding; the reference asserts
+    divisibility — we degrade to the incoming sharding instead of failing).
+    """
+    axes = _token_axes(mesh, with_tp=True)
+    if _tp_size(mesh) <= 1:
+        return x
+    total = 1
+    for a in axes:
+        total *= mesh.shape[a]
+    if x.shape[dim] % total != 0:
+        return x
+    spec = [None] * x.ndim
+    spec[dim] = axes if len(axes) > 1 else axes[0]
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, PartitionSpec(*spec))
+    )
+
+
+def gather_tokens(x: jnp.ndarray, mesh: Optional[Mesh], dim: int = 0) -> jnp.ndarray:
+    """All-gather the token dim across tp again (reference gather_tokens,
+    mappings.py:103) while keeping the dp sharding in place — XLA lowers the
+    constraint change to an all-gather over the tp ICI ring only."""
+    if _tp_size(mesh) <= 1:
+        return x
+    axes = _token_axes(mesh, with_tp=False)
+    spec = [None] * x.ndim
+    if axes:
+        spec[dim] = axes if len(axes) > 1 else axes[0]
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, PartitionSpec(*spec))
+    )

@@ -51,33 +51,71 @@ def top1_gating(
     min_capacity: int = 4,
     rng=None,
     noisy_gate_policy: Optional[str] = None,
+    drop_tokens: bool = True,
+    use_rts: bool = True,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, Dict]:
-    """Switch-style routing. Returns (l_aux, combine [T,E,C], dispatch [T,E,C])."""
+    """Switch-style routing. Returns (l_aux, combine [T,E,C], dispatch [T,E,C]).
+
+    Matches reference ``top1gating`` (sharded_moe.py:177):
+    - ``drop_tokens=False`` → the reference lifts capacity to the allreduce-MAX
+      of per-expert counts (sharded_moe.py:214 region, a dynamic shape). The
+      static-shape XLA equivalent is the exact upper bound C = T: every token
+      keeps its slot, nothing is dropped, and the program stays compilable.
+    - ``use_rts`` (Random Token Selection, sharded_moe.py:225 region): when an
+      expert is over capacity, the surviving C tokens are chosen by ranking
+      ``mask1 * U(0,1)`` per expert instead of first-come-first-served, which
+      de-biases the drop toward sequence position. Needs ``rng``; falls back
+      to sequential priority when rng is None (deterministic eval).
+    """
     T, E = logits.shape
-    C = _capacity(T, E, capacity_factor, min_capacity)
     if noisy_gate_policy == "RSample" and rng is not None:
-        logits_for_choice = logits + jax.random.gumbel(rng, logits.shape)
+        rng, noise_rng = jax.random.split(rng)
+        logits_for_choice = logits + jax.random.gumbel(noise_rng, logits.shape)
     else:
         logits_for_choice = logits
     gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # [T,E]
     expert_idx = jnp.argmax(logits_for_choice, axis=-1)  # [T]
     mask1 = _one_hot(expert_idx, E)  # [T,E]
+    exp_counts = jnp.sum(mask1, axis=0)
 
     # aux loss (reference top1gating l_aux)
     me = jnp.mean(gates, axis=0)
     ce = jnp.mean(mask1, axis=0)
     l_aux = jnp.sum(me * ce) * E
 
-    # capacity slots: position of each token within its expert's queue
-    pos_in_expert = jnp.cumsum(mask1, axis=0) * mask1  # 1-based
-    keep = (pos_in_expert <= C) & (mask1 > 0)
-    slot = (pos_in_expert - 1.0) * mask1  # 0-based
-    dispatch = keep[..., None] & (
-        _one_hot(slot.sum(axis=-1).astype(jnp.int32), C)[:, None, :] > 0
-    )  # [T,E,C]
+    if drop_tokens:
+        C = min(_capacity(T, E, capacity_factor, min_capacity), T)
+        if use_rts and rng is not None:
+            # Random Token Selection: priority = routed-mask * uniform noise,
+            # keep the top-C priorities per expert
+            priority = mask1 * jax.random.uniform(rng, mask1.shape, dtype=jnp.float32)
+            _, top_idx = jax.lax.top_k(priority.T, C)  # [E,C] token ids
+            sel = (
+                jnp.zeros((E, T), jnp.bool_)
+                .at[jnp.arange(E)[:, None], top_idx]
+                .set(True)
+            )
+            keep = (mask1 > 0) & sel.T
+        else:
+            pos_in_expert = jnp.cumsum(mask1, axis=0) * mask1  # 1-based
+            keep = (pos_in_expert <= C) & (mask1 > 0)
+        kept = mask1 * keep
+    else:
+        C = T  # static no-drop bound (see docstring)
+        kept = mask1
+
+    # slot of each kept token within its expert's queue (0-based), computed
+    # AFTER capacity masking like the reference (locations of new_mask1)
+    locations = (jnp.cumsum(kept, axis=0) - 1.0) * kept
+    loc_s = jnp.sum(locations, axis=-1).astype(jnp.int32)  # [T]
+    dispatch = (kept > 0)[..., None] & (_one_hot(loc_s, C)[:, None, :] > 0)  # [T,E,C]
     gate_val = jnp.sum(gates * mask1, axis=-1, keepdims=True)  # [T,1]
     combine = gate_val[..., None] * dispatch.astype(jnp.float32)
-    meta = {"capacity": C, "tokens_dropped": jnp.sum(mask1) - jnp.sum(keep)}
+    meta = {
+        "capacity": C,
+        "exp_counts": exp_counts,
+        "tokens_dropped": jnp.sum(mask1) - jnp.sum(kept),
+    }
     return l_aux, combine, dispatch, meta
 
 
@@ -87,10 +125,16 @@ def top2_gating(
     min_capacity: int = 4,
     rng=None,
     second_policy: str = "random",
+    drop_tokens: bool = True,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, Dict]:
-    """GShard-style top-2 routing (reference top2gating:278)."""
+    """GShard-style top-2 routing (reference top2gating:278). The 2nd expert
+    is Gumbel-max sampled ∝ residual gate probability when ``second_policy ==
+    "random"`` and rng is given (reference :297 gumbel_rsample), else argmax.
+    ``drop_tokens=False`` lifts capacity to the static no-drop bound 2T."""
     T, E = logits.shape
-    C = _capacity(T, E, 2 * capacity_factor, min_capacity)
+    C = min(_capacity(T, E, 2 * capacity_factor, min_capacity), 2 * T)
+    if not drop_tokens:
+        C = 2 * T  # both assignments of every token always fit
     gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
 
     idx1 = jnp.argmax(gates, axis=-1)
@@ -127,7 +171,7 @@ def top2_gating(
 
     combine = g1[:, None, None] * disp1.astype(jnp.float32) + g2[:, None, None] * disp2.astype(jnp.float32)
     dispatch = disp1 | disp2
-    meta = {"capacity": C}
+    meta = {"capacity": C, "exp_counts": jnp.sum(mask1, axis=0)}
     return l_aux, combine, dispatch, meta
 
 
@@ -140,6 +184,8 @@ class MoEConfig:
     min_capacity: int = 4
     noisy_gate_policy: Optional[str] = None
     drop_tokens: bool = True
+    use_rts: bool = True
+    second_policy: str = "random"
     aux_loss_weight: float = 0.01
 
 
@@ -172,6 +218,7 @@ def moe_mlp(
     rng=None,
     train: bool = True,
     activation: Callable = jax.nn.gelu,
+    mesh=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """MoE FFN block. Returns (output [B,S,M], aux_loss scalar).
 
@@ -179,19 +226,31 @@ def moe_mlp(
     gate → dispatch einsum → all-to-all → expert FFN → all-to-all → combine.
     Here the two all-to-alls are implicit in the 'tec,tm->ecm' / 'tec,ecm->tm'
     einsums once experts are sharded over ep.
+
+    When ``mesh`` has a tp axis, tokens are scattered over tp before routing
+    and gathered after combine (reference moe/mappings.py drop/gather_tokens)
+    so expert work isn't duplicated tp-fold.
     """
     B, S, M = x.shape
     T = B * S
     xt = x.reshape(T, M)
+    from .mappings import drop_tokens as _drop_tp, gather_tokens as _gather_tp
+
+    xt = _drop_tp(xt, mesh)
     # routing logits always in f32 even if the engine cast params to bf16/fp16
     logits = xt.astype(jnp.float32) @ params["gate_w"].astype(jnp.float32)  # [T,E]
     capacity_factor = cfg.capacity_factor if train else cfg.eval_capacity_factor
     if cfg.k == 1:
         l_aux, combine, dispatch, _ = top1_gating(
-            logits, capacity_factor, cfg.min_capacity, rng, cfg.noisy_gate_policy
+            logits, capacity_factor, cfg.min_capacity, rng, cfg.noisy_gate_policy,
+            drop_tokens=cfg.drop_tokens, use_rts=cfg.use_rts and train,
         )
     elif cfg.k == 2:
-        l_aux, combine, dispatch, _ = top2_gating(logits, capacity_factor, cfg.min_capacity, rng)
+        l_aux, combine, dispatch, _ = top2_gating(
+            logits, capacity_factor, cfg.min_capacity,
+            rng if train else None,
+            second_policy=cfg.second_policy, drop_tokens=cfg.drop_tokens,
+        )
     else:
         raise ValueError(f"top-{cfg.k} gating unsupported (1 or 2)")
 
@@ -202,4 +261,5 @@ def moe_mlp(
     expert_out = jnp.einsum("ech,ehm->ecm", h, params["w_out"]) + params["b_out"][:, None, :]
     # combine: [T,E,C] x [E,C,M] -> [T,M]    (all-to-all back)
     out = jnp.einsum("tec,ecm->tm", combine.astype(dtype), expert_out)
+    out = _gather_tp(out, mesh)
     return out.reshape(B, S, M), l_aux.astype(jnp.float32)

@@ -365,6 +365,15 @@ class DeepSpeedEngine:
             self.curriculum_scheduler = CurriculumScheduler(config.curriculum_learning)
         # --- progressive layer drop (reference progressive_layer_drop.py)
         self.progressive_layer_drop = None
+        if config.progressive_layer_drop.enabled and (
+            self.onebit or self.offload_enabled or self.param_offload_enabled
+        ):
+            # only _make_train_step threads theta into the model; failing loud
+            # beats a schedule that decays while no layer ever drops
+            raise ValueError(
+                "progressive_layer_drop is only supported on the standard "
+                "device training path (not 1-bit / offload / infinity engines)"
+            )
         if config.progressive_layer_drop.enabled:
             from .progressive_layer_drop import ProgressiveLayerDrop
 
@@ -719,9 +728,28 @@ class DeepSpeedEngine:
             )
         mesh = self.mesh
 
-        def scaled_loss_fn(params, micro_batch, rng, scale):
+        # progressive layer drop: theta(t) computed IN-GRAPH from global_step
+        # (reference recomputes on host each step, engine.py:1643; here the
+        # schedule is a traced function so the compiled program is
+        # step-independent and no host->device transfer happens)
+        pld_cfg = cfg.progressive_layer_drop
+        use_pld = bool(pld_cfg.enabled)
+        if use_pld and model.pld_loss_fn is None:
+            raise ValueError(
+                "progressive_layer_drop enabled but the model provides no "
+                "pld_loss_fn (stochastic-depth support)"
+            )
+        if use_pld and pipeline_mode:
+            raise ValueError("progressive_layer_drop is not supported on a pp mesh")
+        pld_theta0 = float(pld_cfg.theta)
+        pld_gamma = float(pld_cfg.gamma)
+
+        def scaled_loss_fn(params, micro_batch, rng, scale, theta=None):
             cparams = _cast_params(params, compute_dtype)
-            loss, metrics = model.loss_fn(cparams, micro_batch, rng, True)
+            if theta is not None:
+                loss, metrics = model.pld_loss_fn(cparams, micro_batch, rng, True, theta)
+            else:
+                loss, metrics = model.loss_fn(cparams, micro_batch, rng, True)
             return loss.astype(jnp.float32) * scale, (loss, metrics)
 
         def scaled_pipeline_loss_fn(params, batch, rng, scale):
@@ -734,6 +762,11 @@ class DeepSpeedEngine:
 
         def train_step(state: TrainState, batch: PyTree, rng) -> Tuple[TrainState, Dict[str, Any]]:
             scale = state.loss_scale.cur_scale if fp16 else jnp.float32(1.0)
+            theta = (
+                (1.0 - pld_theta0)
+                * jnp.exp(-pld_gamma * state.global_step.astype(jnp.float32))
+                + pld_theta0
+            ) if use_pld else None
 
             if pipeline_mode:
                 # pipeline path: all gas microbatches flow through the 1F1B/
@@ -750,7 +783,7 @@ class DeepSpeedEngine:
                     grads_acc, loss_acc, i = carry
                     micro = jax.tree.map(lambda x: x[i], batch)
                     mrng = jax.random.fold_in(rng, i)
-                    (_, (loss, _metrics)), grads = grad_fn(state.params, micro, mrng, scale)
+                    (_, (loss, _metrics)), grads = grad_fn(state.params, micro, mrng, scale, theta)
                     if predivide:
                         grads = jax.tree.map(lambda g: g / predivide_factor, grads)
                     grads_acc = jax.tree.map(

@@ -45,6 +45,12 @@ class ModuleSpec:
     # Used by the engine when the mesh has a pp axis (the PipelineEngine
     # analog — reference runtime/pipe/engine.py train_batch).
     pipeline_loss_fn: Optional[Callable] = None
+    # progressive-layer-drop loss: (params, batch, rng, train, theta) ->
+    # (loss, metrics). theta is the traced keep-probability scalar the engine
+    # computes in-graph from global_step (reference progressive_layer_drop.py:5
+    # + engine hook engine.py:1643); models supporting PLD apply stochastic
+    # depth with keep prob 1 - (i/L)*(1-theta) per layer i.
+    pld_loss_fn: Optional[Callable] = None
     extra: Dict[str, Any] = field(default_factory=dict)
 
 

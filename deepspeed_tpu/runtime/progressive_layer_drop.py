@@ -6,10 +6,13 @@ Analog of reference ``deepspeed/runtime/progressive_layer_drop.py``
 toward ``theta``. Layer i of L keeps with probability
 ``1 - (i / L) * (1 - theta(t))`` (deeper layers drop more).
 
-TPU integration: the engine computes ``theta(t)`` on host each step and
-passes it to the model as a scalar; the model applies stochastic depth with
-``jax.random.bernoulli`` + ``lax.cond``-free arithmetic (select between the
-block output and identity), so the jitted program is step-independent.
+TPU integration: the engine computes ``theta(t)`` IN-GRAPH from the traced
+``global_step`` (runtime/engine.py train_step) and feeds it to the model's
+``pld_loss_fn``; the model (models/gpt2.py ``_pld_block``) applies stochastic
+depth with ``jax.random.bernoulli`` + ``lax.cond`` so dropped layers actually
+skip their FLOPs, with 1/keep_prob inverted scaling so the eval forward needs
+no change. This host object remains as the schedule mirror for monitoring
+(``get_theta``/``get_state``).
 """
 
 from __future__ import annotations

@@ -107,3 +107,89 @@ def test_gpt2_moe_trains_ep_sharded(mesh_dp4_tp2, devices):
     for _ in range(10):
         last = float(engine.train_batch(b)["loss"])
     assert np.isfinite(last) and last < first
+
+
+def test_top1_no_drop_keeps_all_tokens():
+    """drop_tokens=False → zero drops even under heavy expert skew, and the
+    MoE output equals the exact per-token expert computation (the
+    no-drop-equals-dense check; reference sharded_moe.py:214 no-drop path)."""
+    rs = np.random.RandomState(2)
+    T, E, M, H = 48, 4, 8, 16
+    # skew: push most tokens to expert 0 so the capacity path WOULD drop
+    logits = jnp.asarray(rs.randn(T, E) + np.array([4.0, 0, 0, 0]), jnp.float32)
+    l_aux, combine, dispatch, meta = top1_gating(
+        logits, capacity_factor=1.0, drop_tokens=False
+    )
+    assert float(meta["tokens_dropped"]) == 0.0
+    assert meta["capacity"] == T
+
+    cfg = MoEConfig(num_experts=E, k=1, capacity_factor=1.0, drop_tokens=False)
+    params = init_moe_mlp_params(jax.random.PRNGKey(0), M, H, E)
+    x = jnp.asarray(rs.randn(1, T, M), jnp.float32)
+    out, _ = moe_mlp(params, x, cfg)
+    # dense reference: every token through its argmax expert, scaled by gate
+    xt = x.reshape(T, M)
+    gate_logits = xt @ params["gate_w"]
+    gates = jax.nn.softmax(gate_logits, axis=-1)
+    eidx = np.asarray(jnp.argmax(gate_logits, axis=-1))
+    ref = np.zeros((T, M), np.float32)
+    for t in range(T):
+        e = int(eidx[t])
+        h = jax.nn.gelu(xt[t] @ params["w_in"][e] + params["b_in"][e])
+        ref[t] = np.asarray((h @ params["w_out"][e] + params["b_out"][e]) * gates[t, e])
+    np.testing.assert_allclose(np.asarray(out[0]), ref, atol=1e-5, rtol=1e-4)
+
+
+def test_top1_rts_respects_capacity_and_randomizes():
+    """Random Token Selection: per-expert kept count ≤ C, only routed tokens
+    kept, and the survivor set is position-debiased (differs from the
+    sequential first-come policy)."""
+    rs = np.random.RandomState(3)
+    T, E = 64, 2
+    # all tokens to expert 0 → guaranteed overflow at cf=0.25 (C=8)
+    logits = jnp.asarray(np.stack([np.ones(T) * 5, np.zeros(T)], 1), jnp.float32)
+    _, _, disp_seq, meta = top1_gating(logits, capacity_factor=0.25, rng=None)
+    C = meta["capacity"]
+    _, _, disp_rts, _ = top1_gating(
+        logits, capacity_factor=0.25, rng=jax.random.PRNGKey(7), use_rts=True
+    )
+    for disp in (disp_seq, disp_rts):
+        kept_per_expert = jnp.sum(disp.astype(jnp.int32), axis=(0, 2))  # [E]
+        assert int(kept_per_expert[0]) == C
+        assert int(kept_per_expert[1]) == 0
+        # no slot double-booked
+        assert int(jnp.max(jnp.sum(disp.astype(jnp.int32), axis=0))) <= 1
+    kept_seq = np.asarray(jnp.sum(disp_seq, axis=(1, 2)) > 0)
+    kept_rts = np.asarray(jnp.sum(disp_rts, axis=(1, 2)) > 0)
+    # sequential keeps exactly the first C tokens; RTS should not
+    assert kept_seq[:C].all() and not kept_seq[C:].any()
+    assert not np.array_equal(kept_seq, kept_rts)
+
+
+def test_top2_no_drop_zero_dropped():
+    rs = np.random.RandomState(4)
+    logits = jnp.asarray(rs.randn(32, 4) + np.array([6.0, 5.0, 0, 0]), jnp.float32)
+    _, _, dispatch, meta = top2_gating(logits, capacity_factor=0.25, drop_tokens=False)
+    per_token = jnp.sum(dispatch.astype(jnp.int32), axis=(1, 2))
+    assert int(jnp.min(per_token)) == 2  # both assignments of every token kept
+
+
+def test_tp_token_mappings_preserve_values(mesh_dp4_tp2):
+    """drop_tokens/gather_tokens are sharding annotations: values unchanged,
+    and an MoE block run with the tp mesh matches the meshless run exactly."""
+    from deepspeed_tpu.moe.mappings import drop_tokens, gather_tokens
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (64, 8))
+
+    @jax.jit
+    def roundtrip(x):
+        return gather_tokens(drop_tokens(x, mesh_dp4_tp2), mesh_dp4_tp2)
+
+    np.testing.assert_allclose(np.asarray(roundtrip(x)), np.asarray(x), rtol=1e-6)
+
+    cfg = MoEConfig(num_experts=4, k=1, capacity_factor=2.0)
+    params = init_moe_mlp_params(jax.random.PRNGKey(0), 8, 16, 4)
+    xb = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 8))
+    out_plain, _ = moe_mlp(params, xb, cfg)
+    out_tp, _ = jax.jit(lambda p, x: moe_mlp(p, x, cfg, mesh=mesh_dp4_tp2))(params, xb)
+    np.testing.assert_allclose(np.asarray(out_plain), np.asarray(out_tp), atol=1e-5, rtol=1e-4)
