@@ -1,0 +1,79 @@
+"""Measure: optax (XLA-fused) AdamW vs the Pallas fused kernel on flat shards.
+
+SURVEY §2.7 asks for exactly this measurement before keeping either path
+("Pallas fused optimizer kernel over flat param shards (or jax.jit fused
+update — measure)"). Run on a TPU chip:
+
+    python benchmarks/fused_adam_bench.py [n_params]
+
+The op is HBM-bandwidth-bound (28 B/param fp32 traffic), so the report also
+shows achieved GB/s against the chip's peak. Result is printed as one JSON
+line; paste the winner + number into RESULTS below when re-run on new
+hardware.
+
+RESULTS (v5e, 2026-07-29, n=268435456 fp32):
+  measured by the driver round — see BENCH notes / commit message. The optax
+  update and the Pallas kernel are both bandwidth-bound; whichever wins is
+  kept as the default (optimizers.py build_optimizer stays optax unless the
+  kernel shows a material edge).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from deepspeed_tpu.ops.fused_adam import fused_adamw_flat
+
+
+def bench(fn, args, iters=20):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 256 * 1024 * 1024  # 256M fp32
+    key = jax.random.PRNGKey(0)
+    p = jax.random.normal(key, (n,), jnp.float32)
+    g = jax.random.normal(key, (n,), jnp.float32) * 1e-3
+    m = jnp.zeros_like(p)
+    v = jnp.zeros_like(p)
+
+    tx = optax.adamw(1e-3, weight_decay=0.01)
+    state = tx.init(p)
+
+    @jax.jit
+    def optax_step(p, g, state):
+        u, s2 = tx.update(g, state, p)
+        return optax.apply_updates(p, u), s2
+
+    @jax.jit
+    def pallas_step(p, g, m, v):
+        return fused_adamw_flat(p, g, m, v, jnp.int32(1), 1e-3, weight_decay=0.01)
+
+    t_optax = bench(optax_step, (p, g, state))
+    t_pallas = bench(pallas_step, (p, g, m, v))
+    traffic = 28.0 * n  # r(p,g,m,v fp32) + w(p,m,v fp32)
+    result = {
+        "metric": "fused_adam ms @ %dM params" % (n // 1e6),
+        "optax_ms": round(t_optax * 1e3, 3),
+        "pallas_ms": round(t_pallas * 1e3, 3),
+        "optax_gbps": round(traffic / t_optax / 1e9, 1),
+        "pallas_gbps": round(traffic / t_pallas / 1e9, 1),
+        "winner": "optax" if t_optax <= t_pallas else "pallas",
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -58,7 +58,18 @@ class XLABackend(Backend):
 # Traceable collectives (call inside shard_map / pjit with axis in scope)
 # ---------------------------------------------------------------------------
 
+def _record(op_name: str, axis, x) -> None:
+    """Trace-time accounting hook → CommsLogger (reference timed_op decorator,
+    comm/comm.py:111). Runs once per trace; shapes are static so the recorded
+    op mix is the exact per-compiled-step traffic. Lazy import breaks the
+    comm.py → xla.py cycle."""
+    from .comm import record
+
+    record(op_name, axis, x)
+
+
 def all_reduce(x, axis: AxisName, op: str = "sum"):
+    _record("all_reduce", axis, x)
     if op == "sum":
         return lax.psum(x, axis)
     if op == "mean":
@@ -74,22 +85,26 @@ def all_reduce(x, axis: AxisName, op: str = "sum"):
 
 def all_gather(x, axis: AxisName, *, gather_dim: int = 0, tiled: bool = True):
     """Concatenate shards along ``gather_dim`` (reference all_gather_base)."""
+    _record("all_gather", axis, x)
     return lax.all_gather(x, axis, axis=gather_dim, tiled=tiled)
 
 
 def reduce_scatter(x, axis: AxisName, *, scatter_dim: int = 0, tiled: bool = True):
     """Sum across the axis then keep this rank's shard (reduce_scatter_base)."""
+    _record("reduce_scatter", axis, x)
     return lax.psum_scatter(x, axis, scatter_dimension=scatter_dim, tiled=tiled)
 
 
 def all_to_all(x, axis: AxisName, *, split_dim: int, concat_dim: int, tiled: bool = True):
     """MoE dispatch collective (reference all_to_all_single, comm/comm.py:355)."""
+    _record("all_to_all", axis, x)
     return lax.all_to_all(x, axis, split_axis=split_dim, concat_axis=concat_dim, tiled=tiled)
 
 
 def broadcast(x, axis: AxisName, root: int = 0):
     """Every rank gets root's value. Lowered as a one-hot psum (XLA optimizes
     to an actual broadcast); analog of reference broadcast (comm.py:424)."""
+    _record("broadcast", axis, x)
     idx = lax.axis_index(axis)
     mask = (idx == root).astype(x.dtype)
     return lax.psum(x * mask, axis)
@@ -97,6 +112,7 @@ def broadcast(x, axis: AxisName, root: int = 0):
 
 def ppermute(x, axis: AxisName, perm: Sequence[Tuple[int, int]]):
     """Point-to-point pattern; the pipeline send/recv analog (pipe/p2p.py)."""
+    _record("ppermute", axis, x)
     return lax.ppermute(x, axis, perm=perm)
 
 

@@ -5,7 +5,7 @@ from .basic_layer import (
     sparse_pruning_mask,
 )
 from .compress import CompressionScheduler, apply_compression, init_compression
-from .scheduler import compression_scheduler_from_config
+from .compress import compression_scheduler_from_config
 
 __all__ = [
     "CompressionScheduler",

@@ -127,3 +127,9 @@ def redundancy_clean(params: PyTree, config: Dict[str, Any], masks: Dict[str, Py
     """Bake all compression permanently into the weights (reference
     redundancy_clean:127): final masked+quantized tree for export."""
     return apply_compression(params, config, masks, step=10**12)
+
+
+def compression_scheduler_from_config(ds_config):
+    """Build a CompressionScheduler from a DeepSpeed config document
+    (reference compression/scheduler.py entry)."""
+    return CompressionScheduler(config=ds_config.get("compression_training", {}))

@@ -279,6 +279,23 @@ class DataTypesConfig(DSConfigModel):
     grad_accum_dtype: Optional[str] = None
 
 
+@dataclass
+class DebugConfig(DSConfigModel):
+    """First-class debug modes (reference stage3.py safe_mode,
+    zero/utils.py assert_ints_same_as_other_ranks, coordinator trace checks;
+    SURVEY.md §5 keeps these as explicit modes on TPU)."""
+
+    enabled: bool = False
+    # per-step NaN/Inf scan over the clipped grads with a cross-device
+    # reduced flag; raises host-side naming the step
+    nan_check: bool = True
+    # all-gather + compare a config/mesh fingerprint across hosts at init
+    check_config_consistency: bool = True
+    # ZeRO-Infinity streamed path: block fetch order must replay the
+    # recorded trace every step
+    trace_validation: bool = True
+
+
 # ---------------------------------------------------------------------------
 # Top-level document
 # ---------------------------------------------------------------------------
@@ -313,6 +330,7 @@ class DeepSpeedConfig(DSConfigModel):
     data_types: DataTypesConfig = field(default_factory=DataTypesConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     tpu: TPUConfig = field(default_factory=TPUConfig)
+    debug: DebugConfig = field(default_factory=DebugConfig)
 
     gradient_clipping: float = 0.0
     prescale_gradients: bool = False

@@ -1,0 +1,126 @@
+"""Cross-rank consistency checks and numerical debug modes.
+
+Reference analogs (SURVEY.md §5 "race detection"):
+- ``stage3.py:1110`` safe_mode cross-rank bucket-id assert +
+  ``zero/utils.py`` ``assert_ints_same_as_other_ranks``: under SPMD the
+  "ranks reduce different buckets" race is impossible by construction (one
+  traced program runs everywhere), but the *inputs* can still diverge across
+  hosts — config documents, mesh shapes, code versions. That is what
+  :func:`check_config_consistency` catches: every host contributes its config
+  fingerprint to a device array, one all-gather compares them, and a mismatch
+  names the divergent hosts.
+- ``stage3.py:2031`` ``_has_inf_or_nan`` + ``has_overflow`` allreduced flag:
+  :func:`tree_nan_scan` — under pjit the ``jnp.any`` reduction over sharded
+  grads IS the allreduce; the engine raises host-side with the step number.
+- ``partitioned_param_coordinator.py:300-307`` trace-mismatch RuntimeError:
+  :class:`BlockTraceValidator` for the ZeRO-Infinity streamed path — the
+  block fetch order is recorded on the first step and every later step must
+  replay it exactly.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PyTree = Any
+
+
+def tree_nan_scan(tree: PyTree) -> jnp.ndarray:
+    """True iff any floating leaf contains NaN/Inf. Safe under jit; the
+    reduction over sharded leaves lowers to the cross-device allreduce the
+    reference issues by hand (stage3.py:2000 has_overflow)."""
+    flags = []
+    for leaf in jax.tree.leaves(tree):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating):
+            flags.append(jnp.any(~jnp.isfinite(leaf)))
+    if not flags:
+        return jnp.bool_(False)
+    return jnp.any(jnp.stack(flags))
+
+
+def config_fingerprint(config_dict: Any, mesh=None) -> bytes:
+    """16-byte digest of the canonicalized config + mesh topology."""
+    doc = {
+        "config": config_dict,
+        "mesh": dict(mesh.shape) if mesh is not None else None,
+    }
+    blob = json.dumps(doc, sort_keys=True, default=str).encode()
+    return hashlib.md5(blob).digest()
+
+
+def check_config_consistency(mesh, fingerprint: bytes) -> None:
+    """Assert every host initialized with the same config/mesh fingerprint.
+
+    Each process fills its addressable devices' rows of a global [n_devices,4]
+    uint32 array with its own fingerprint; a jitted equality check then
+    compares all rows (the comparison itself is the cross-host collective).
+    Divergence raises with the offending device ids — the
+    ``assert_ints_same_as_other_ranks`` analog (reference zero/utils.py).
+    """
+    words = np.frombuffer(fingerprint, dtype=np.uint32).copy()  # [4]
+    devices = list(mesh.devices.flat)
+    n = len(devices)
+    from jax.sharding import NamedSharding, PartitionSpec, Mesh
+
+    row_mesh = Mesh(np.array(devices), ("rows",))
+    sharding = NamedSharding(row_mesh, PartitionSpec("rows"))
+    def _rows(idx):
+        r = idx[0]
+        start = r.start or 0
+        stop = r.stop if r.stop is not None else n
+        return words[None, :].repeat(stop - start, 0)
+
+    arr = jax.make_array_from_callback((n, 4), sharding, _rows)
+    replicated = NamedSharding(row_mesh, PartitionSpec())
+    same = jax.jit(lambda a: jnp.all(a == a[0:1]), out_shardings=replicated)(arr)
+    if not bool(jax.device_get(same)):
+        # replicate before fetching: the sharded array spans non-addressable
+        # devices in multi-host runs (the very case this check exists for)
+        gathered = jax.jit(lambda a: a, out_shardings=replicated)(arr)
+        rows = np.asarray(jax.device_get(gathered))
+        bad = [i for i in range(n) if not np.array_equal(rows[i], rows[0])]
+        raise RuntimeError(
+            "deepspeed_tpu debug: config/mesh fingerprint mismatch across "
+            f"hosts — devices {bad} disagree with device 0. Every process "
+            "must pass an identical DeepSpeed config and mesh shape to "
+            "initialize() (reference assert_ints_same_as_other_ranks)."
+        )
+
+
+class BlockTraceValidator:
+    """Validates that the ZeRO-Infinity streamed path fetches blocks in the
+    same order every step (reference partitioned_param_coordinator.py:300-307:
+    a divergent module-execution order vs the recorded trace is an error)."""
+
+    def __init__(self) -> None:
+        self._trace: Optional[List[int]] = None
+        self._current: List[int] = []
+
+    def record_fetch(self, block_id: int) -> None:
+        self._current.append(int(block_id))
+
+    def end_step(self) -> None:
+        if self._trace is None:
+            self._trace = self._current
+        elif self._current != self._trace:
+            recorded, actual = self._trace, self._current
+            self._current = []
+            first_diff = next(
+                (k for k, (a, b) in enumerate(zip(recorded, actual)) if a != b),
+                min(len(recorded), len(actual)),
+            )
+            raise RuntimeError(
+                "deepspeed_tpu debug: block fetch order diverged from the "
+                f"recorded trace at position {first_diff}: recorded "
+                f"{recorded[max(0, first_diff - 2):first_diff + 3]}, got "
+                f"{actual[max(0, first_diff - 2):first_diff + 3]}. The model's "
+                "block schedule must be identical every step (reference "
+                "partitioned_param_coordinator trace validation)."
+            )
+        self._current = []

@@ -116,6 +116,9 @@ class DeepSpeedEngine:
         acc = config.data_types.grad_accum_dtype
         self.grad_accum_dtype = {None: jnp.float32, "fp32": jnp.float32, "fp16": jnp.float16, "bf16": jnp.bfloat16}[acc]
 
+        # set before the step builders run (they read it)
+        self._debug_nan_check = config.debug.enabled and config.debug.nan_check
+
         # --- ZeRO sharding policy
         zcfg = config.zero_optimization
         self.zero_stage = zcfg.stage
@@ -179,6 +182,19 @@ class DeepSpeedEngine:
             self._init_device_state(model, config, zcfg, seed, params, opt_cfg)
             self._rng = jax.random.PRNGKey(seed + 1)
 
+        # --- debug modes (reference safe_mode / assert_ints_same_as_other_ranks)
+        if config.debug.enabled and config.debug.check_config_consistency:
+            import dataclasses
+
+            from .debug import check_config_consistency, config_fingerprint
+
+            doc = {
+                k: v
+                for k, v in dataclasses.asdict(config).items()
+                if not k.startswith("_")
+            }
+            check_config_consistency(self.mesh, config_fingerprint(doc, self.mesh))
+
         # --- observability (reference EngineTimers / ThroughputTimer / Monitor)
         self.timers = SynchronizedWallClockTimer()
         self.tput_timer = ThroughputTimer(
@@ -218,6 +234,11 @@ class DeepSpeedEngine:
         off = zcfg.offload_optimizer
         opt_cfg = config.optimizer
         p = (opt_cfg.params if opt_cfg else None) or {}
+        trace_validator = None
+        if config.debug.enabled and config.debug.trace_validation:
+            from .debug import BlockTraceValidator
+
+            trace_validator = BlockTraceValidator()
         self._infinity = InfinityEngine(
             api,
             lr_schedule=self.lr_schedule,
@@ -231,6 +252,7 @@ class DeepSpeedEngine:
             compute_dtype=self.compute_dtype,
             seed=seed,
             initial_params=params,
+            trace_validator=trace_validator,
         )
         self.offload_enabled = False
         self._offload = None
@@ -384,6 +406,7 @@ class DeepSpeedEngine:
 
         self.training_dataloader = None
         self._data_iterator = None
+        self._step_arg_structs = None
         self._jit_apply = jax.jit(model.apply_fn) if model.apply_fn is not None else None
         if training_data is not None:
             self.training_dataloader = self.deepspeed_io(training_data, collate_fn=collate_fn)
@@ -743,6 +766,7 @@ class DeepSpeedEngine:
             raise ValueError("progressive_layer_drop is not supported on a pp mesh")
         pld_theta0 = float(pld_cfg.theta)
         pld_gamma = float(pld_cfg.gamma)
+        debug_nan = self._debug_nan_check
 
         def scaled_loss_fn(params, micro_batch, rng, scale, theta=None):
             cparams = _cast_params(params, compute_dtype)
@@ -846,6 +870,12 @@ class DeepSpeedEngine:
                 "lr": jnp.asarray(self.lr_schedule(state.global_step), jnp.float32),
                 "global_step": new_state.global_step,
             }
+            if debug_nan:
+                from .debug import tree_nan_scan
+
+                # cross-device reduced NaN/Inf flag over the final grads
+                # (reference has_overflow allreduce, stage3.py:2000)
+                metrics["nan_in_grads"] = tree_nan_scan(grads)
             return new_state, metrics
 
         return train_step
@@ -948,8 +978,28 @@ class DeepSpeedEngine:
             self.progressive_layer_drop.update_state(self.global_steps)
         device_batch = self.shard_batch(batch)
         self._rng, step_rng = jax.random.split(self._rng)
+        if self._step_arg_structs is None:
+            # abstract arg specs kept for HLO-level comms accounting
+            # (comms_summary) without holding real buffers alive
+            self._step_arg_structs = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(
+                    x.shape, x.dtype, sharding=getattr(x, "sharding", None)
+                ),
+                (self.state, device_batch, step_rng),
+            )
         self.state, metrics = self._train_step(self.state, device_batch, step_rng)
         self.global_steps += 1
+        nan_flag = metrics.pop("nan_in_grads", None) if isinstance(metrics, dict) else None
+        if nan_flag is not None and bool(jax.device_get(nan_flag)):
+            raise RuntimeError(
+                f"deepspeed_tpu debug: NaN/Inf detected in gradients at step "
+                f"{self.global_steps} (loss="
+                f"{float(jax.device_get(metrics['loss'])):.4f}). With bf16/fp32 "
+                "there is no loss-scale skip — this is a model/data bug. "
+                "Inspect the batch fed to this step; disable via "
+                "config debug.nan_check. (reference stage3.py:2031 "
+                "_has_inf_or_nan debug scan)"
+            )
         if self.wall_clock_breakdown:
             self.timers(TRAIN_BATCH_TIMER).stop(sync_tree=metrics)
         self.tput_timer.stop(sync_tree=None)
@@ -971,6 +1021,37 @@ class DeepSpeedEngine:
             if self.wall_clock_breakdown:
                 self.timers.log([TRAIN_BATCH_TIMER])
         return metrics
+
+    def comms_summary(self, measure: bool = False) -> str:
+        """Account + print the compiled train step's collective mix
+        (reference comm.log_summary, comms_logging.py:56).
+
+        Counts and byte volumes come from the post-optimization HLO — the
+        ground truth for SPMD programs where XLA inserts ZeRO's
+        reduce-scatter/all-gather from sharding annotations. ``measure=True``
+        additionally times each recorded op at its real payload size on this
+        mesh (latency + algbw/busbw columns). Requires ≥1 train_batch call;
+        with a persistent compilation cache the re-lower is cheap.
+        """
+        assert self._step_arg_structs is not None, (
+            "comms_summary requires at least one train_batch() call"
+        )
+        if not hasattr(self._train_step, "lower"):
+            raise ValueError(
+                "comms_summary supports the standard jitted train step only "
+                "(offload/onebit/infinity paths run multiple programs per step)"
+            )
+        from ..comm import comm as dscomm
+
+        if not getattr(self, "_comms_hlo_recorded", False):
+            # merge the compiled step's op mix once; repeat calls would
+            # double-count an unchanged program
+            compiled = self._train_step.lower(*self._step_arg_structs).compile()
+            dscomm.record_from_compiled(compiled)
+            self._comms_hlo_recorded = True
+        if measure:
+            dscomm.comms_logger.measure(self.mesh)
+        return dscomm.log_summary()
 
     def eval_batch(self, batch: PyTree) -> jnp.ndarray:
         device_batch = self.shard_batch(batch)

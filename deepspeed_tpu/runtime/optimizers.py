@@ -4,9 +4,11 @@ Analog of reference ``engine._configure_basic_optimizer`` (engine.py:1173) and
 the ``deepspeed/ops/{adam,lamb,adagrad}`` wrappers. The reference ships three
 flavors of Adam (torch, FusedAdam CUDA kernel, DeepSpeedCPUAdam SIMD); under
 XLA the optimizer update is fused into the train step by the compiler, so one
-optax definition covers the "fused" case, and `deepspeed_tpu/ops/fused_adam.py`
-provides a Pallas multi-tensor kernel for the flat-shard fast path. The CPU
-(host-offload) variants live in ``deepspeed_tpu/runtime/offload/``.
+optax definition covers the "fused" case. ``deepspeed_tpu/ops/fused_adam.py``
+is the Pallas multi-tensor kernel alternative; ``benchmarks/fused_adam_bench.py``
+measures both (SURVEY §2.7's required measurement) — optax stays the default
+unless the kernel wins on the target chip. The CPU (host-offload) variants
+live in ``deepspeed_tpu/runtime/offload/``.
 
 Accepted ``type`` strings keep DeepSpeed's names: Adam, AdamW, FusedAdam,
 DeepSpeedCPUAdam, Lamb, FusedLamb, Adagrad, DeepSpeedCPUAdagrad, SGD,

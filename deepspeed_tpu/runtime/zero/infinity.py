@@ -110,9 +110,13 @@ def memory_math(
 class InfinityEngine:
     """Single-chip (per-host) block-streaming train step.
 
-    dp>1 composes by giving each host its batch shard and pmean-ing host grads
-    through the comm backend before the optimizer step; v1 targets the
-    BASELINE single-chip capacity row ("OPT-13B on one chip").
+    Scope (matches the engine's mesh check, runtime/engine.py
+    ``_init_param_offload``): one chip per host, targeting the BASELINE
+    single-chip capacity row ("OPT-13B on one chip"). Multi-host dp would
+    compose by sharding the batch per host and all-reducing the host-side
+    grad accumulators before the optimizer step — NOT implemented yet; the
+    engine rejects >1-device meshes rather than silently training divergent
+    replicas.
     """
 
     def __init__(
@@ -129,10 +133,16 @@ class InfinityEngine:
         compute_dtype=jnp.bfloat16,
         seed: int = 0,
         initial_params: Optional[PyTree] = None,
+        trace_validator=None,
     ):
         assert device in ("cpu", "nvme"), device
         assert opt_device in ("cpu", "nvme"), opt_device
         self.api = api
+        # debug mode: block fetch order must replay the recorded trace
+        # (runtime/debug.BlockTraceValidator; reference coordinator.py:300-307);
+        # only train-step fetches are traced (eval's fwd-only order differs)
+        self._trace_validator = trace_validator
+        self._tracing = False
         self.device = device
         self.opt_device = opt_device
         self.lr_schedule = lr_schedule
@@ -291,6 +301,8 @@ class InfinityEngine:
 
     # ---- device staging ----------------------------------------------------
     def _put_block(self, i: int):
+        if self._trace_validator is not None and self._tracing:
+            self._trace_validator.record_fetch(i)
         flat = self._load_block_bf16(i)
         leaves = [
             jnp.asarray(
@@ -389,9 +401,11 @@ class InfinityEngine:
         self._g_pers_acc = None
         self._g_blk_acc = {}
         losses = []
+        self._tracing = True
         for g in range(gas):
             micro = jax.tree.map(lambda x: x[g], batch_gas)
             losses.append(self._micro_sweep(micro, jax.random.fold_in(rng, g)))
+        self._tracing = False
         loss = float(np.mean([float(jax.device_get(l)) for l in losses]))
 
         # mean over gas + global grad norm (host side, all grads staged)
@@ -447,6 +461,8 @@ class InfinityEngine:
             self.opt.step(m.reshape(-1), g, key=L + j, lr=lr)
         self._pers_dev = None  # refresh device copy next step
         self._g_pers_acc = None
+        if self._trace_validator is not None:
+            self._trace_validator.end_step()
         return {"loss": loss, "grad_norm": gnorm * coef, "lr": lr}
 
     def eval_loss(self, batch_gas: PyTree, rng) -> float:

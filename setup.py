@@ -7,4 +7,10 @@ setup(
     packages=find_packages(include=["deepspeed_tpu", "deepspeed_tpu.*"]),
     python_requires=">=3.10",
     install_requires=["jax", "optax", "orbax-checkpoint", "numpy"],
+    entry_points={
+        "console_scripts": [
+            "deepspeed=deepspeed_tpu.launcher.runner:main",
+            "ds_report=deepspeed_tpu.env_report:main",
+        ]
+    },
 )

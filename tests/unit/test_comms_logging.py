@@ -1,0 +1,109 @@
+"""Comms observability tests (reference utils/comms_logging.py:56 +
+comm/comm.py:461 log_summary): trace-time wrapper accounting, HLO-derived
+op mix of a compiled ZeRO step, measured-latency summary table."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+import deepspeed_tpu.comm.comm as dscomm
+from deepspeed_tpu.comm.xla import all_gather, all_reduce, reduce_scatter
+from deepspeed_tpu.parallel.topology import MeshSpec
+
+
+def setup_function(_):
+    dscomm.comms_logger.reset()
+    dscomm.comms_logger.configure(enabled=True)
+
+
+def teardown_function(_):
+    dscomm.comms_logger.reset()
+    dscomm.comms_logger.configure(enabled=False)
+
+
+def test_wrappers_record_at_trace_time(mesh_dp8):
+    @jax.jit
+    def step(x):
+        return shard_map(
+            lambda v: all_reduce(v, "dp") + reduce_scatter(all_gather(v, "dp"), "dp"),
+            mesh=mesh_dp8, in_specs=(P("dp"),), out_specs=P("dp"), check_vma=False,
+        )(x)
+
+    x = jnp.ones((16, 4), jnp.float32)
+    step(x)
+    d = dscomm.comms_logger.comms_dict
+    assert d[("all_reduce", "dp")]["count"] == 1
+    # per-shard payload: 2x4 f32 = 32 bytes
+    assert d[("all_reduce", "dp")]["bytes"] == 32
+    assert ("all_gather", "dp") in d and ("reduce_scatter", "dp") in d
+    # retrace-once semantics: second call adds nothing
+    step(x)
+    assert d[("all_reduce", "dp")]["count"] == 1
+
+
+def test_record_from_compiled_finds_zero_collectives(mesh_dp8):
+    """A dp-sharded gradient step's XLA-inserted all-reduce shows up in the
+    HLO-derived accounting even though no wrapper was called."""
+    from jax.sharding import NamedSharding
+
+    sh = NamedSharding(mesh_dp8, P("dp"))
+    rep = NamedSharding(mesh_dp8, P())
+
+    def loss(w, x):
+        return jnp.sum((x @ w) ** 2)
+
+    x = jax.device_put(jnp.ones((16, 8), jnp.float32), sh)
+    w = jax.device_put(jnp.ones((8, 4), jnp.float32), rep)
+    compiled = (
+        jax.jit(jax.grad(loss), out_shardings=rep).lower(w, x).compile()
+    )
+    found = dscomm.record_from_compiled(compiled)
+    assert any(op == "all_reduce" for op, _ in found), found
+    text = dscomm.log_summary()
+    assert "all_reduce" in text
+
+
+def test_engine_comms_summary_nonempty(mesh_dp8):
+    """End-to-end: a ZeRO-2 training step reports a non-empty op/bytes table
+    (VERDICT r2 'comms logger not wired' + 'log_summary would print empty')."""
+    from deepspeed_tpu.models import gpt2
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+    cfg = gpt2.get_config("gpt2-tiny")
+    ds = DeepSpeedConfig.load(
+        {
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 2},
+            "comms_logger": {"enabled": True},
+            "steps_per_print": 10**9,
+        },
+        dp_world_size=8,
+    )
+    engine = DeepSpeedEngine(gpt2.make_module(cfg), ds, mesh=mesh_dp8, seed=0)
+    rs = np.random.RandomState(0)
+    b = {"input_ids": rs.randint(0, cfg.vocab_size, (8, 16)).astype(np.int32)}
+    engine.train_batch(b)
+    text = engine.comms_summary()
+    # ZeRO-2: grads sharded over dp → XLA emits reduce-scatter and/or
+    # all-reduce + all-gather; the table must not be empty
+    assert any(op in text for op in ("reduce_scatter", "all_reduce", "all_gather")), text
+
+
+def test_measured_summary_has_latency(mesh_dp8):
+    @jax.jit
+    def step(x):
+        return shard_map(
+            lambda v: all_reduce(v, "dp"),
+            mesh=mesh_dp8, in_specs=(P("dp"),), out_specs=P("dp"), check_vma=False,
+        )(x)
+
+    step(jnp.ones((64, 32), jnp.float32))
+    dscomm.comms_logger.measure(mesh_dp8, iters=2)
+    rec = dscomm.comms_logger.comms_dict[("all_reduce", "dp")]
+    assert rec["time_ms"] is not None and rec["time_ms"] > 0
+    text = dscomm.log_summary()
+    assert "algbw" in text and "-" not in text.splitlines()[2].split()[-1]
