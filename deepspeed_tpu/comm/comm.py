@@ -98,8 +98,10 @@ class CommsLogger:
         timing (comm/comm.py:111 + comms_logging.py:56).
 
         Rows recorded from compiled HLO carry axis ``"xla"`` (the inserting
-        axis isn't recoverable from the op name); they are measured over the
-        mesh's largest axis — an attribution approximation, stated here.
+        axis isn't recoverable from the op name) or ``"xla-loop"`` (the op
+        sits inside a while/scan body, so its count is per-iteration rather
+        than per-step); both are measured over the mesh's largest axis — an
+        attribution approximation, stated here.
         """
         import time
 
@@ -109,10 +111,17 @@ class CommsLogger:
 
         from . import xla as _xla
 
+        def _a2a(x, ax):
+            n = _xla.axis_size(ax)
+            return _xla.all_to_all(
+                x.reshape(n, -1), ax, split_dim=0, concat_dim=0
+            ).reshape(-1)
+
         fns = {
             "all_reduce": lambda x, ax: _xla.all_reduce(x, ax),
             "all_gather": lambda x, ax: _xla.all_gather(x, ax),
             "reduce_scatter": lambda x, ax: _xla.reduce_scatter(x, ax),
+            "all_to_all": _a2a,
             "broadcast": lambda x, ax: _xla.broadcast(x, ax),
             "ppermute": lambda x, ax: _xla.ring_shift(x, ax),
         }
@@ -124,7 +133,7 @@ class CommsLogger:
             for (op, axis), rec in self.comms_dict.items():
                 fn = fns.get(op)
                 ax = axis if axis in mesh.axis_names else (
-                    biggest_axis if axis == "xla" else None
+                    biggest_axis if axis in ("xla", "xla-loop") else None
                 )
                 if fn is None or ax is None:
                     continue
@@ -235,8 +244,17 @@ def record_from_compiled(compiled, reset: bool = False) -> dict:
         r"=\s*(?:\(([^)]*)\)|(\w+)\[([0-9,]*)\][^\s]*)\s+("
         + "|".join(_HLO_COLLECTIVES) + r")(?:-(?:start|done))?\("
     )
-    seen_started = set()
+    # Track computation boundaries: a collective inside a while-loop body
+    # (gas scan, decode loop) executes once PER ITERATION but prints once in
+    # HLO — the same scan-counted-once pitfall as cost_analysis (bench.py
+    # docstring). Those rows get axis "xla-loop" so the table says
+    # per-iteration, not per-step.
+    cur_computation = ""
+    comp_pat = re.compile(r"^\s*%?([\w.\-]+)\s*(?:\([^)]*\))?\s*(?:->[^{]*)?\{")
     for line in txt.splitlines():
+        cm = comp_pat.match(line)
+        if cm and "{" in line and "=" not in line.split("{")[0]:
+            cur_computation = cm.group(1)
         m = pat.search(line)
         if not m:
             continue
@@ -264,7 +282,8 @@ def record_from_compiled(compiled, reset: bool = False) -> dict:
         # all-gather — an upper bound on the wire payload)
         nbytes = max(sizes) if sizes else 0
         name = op.replace("-", "_").replace("collective_permute", "ppermute")
-        key = (name, "xla")
+        in_loop = any(t in cur_computation.lower() for t in ("while", "body", "cond"))
+        key = (name, "xla-loop" if in_loop else "xla")
         rec = found.setdefault(key, {"count": 0, "bytes": 0})
         rec["count"] += 1
         rec["bytes"] += nbytes

@@ -48,6 +48,11 @@ class GPT2Config:
     layer_norm_epsilon: float = 1e-5
     use_bias: bool = True
     remat: bool = False
+    # activation-checkpointing extensions (reference checkpointing.py:367/:480):
+    # shard the saved per-layer boundary activation over tp (needs cfg.mesh),
+    # and/or offload it to pinned host RAM between forward and backward
+    partition_activations: bool = False
+    cpu_checkpointing: bool = False
     attn_impl: str = "auto"  # auto | pallas | jnp | ring | ulysses
     # mesh is required for the sequence-parallel attention impls ("ring",
     # "ulysses") — they shard_map over its sp axis (parallel/sequence.py)
@@ -262,6 +267,49 @@ def _block(cfg: GPT2Config, layer_params, h, train: bool, rng=None):
     return h + _dropout(m, cfg.dropout, r3, train), aux
 
 
+def _tag_boundary(cfg: GPT2Config, h):
+    """Mark the block-input boundary activation for host offload under
+    ``cpu_checkpointing`` (reference checkpointing.py:480). With the
+    save-and-offload remat policy the saved residual — the checkpointed
+    body's input — lives in pinned host RAM between forward and backward."""
+    if cfg.remat and cfg.cpu_checkpointing:
+        from ..runtime.activation_checkpointing.checkpointing import offload_name
+
+        return offload_name(h)
+    return h
+
+
+def _partition_boundary(cfg: GPT2Config, h):
+    """Shard the block-output boundary activation over tp (reference
+    partition_activations, checkpointing.py:367): the scan saves each carry
+    as a residual, so constraining the produced carry makes every saved
+    checkpoint live as 1/tp slices; XLA all-gathers in backward exactly where
+    the reference calls gather_partitioned_activations:259."""
+    if (
+        cfg.partition_activations
+        and cfg.mesh is not None
+        and "tp" in cfg.mesh.axis_names
+        and cfg.mesh.shape["tp"] > 1
+        and h.shape[-1] % cfg.mesh.shape["tp"] == 0
+    ):
+        from jax.sharding import NamedSharding
+
+        return lax.with_sharding_constraint(
+            h, NamedSharding(cfg.mesh, PartitionSpec(None, None, "tp"))
+        )
+    return h
+
+
+def _remat_policy(cfg: GPT2Config):
+    """jax.checkpoint policy for the block body: offload-capable when
+    cpu_checkpointing, else full remat (save nothing, recompute)."""
+    if cfg.cpu_checkpointing:
+        from ..runtime.activation_checkpointing.checkpointing import _offload_policy
+
+        return _offload_policy()
+    return None
+
+
 def _pld_block(cfg: GPT2Config, layer_params, h, train: bool, key, theta, layer_id, pld_key):
     """Stochastic-depth block for Progressive Layer Drop (reference
     progressive_layer_drop.py:5). Layer i of L keeps with probability
@@ -314,6 +362,7 @@ def forward_with_aux(
 
         def body(carry, x):
             h, aux_sum = carry
+            h = _tag_boundary(cfg, h)
             key = x["key"] if need_rng else None
             if use_pld:
                 h, aux = _pld_block(
@@ -321,19 +370,20 @@ def forward_with_aux(
                 )
             else:
                 h, aux = _block(cfg, x["lp"], h, train, key)
-            return (h, aux_sum + aux), None
+            return (_partition_boundary(cfg, h), aux_sum + aux), None
 
     else:
 
         def body(carry, layer_params):
             h, aux_sum = carry
+            h = _tag_boundary(cfg, h)
             h, aux = _block(cfg, layer_params, h, train, None)
-            return (h, aux_sum + aux), None
+            return (_partition_boundary(cfg, h), aux_sum + aux), None
 
         xs = params["blocks"]
 
     if cfg.remat:
-        body = jax.checkpoint(body, prevent_cse=False)
+        body = jax.checkpoint(body, policy=_remat_policy(cfg), prevent_cse=False)
     (h, aux_total), _ = lax.scan(body, (h, jnp.float32(0.0)), xs)
     h = _layer_norm(h, params["ln_f"]["scale"], params["ln_f"]["bias"], cfg.layer_norm_epsilon)
     logits = h @ params["wte"].T  # tied embeddings

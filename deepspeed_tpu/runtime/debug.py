@@ -102,6 +102,10 @@ class BlockTraceValidator:
         self._trace: Optional[List[int]] = None
         self._current: List[int] = []
 
+    def begin_step(self) -> None:
+        """Drop any partial trace from an aborted previous step."""
+        self._current = []
+
     def record_fetch(self, block_id: int) -> None:
         self._current.append(int(block_id))
 

@@ -404,6 +404,20 @@ class DeepSpeedEngine:
                 gamma=config.progressive_layer_drop.gamma,
             )
 
+        # --- activation checkpointing config → global policy (reference
+        # configure:825, which is equally process-global); models built from
+        # GPT2Config-style configs read their own fields, models using
+        # checkpoint_wrapper() read this. ALWAYS set from this engine's
+        # config — deterministic last-init-wins instead of a stale leak from
+        # a previously constructed engine.
+        from .activation_checkpointing import checkpointing as _ck
+
+        ac = config.activation_checkpointing
+        if ac.partition_activations or ac.cpu_checkpointing:
+            _ck.configure(ac)
+        else:
+            _ck.reset()
+
         self.training_dataloader = None
         self._data_iterator = None
         self._step_arg_structs = None
@@ -543,17 +557,18 @@ class DeepSpeedEngine:
                 **{f: getattr(opt_state, f)[0] for f in per_rank_fields}
             )
 
-            def scaled_loss(p, micro, mrng):
-                loss, metrics = model.loss_fn(_cast_params(p, compute_dtype), micro, mrng, True)
+            def scaled_loss(cp, micro, mrng):
+                loss, metrics = model.loss_fn(cp, micro, mrng, True)
                 return loss.astype(jnp.float32), metrics
 
             grad_fn = jax.value_and_grad(scaled_loss, has_aux=True)
+            cparams = _cast_params(params, compute_dtype)  # hoisted out of scan
 
             def micro_step(carry, i):
                 grads_acc, loss_acc = carry
                 micro = jax.tree.map(lambda x: x[i], batch)
                 mrng = jax.random.fold_in(jax.random.fold_in(rng, i), rank)
-                (loss, _), grads = grad_fn(params, micro, mrng)
+                (loss, _), grads = grad_fn(cparams, micro, mrng)
                 grads_acc = jax.tree.map(
                     lambda a, g: a + g.astype(jnp.float32), grads_acc, grads
                 )
@@ -645,17 +660,20 @@ class DeepSpeedEngine:
         gas = self.gradient_accumulation_steps_value
         clip = self.config.gradient_clipping
 
-        def grad_fn_inner(params, micro, mrng):
-            loss, _m = model.loss_fn(_cast_params(params, compute_dtype), micro, mrng, True)
+        def grad_fn_inner(cparams, micro, mrng):
+            loss, _m = model.loss_fn(cparams, micro, mrng, True)
             return loss.astype(jnp.float32)
 
         grad_fn = jax.value_and_grad(grad_fn_inner)
 
         def grad_step(params, batch, rng):
+            # cast hoisted out of the gas scan (see _make_train_step note)
+            cparams = _cast_params(params, compute_dtype)
+
             def micro_step(carry, i):
                 grads_acc, loss_acc = carry
                 micro = jax.tree.map(lambda x: x[i], batch)
-                loss, grads = grad_fn(params, micro, jax.random.fold_in(rng, i))
+                loss, grads = grad_fn(cparams, micro, jax.random.fold_in(rng, i))
                 grads_acc = jax.tree.map(lambda a, g: a + g.astype(acc_dtype), grads_acc, grads)
                 grads_acc = jax.lax.with_sharding_constraint(grads_acc, grad_shardings)
                 return (grads_acc, loss_acc + loss), None
@@ -768,16 +786,19 @@ class DeepSpeedEngine:
         pld_gamma = float(pld_cfg.gamma)
         debug_nan = self._debug_nan_check
 
-        def scaled_loss_fn(params, micro_batch, rng, scale, theta=None):
-            cparams = _cast_params(params, compute_dtype)
+        # NOTE: these take the COMPUTE-dtype copy of the params. The fp32->bf16
+        # master cast is hoisted out of the per-microbatch scan (one cast per
+        # step, not per micro-step) — d(loss)/d(master) == upcast of
+        # d(loss)/d(cast copy), so accumulating the bf16 grads in fp32 is
+        # numerically identical to differentiating through the cast each time.
+        def scaled_loss_fn(cparams, micro_batch, rng, scale, theta=None):
             if theta is not None:
                 loss, metrics = model.pld_loss_fn(cparams, micro_batch, rng, True, theta)
             else:
                 loss, metrics = model.loss_fn(cparams, micro_batch, rng, True)
             return loss.astype(jnp.float32) * scale, (loss, metrics)
 
-        def scaled_pipeline_loss_fn(params, batch, rng, scale):
-            cparams = _cast_params(params, compute_dtype)
+        def scaled_pipeline_loss_fn(cparams, batch, rng, scale):
             loss, metrics = model.pipeline_loss_fn(cparams, batch, rng, True, mesh)
             return loss.astype(jnp.float32) * scale, (loss, metrics)
 
@@ -791,12 +812,13 @@ class DeepSpeedEngine:
                 * jnp.exp(-pld_gamma * state.global_step.astype(jnp.float32))
                 + pld_theta0
             ) if use_pld else None
+            cparams = _cast_params(state.params, compute_dtype)
 
             if pipeline_mode:
                 # pipeline path: all gas microbatches flow through the 1F1B/
                 # fill-drain schedule in ONE grad call (PipelineEngine
                 # train_batch analog) — gas IS the pipeline microbatch count
-                (_, (loss, _metrics)), grads = pipe_grad_fn(state.params, batch, rng, scale)
+                (_, (loss, _metrics)), grads = pipe_grad_fn(cparams, batch, rng, scale)
                 grads = jax.lax.with_sharding_constraint(
                     jax.tree.map(lambda g: g.astype(acc_dtype), grads), grad_shardings
                 )
@@ -807,7 +829,7 @@ class DeepSpeedEngine:
                     grads_acc, loss_acc, i = carry
                     micro = jax.tree.map(lambda x: x[i], batch)
                     mrng = jax.random.fold_in(rng, i)
-                    (_, (loss, _metrics)), grads = grad_fn(state.params, micro, mrng, scale, theta)
+                    (_, (loss, _metrics)), grads = grad_fn(cparams, micro, mrng, scale, theta)
                     if predivide:
                         grads = jax.tree.map(lambda g: g / predivide_factor, grads)
                     grads_acc = jax.tree.map(

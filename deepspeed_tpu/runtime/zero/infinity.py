@@ -401,11 +401,17 @@ class InfinityEngine:
         self._g_pers_acc = None
         self._g_blk_acc = {}
         losses = []
+        if self._trace_validator is not None:
+            self._trace_validator.begin_step()
         self._tracing = True
-        for g in range(gas):
-            micro = jax.tree.map(lambda x: x[g], batch_gas)
-            losses.append(self._micro_sweep(micro, jax.random.fold_in(rng, g)))
-        self._tracing = False
+        try:
+            for g in range(gas):
+                micro = jax.tree.map(lambda x: x[g], batch_gas)
+                losses.append(self._micro_sweep(micro, jax.random.fold_in(rng, g)))
+        finally:
+            # an aborted sweep must not leave a partial trace that makes the
+            # next (healthy) step look divergent
+            self._tracing = False
         loss = float(np.mean([float(jax.device_get(l)) for l in losses]))
 
         # mean over gas + global grad norm (host side, all grads staged)
