@@ -1,0 +1,112 @@
+"""Hardware-mode kernel CI (VERDICT r2 item 8): compile — not interpret —
+the Mosaic kernels on a real TPU chip and check parity against the jnp
+reference paths.
+
+Run with:  DS_TPU_TESTS=1 python -m pytest tests/ -m tpu -q
+(conftest skips its CPU forcing under DS_TPU_TESTS=1; everything here skips
+unless the active backend is a TPU).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = [
+    pytest.mark.tpu,
+    pytest.mark.skipif(
+        jax.default_backend() != "tpu", reason="needs a real TPU backend"
+    ),
+]
+
+
+def _qkv(B, S, H, D, seed=0, dtype=jnp.bfloat16):
+    rs = np.random.RandomState(seed)
+    return [jnp.asarray(rs.randn(B, S, H, D), dtype) for _ in range(3)]
+
+
+class TestFlashAttentionHardware:
+    def test_forward_compiles_and_matches(self):
+        from deepspeed_tpu.ops.attention import causal_attention_jnp
+        from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+        q, k, v = _qkv(2, 1024, 4, 64)
+        o = jax.jit(lambda q, k, v: flash_attention(q, k, v))(q, k, v)
+        o_ref = causal_attention_jnp(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(o, np.float32), np.asarray(o_ref, np.float32),
+            atol=2e-2, rtol=2e-2,
+        )
+
+    def test_backward_compiles_and_matches(self):
+        from deepspeed_tpu.ops.attention import causal_attention_jnp
+        from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+        q, k, v = _qkv(1, 512, 2, 64, seed=1)
+
+        def loss_k(f):
+            return lambda q, k, v: jnp.sum(f(q, k, v).astype(jnp.float32) ** 2)
+
+        g = jax.jit(jax.grad(loss_k(flash_attention), argnums=(0, 1, 2)))(q, k, v)
+        g_ref = jax.jit(jax.grad(loss_k(causal_attention_jnp), argnums=(0, 1, 2)))(q, k, v)
+        for a, b in zip(g, g_ref):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                atol=5e-2, rtol=5e-2,
+            )
+
+    def test_head_dim_128(self):
+        from deepspeed_tpu.ops.attention import causal_attention_jnp
+        from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+        q, k, v = _qkv(1, 256, 2, 128, seed=2)
+        o = jax.jit(lambda q, k, v: flash_attention(q, k, v))(q, k, v)
+        o_ref = causal_attention_jnp(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(o, np.float32), np.asarray(o_ref, np.float32),
+            atol=2e-2, rtol=2e-2,
+        )
+
+
+class TestBlockSparseHardware:
+    def test_fixed_pattern_compiles_and_matches(self):
+        from deepspeed_tpu.ops.sparse_attention import FixedSparsityConfig
+        from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import (
+            sparse_attention,
+        )
+
+        H, S, D, block = 2, 1024, 64, 128
+        cfg = FixedSparsityConfig(num_heads=H, block=block)
+        rs = np.random.RandomState(3)
+        q, k, v = (
+            jnp.asarray(rs.randn(1, S, H, D), jnp.bfloat16) for _ in range(3)
+        )
+        o = jax.jit(
+            lambda q, k, v: sparse_attention(q, k, v, cfg, causal=True, impl="pallas")
+        )(q, k, v)
+        o_ref = sparse_attention(q, k, v, cfg, causal=True, impl="jnp")
+        np.testing.assert_allclose(
+            np.asarray(o, np.float32), np.asarray(o_ref, np.float32),
+            atol=3e-2, rtol=3e-2,
+        )
+
+
+class TestFusedAdamHardware:
+    def test_kernel_compiles_and_matches_optax(self):
+        import optax
+
+        from deepspeed_tpu.ops.fused_adam import fused_adamw_flat
+
+        n = 1024 * 1024
+        rs = np.random.RandomState(4)
+        p = jnp.asarray(rs.randn(n), jnp.float32)
+        g = jnp.asarray(rs.randn(n), jnp.float32)
+        m = jnp.zeros_like(p)
+        v = jnp.zeros_like(p)
+        p2, m2, v2 = jax.jit(
+            lambda p, g, m, v: fused_adamw_flat(p, g, m, v, jnp.int32(1), 1e-3, weight_decay=0.01)
+        )(p, g, m, v)
+        tx = optax.adamw(1e-3, weight_decay=0.01)
+        u, _ = tx.update(g, tx.init(p), p)
+        p_ref = optax.apply_updates(p, u)
+        np.testing.assert_allclose(np.asarray(p2), np.asarray(p_ref), rtol=3e-6, atol=3e-7)
