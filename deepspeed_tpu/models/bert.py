@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..ops.layer_norm import layer_norm
 from ..runtime.module import ModuleSpec
 
 PyTree = Any
@@ -58,9 +59,7 @@ def get_config(name: str, **overrides) -> BertConfig:
 
 
 def _ln(x, scale, bias, eps):
-    m = jnp.mean(x, axis=-1, keepdims=True)
-    v = jnp.var(x, axis=-1, keepdims=True)
-    return (x - m) * lax.rsqrt(v + eps) * scale + bias
+    return layer_norm(x, scale, bias, eps)
 
 
 def init_params(cfg: BertConfig, rng) -> PyTree:

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..ops.layer_norm import layer_norm
 from ..runtime.module import ModuleSpec
 
 PyTree = Any
@@ -78,9 +79,7 @@ def init_cache(cfg: DecoderConfig, batch_size: int, max_len: int, dtype=jnp.bflo
 # ---------------------------------------------------------------------------
 
 def _ln(x, scale, bias, eps):
-    m = jnp.mean(x, axis=-1, keepdims=True)
-    v = jnp.var(x, axis=-1, keepdims=True)
-    return (x - m) * lax.rsqrt(v + eps) * scale + bias
+    return layer_norm(x, scale, bias, eps)
 
 
 def _act(cfg: DecoderConfig, x):

@@ -32,6 +32,7 @@ from jax import lax
 from jax.sharding import PartitionSpec
 
 from ..ops.quantizer import maybe_dequantize as _deq
+from ..ops.layer_norm import layer_norm
 from ..runtime.module import ModuleSpec
 
 PyTree = Any
@@ -194,9 +195,7 @@ def logical_axes(cfg: Optional[GPT2Config] = None) -> PyTree:
 # ---------------------------------------------------------------------------
 
 def _layer_norm(x, scale, bias, eps):
-    m = jnp.mean(x, axis=-1, keepdims=True)
-    v = jnp.var(x, axis=-1, keepdims=True)
-    return (x - m) * lax.rsqrt(v + eps) * scale + bias
+    return layer_norm(x, scale, bias, eps)
 
 
 def _dropout(x, rate: float, rng, train: bool):

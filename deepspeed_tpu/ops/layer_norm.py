@@ -1,0 +1,21 @@
+"""Layer norm with fp32 statistics — the one shared implementation.
+
+The reference's fused LN kernels accumulate mean/variance in fp32 regardless
+of the activation dtype (``csrc/transformer/normalize_kernels.cu``); doing the
+statistics in fp16 overflows the variance/rsqrt chain. Every model family
+(gpt2/decoder/bert) routes through this helper so the numerics cannot drift
+apart between copies.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def layer_norm(x, scale, bias, eps):
+    xf = x.astype(jnp.float32)
+    m = jnp.mean(xf, axis=-1, keepdims=True)
+    v = jnp.var(xf, axis=-1, keepdims=True)
+    y = (xf - m) * lax.rsqrt(v + eps)
+    return (y * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(x.dtype)

@@ -332,11 +332,6 @@ class DeepSpeedEngine:
         self.offload_enabled = off.device in ("cpu", "nvme") and not self.onebit
         self._offload = None
         if self.offload_enabled:
-            if config.fp16.enabled:
-                raise ValueError(
-                    "offload_optimizer currently supports bf16/fp32 steps "
-                    "(host-side loss scaling lands with the fp16 offload path)"
-                )
             from .offload.offload_engine import HostOffloadOptimizer
 
             p = (opt_cfg.params if opt_cfg else None) or {}
@@ -367,7 +362,17 @@ class DeepSpeedEngine:
         elif self.offload_enabled:
             self._grad_step = jax.jit(
                 self._make_grad_step(),
-                out_shardings=(None, self.grad_shardings, None),
+                out_shardings=(None, self.grad_shardings, None, None),
+            )
+            import functools
+
+            self._scale_update = jax.jit(
+                functools.partial(
+                    ls.update,
+                    dynamic=self.dynamic_loss_scale,
+                    scale_window=config.fp16.loss_scale_window,
+                    min_scale=config.fp16.min_loss_scale,
+                )
             )
             self._train_step = self._offload_dispatch
         else:
@@ -650,30 +655,35 @@ class DeepSpeedEngine:
     # ZeRO-Offload path: jitted (loss, grads) + host optimizer step
     # ------------------------------------------------------------------
     def _make_grad_step(self):
-        """Device program computing (loss, clipped mean grads, gnorm) only —
-        the optimizer update happens on host (reference cpu-offload split:
-        backward on device, DeepSpeedCPUAdam on host)."""
+        """Device program computing (loss, clipped mean grads, gnorm,
+        overflow) only — the optimizer update happens on host (reference
+        cpu-offload split: backward on device, DeepSpeedCPUAdam on host).
+        fp16 runs loss-scaled: the scale multiplies the loss in-graph and the
+        unscale + overflow scan happen here, so the host sees clean fp32
+        grads plus a skip flag (reference stage_1_and_2.py cpu_offload +
+        DynamicLossScaler)."""
         model = self.module
         compute_dtype = self.compute_dtype
         acc_dtype = self.grad_accum_dtype
         grad_shardings = self.grad_shardings
         gas = self.gradient_accumulation_steps_value
         clip = self.config.gradient_clipping
+        fp16 = self.fp16_enabled
 
-        def grad_fn_inner(cparams, micro, mrng):
+        def grad_fn_inner(cparams, micro, mrng, scale):
             loss, _m = model.loss_fn(cparams, micro, mrng, True)
-            return loss.astype(jnp.float32)
+            return loss.astype(jnp.float32) * scale
 
         grad_fn = jax.value_and_grad(grad_fn_inner)
 
-        def grad_step(params, batch, rng):
+        def grad_step(params, batch, rng, scale):
             # cast hoisted out of the gas scan (see _make_train_step note)
             cparams = _cast_params(params, compute_dtype)
 
             def micro_step(carry, i):
                 grads_acc, loss_acc = carry
                 micro = jax.tree.map(lambda x: x[i], batch)
-                loss, grads = grad_fn(cparams, micro, jax.random.fold_in(rng, i))
+                loss, grads = grad_fn(cparams, micro, jax.random.fold_in(rng, i), scale)
                 grads_acc = jax.tree.map(lambda a, g: a + g.astype(acc_dtype), grads_acc, grads)
                 grads_acc = jax.lax.with_sharding_constraint(grads_acc, grad_shardings)
                 return (grads_acc, loss_acc + loss), None
@@ -683,12 +693,14 @@ class DeepSpeedEngine:
             (grads, loss_sum), _ = jax.lax.scan(
                 micro_step, (zero, jnp.float32(0.0)), jnp.arange(gas)
             )
-            grads = jax.tree.map(lambda g: g.astype(jnp.float32) / gas, grads)
+            inv = 1.0 / (scale * gas)
+            grads = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, grads)
+            overflow = ls.has_inf_or_nan(grads) if fp16 else jnp.bool_(False)
             gnorm = global_norm(grads)
             if clip > 0.0:
                 coef = jnp.minimum(1.0, clip / (gnorm + 1e-6))
                 grads = jax.tree.map(lambda g: g * coef, grads)
-            return loss_sum / gas, grads, gnorm
+            return loss_sum / (gas * scale), grads, gnorm, overflow
 
         return grad_step
 
@@ -714,30 +726,43 @@ class DeepSpeedEngine:
         return new_state, metrics
 
     def _offload_dispatch(self, state: "TrainState", batch: PyTree, rng):
-        loss, grads, gnorm = self._grad_step(state.params, batch, rng)
-        step = self.global_steps
-        # pipelined host step: grads stream D2H per subgroup while earlier
-        # subgroups run the SIMD Adam; updated leaves upload H2D immediately
-        # (see offload_engine.step docstring)
-        shard_leaves = jax.tree.leaves(self.param_shardings)
-        new_params = self._offload.step(
-            grads,
-            step,
-            compute_dtype=self.compute_dtype,
-            put_leaf=lambda li, arr: jax.device_put(arr, shard_leaves[li]),
-        )
+        scale = state.loss_scale.cur_scale if self.fp16_enabled else jnp.float32(1.0)
+        loss, grads, gnorm, overflow = self._grad_step(state.params, batch, rng, scale)
+        # LR schedule is driven by APPLIED steps only — a skipped (overflow)
+        # step must not advance it, or the applied LR silently diverges from
+        # metrics['lr'] and from the non-offload path (scheduler not stepped
+        # on overflow, reference fused_optimizer semantics)
+        step = getattr(self, "_offload_applied_steps", 0)
+        skipped = self.fp16_enabled and bool(jax.device_get(overflow))
+        if skipped:
+            # overflow: drop grads, keep params; loss-scale backs off
+            # (fp16/fused_optimizer.py skip semantics on the host-driven path)
+            new_params = state.params
+        else:
+            # pipelined host step: grads stream D2H per subgroup while earlier
+            # subgroups run the SIMD Adam; updated leaves upload H2D
+            # immediately (see offload_engine.step docstring)
+            shard_leaves = jax.tree.leaves(self.param_shardings)
+            new_params = self._offload.step(
+                grads,
+                step,
+                compute_dtype=self.compute_dtype,
+                put_leaf=lambda li, arr: jax.device_put(arr, shard_leaves[li]),
+            )
+            self._offload_applied_steps = step + 1
+        new_scale_state = self._scale_update(state.loss_scale, overflow)
         new_state = TrainState(
             params=new_params,
             opt_state=state.opt_state,
-            loss_scale=state.loss_scale,
-            global_step=state.global_step + 1,
-            skipped_steps=state.skipped_steps,
+            loss_scale=new_scale_state,
+            global_step=state.global_step + (0 if skipped else 1),
+            skipped_steps=state.skipped_steps + (1 if skipped else 0),
         )
         metrics = {
             "loss": loss,
             "grad_norm": gnorm,
-            "loss_scale": jnp.float32(1.0),
-            "overflow": jnp.bool_(False),
+            "loss_scale": state.loss_scale.cur_scale,
+            "overflow": overflow,
             "lr": jnp.asarray(self.lr_schedule(state.global_step), jnp.float32),
             "global_step": new_state.global_step,
         }
@@ -905,6 +930,18 @@ class DeepSpeedEngine:
     def _make_eval_step(self):
         model = self.module
         compute_dtype = self.compute_dtype
+        mesh = self.mesh
+
+        if mesh_axis_size(self.mesh, "pp") > 1:
+            # pp mesh: evaluating through loss_fn would bypass the pipeline
+            # stage partitioning and mis-trace — route through the same
+            # fill-drain schedule as training (train=False)
+            def eval_step(params, batch, rng):
+                cparams = _cast_params(params, compute_dtype)
+                loss, _ = model.pipeline_loss_fn(cparams, batch, rng, False, mesh)
+                return loss.astype(jnp.float32)
+
+            return eval_step
 
         def eval_step(params, batch, rng):
             cparams = _cast_params(params, compute_dtype)
@@ -1167,6 +1204,8 @@ class DeepSpeedEngine:
         )
         self.state = state
         self.global_steps = int(client_state.get("global_steps", self.get_global_step()))
+        # applied-step counter drives the offload path's LR schedule
+        self._offload_applied_steps = self.get_global_step()
         if self._offload is not None and load_optimizer_states:
             from .checkpoint_utils_offload import offload_npz_path
 

