@@ -66,6 +66,7 @@ class InferenceEngine:
         quantize_groups: int = 64,
         max_tokens: int = 1024,
         seed: int = 0,
+        checkpoint: Optional[str] = None,
         **kwargs,
     ):
         self.dtype = dtype
@@ -90,7 +91,29 @@ class InferenceEngine:
         self.model_config = None
         self._generate_cache: Dict = {}
 
-        if model is not None and not isinstance(model, ModuleSpec) and _is_torch_module(model):
+        kind = None
+        if checkpoint is not None and model is not None:
+            raise ValueError(
+                "pass either model= or checkpoint= to init_inference, not both "
+                "(a provided model would silently shadow the checkpoint weights)"
+            )
+        if model is None and checkpoint is not None:
+            # layer-streaming load straight from checkpoint files — the big-
+            # model path that never instantiates a torch module (reference
+            # module_inject/load_checkpoint.py:241)
+            from ..module_inject.load_checkpoint import load_checkpoint_streamed
+
+            kind, mcfg, params = load_checkpoint_streamed(checkpoint, dtype=dtype)
+            if quantize_bits == 8:
+                from ..ops.quantizer import quantize_tree
+
+                params = quantize_tree(
+                    jax.tree.map(jnp.asarray, params),
+                    groups=quantize_groups,
+                    dtype=dtype,
+                )
+            self.quantized = quantize_bits == 8
+        elif model is not None and not isinstance(model, ModuleSpec) and _is_torch_module(model):
             # reference path: init_inference(hf_model, replace_with_kernel_inject=True)
             from ..module_inject import replace_transformer_layer
 
@@ -101,6 +124,8 @@ class InferenceEngine:
                 quantize_bits=quantize_bits,
                 quantize_groups=quantize_groups,
             )
+            self.quantized = quantize_bits == 8
+        if kind is not None:
             self.model_config = mcfg
             if kind == "gpt2":
                 from ..models import gpt2 as m_mod
@@ -111,7 +136,6 @@ class InferenceEngine:
             else:
                 raise ValueError(f"unsupported injected model kind {kind}")
             model = m_mod.make_module(mcfg)
-            self.quantized = quantize_bits == 8
         else:
             assert model is not None and model.apply_fn is not None, (
                 "init_inference requires a ModuleSpec with apply_fn or an HF torch model"

@@ -1,0 +1,80 @@
+"""Auxiliary CLI entry points (reference ``bin/ds_ssh``, ``bin/ds_bench``,
+``bin/ds_elastic``; installed via setup.py console_scripts).
+
+- ``ds_ssh``: run a shell command on every host of a hostfile (the
+  cluster-wide fan-out the reference implements with a pdsh loop).
+- ``ds_bench``: sweep the collective micro-benchmarks on the local mesh —
+  reuses ``CommsLogger.measure`` so the numbers match ``comms_summary``.
+- ``ds_elastic``: pretty-print the elastic batch ladder for a config
+  (reference ds_elastic: compute_elastic_config from a ds_config JSON).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shlex
+import subprocess
+import sys
+
+
+def ds_ssh(argv=None) -> int:
+    p = argparse.ArgumentParser("ds_ssh", description="run a command on all hosts")
+    p.add_argument("-f", "--hostfile", default="/job/hostfile")
+    p.add_argument("command", nargs=argparse.REMAINDER)
+    args = p.parse_args(argv)
+    from .runner import fetch_hostfile
+
+    hosts = fetch_hostfile(args.hostfile)
+    if not hosts:
+        print(f"ds_ssh: no hosts in {args.hostfile}", file=sys.stderr)
+        return 1
+    cmd = shlex.join(args.command)  # preserve quoting on the remote shell
+    rc = 0
+    for host in hosts:
+        print(f"--- {host} ---")
+        r = subprocess.run(["ssh", "-o", "StrictHostKeyChecking=no", host, cmd])
+        rc = rc or r.returncode
+    return rc
+
+
+def ds_bench(argv=None) -> int:
+    p = argparse.ArgumentParser("ds_bench", description="collective micro-bench")
+    p.add_argument("--ops", default="all_reduce,all_gather,reduce_scatter,all_to_all")
+    p.add_argument("--bytes", type=int, default=16 * 1024 * 1024)
+    p.add_argument("--iters", type=int, default=10)
+    args = p.parse_args(argv)
+    import jax
+
+    from ..comm import comm as dscomm
+    from ..parallel.topology import MeshSpec
+
+    n = len(jax.devices())
+    mesh = MeshSpec(dp=n).build_mesh()
+    dscomm.comms_logger.configure(enabled=True)
+    for op in args.ops.split(","):
+        dscomm.comms_logger.comms_dict[(op.strip(), "dp")] = {
+            "count": 1, "bytes": args.bytes, "time_ms": None, "world": None,
+        }
+    dscomm.comms_logger.measure(mesh, iters=args.iters)
+    print(dscomm.log_summary())
+    return 0
+
+
+def ds_elastic(argv=None) -> int:
+    p = argparse.ArgumentParser("ds_elastic", description="elastic config ladder")
+    p.add_argument("-c", "--config", required=True, help="ds_config JSON path")
+    p.add_argument("-w", "--world-size", type=int, default=0)
+    args = p.parse_args(argv)
+    from ..elasticity.elasticity import compute_elastic_config
+
+    with open(args.config) as f:
+        doc = json.load(f)
+    res = compute_elastic_config(
+        doc, world_size=args.world_size, return_microbatch=args.world_size > 0
+    )
+    out = {"final_batch_size": res[0], "valid_gpus": res[1]}
+    if len(res) > 2:
+        out["micro_batch_per_gpu"] = res[2]
+    print(json.dumps(out, indent=2))
+    return 0

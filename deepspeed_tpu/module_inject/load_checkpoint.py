@@ -1,0 +1,210 @@
+"""Layer-streaming HF checkpoint loader — no torch module materialized.
+
+Analog of reference ``deepspeed/module_inject/load_checkpoint.py:241``
+(load_model_with_checkpoint: walks the injected module layer-by-layer,
+copying tensors out of per-shard state dicts so an OPT-13B-class model never
+needs model+state_dict resident at once). The TPU-native equivalent skips the
+torch module entirely: checkpoint shards (safetensors or torch .bin) are
+opened lazily, each tensor is read once, written into its slot of the stacked
+JAX param layout, and released. Peak host RAM ≈ the final param stack in the
+target dtype (2 B/param for bf16) + one tensor — vs the policy path's full
+fp32 torch model + converted copy (~6x more for a 13B model).
+
+Per-architecture key maps register like injection policies; GPT-2 ships
+built-in, others convert via ``replace_transformer_layer`` (live module) or
+register a map with :func:`register_checkpoint_map`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+PyTree = Any
+
+
+class _CkptReader:
+    """Lazy tensor access across sharded safetensors / torch .bin files."""
+
+    def __init__(self, model_dir: str):
+        self.dir = model_dir
+        self._key_to_file: Dict[str, str] = {}
+        self._open_safetensors: Dict[str, Any] = {}
+        self._bin_cache: Dict[str, Dict[str, Any]] = {}
+        st = [f for f in os.listdir(model_dir) if f.endswith(".safetensors")]
+        bins = [f for f in os.listdir(model_dir) if f.endswith(".bin")]
+        idx_st = os.path.join(model_dir, "model.safetensors.index.json")
+        idx_bin = os.path.join(model_dir, "pytorch_model.bin.index.json")
+        if os.path.exists(idx_st):
+            for k, f in json.load(open(idx_st))["weight_map"].items():
+                self._key_to_file[k] = f
+        elif os.path.exists(idx_bin):
+            for k, f in json.load(open(idx_bin))["weight_map"].items():
+                self._key_to_file[k] = f
+        elif st:
+            from safetensors import safe_open
+
+            for f in st:
+                with safe_open(os.path.join(model_dir, f), framework="np") as h:
+                    for k in h.keys():
+                        self._key_to_file[k] = f
+        elif bins:
+            import torch
+
+            for f in bins:
+                # mmap keeps storages on disk until sliced
+                sd = torch.load(
+                    os.path.join(model_dir, f), map_location="cpu", mmap=True,
+                    weights_only=True,
+                )
+                self._bin_cache[f] = sd
+                for k in sd:
+                    self._key_to_file[k] = f
+        else:
+            raise FileNotFoundError(f"no checkpoint files in {model_dir}")
+
+    def keys(self):
+        return self._key_to_file.keys()
+
+    def get(self, key: str) -> np.ndarray:
+        f = self._key_to_file[key]
+        path = os.path.join(self.dir, f)
+        if f.endswith(".safetensors"):
+            from safetensors import safe_open
+
+            h = self._open_safetensors.get(f)
+            if h is None:
+                h = safe_open(path, framework="np")
+                self._open_safetensors[f] = h
+            t = h.get_tensor(key)
+            if t.dtype.kind == "V":  # bf16 surfaces as a void dtype in numpy
+                import ml_dtypes
+
+                t = t.view(ml_dtypes.bfloat16)
+            # source dtype kept — the layer loop casts ONCE to the target
+            # dtype, avoiding a transient fp32 copy of every tensor
+            return t
+        # torch .bin shard (mmap'd)
+        if f not in self._bin_cache:
+            import torch
+
+            self._bin_cache[f] = torch.load(
+                path, map_location="cpu", mmap=True, weights_only=True
+            )
+        return self._bin_cache[f][key].float().numpy()
+
+
+# arch name → (match_fn(config_dict) -> bool, loader_fn(reader, config_dict, dtype))
+_CKPT_MAPS: Dict[str, Tuple[Callable, Callable]] = {}
+
+
+def register_checkpoint_map(name: str, match, loader) -> None:
+    _CKPT_MAPS[name] = (match, loader)
+
+
+def load_checkpoint_streamed(model_dir: str, dtype=None) -> Tuple[str, Any, PyTree]:
+    """Stream an HF checkpoint directory into (kind, model_config, params).
+
+    Drop-in alternative to ``replace_transformer_layer`` for checkpoints too
+    big to instantiate as a torch model (reference load_checkpoint.py:241).
+    """
+    import jax.numpy as jnp
+
+    dtype = dtype or jnp.bfloat16
+    with open(os.path.join(model_dir, "config.json")) as f:
+        hf_cfg = json.load(f)
+    reader = _CkptReader(model_dir)
+    for name, (match, loader) in _CKPT_MAPS.items():
+        if match(hf_cfg):
+            return loader(reader, hf_cfg, dtype)
+    raise ValueError(
+        f"no streaming checkpoint map for model_type={hf_cfg.get('model_type')}; "
+        "registered: " + ", ".join(_CKPT_MAPS) + ". Use replace_transformer_layer "
+        "or register_checkpoint_map."
+    )
+
+
+# ---------------------------------------------------------------------------
+# GPT-2 (flagship): transformer.h.{i}.* → stacked blocks
+# ---------------------------------------------------------------------------
+
+def _load_gpt2(reader: _CkptReader, hf_cfg: dict, dtype) -> Tuple[str, Any, PyTree]:
+    import jax.numpy as jnp
+    import ml_dtypes
+
+    from ..models.gpt2 import GPT2Config
+
+    L = hf_cfg["n_layer"]
+    E = hf_cfg["n_embd"]
+    cfg = GPT2Config(
+        vocab_size=hf_cfg["vocab_size"],
+        n_positions=hf_cfg["n_positions"],
+        n_embd=E,
+        n_layer=L,
+        n_head=hf_cfg["n_head"],
+        layer_norm_epsilon=hf_cfg.get("layer_norm_epsilon", 1e-5),
+        dtype=dtype,
+    )
+    np_dt = ml_dtypes.bfloat16 if dtype == jnp.bfloat16 else np.dtype(dtype)
+    pref = "transformer." if any(k.startswith("transformer.") for k in reader.keys()) else ""
+
+    def g(key):
+        return reader.get(pref + key)
+
+    # stacked block leaves preallocated in the TARGET dtype; each layer's
+    # tensors are read, written, and freed — the streaming property
+    blocks = {
+        "ln_1": {"scale": np.empty((L, E), np_dt), "bias": np.empty((L, E), np_dt)},
+        "ln_2": {"scale": np.empty((L, E), np_dt), "bias": np.empty((L, E), np_dt)},
+        "attn": {
+            "c_attn_w": np.empty((L, E, 3 * E), np_dt),
+            "c_attn_b": np.empty((L, 3 * E), np_dt),
+            "c_proj_w": np.empty((L, E, E), np_dt),
+            "c_proj_b": np.empty((L, E), np_dt),
+        },
+        "mlp": {
+            "c_fc_w": np.empty((L, E, 4 * E), np_dt),
+            "c_fc_b": np.empty((L, 4 * E), np_dt),
+            "c_proj_w": np.empty((L, 4 * E, E), np_dt),
+            "c_proj_b": np.empty((L, E), np_dt),
+        },
+    }
+    # HF Conv1D stores [in, out] — already our h @ w layout, no transpose
+    per_layer = [
+        ("ln_1.weight", lambda b, i, t: b["ln_1"]["scale"].__setitem__(i, t)),
+        ("ln_1.bias", lambda b, i, t: b["ln_1"]["bias"].__setitem__(i, t)),
+        ("ln_2.weight", lambda b, i, t: b["ln_2"]["scale"].__setitem__(i, t)),
+        ("ln_2.bias", lambda b, i, t: b["ln_2"]["bias"].__setitem__(i, t)),
+        ("attn.c_attn.weight", lambda b, i, t: b["attn"]["c_attn_w"].__setitem__(i, t)),
+        ("attn.c_attn.bias", lambda b, i, t: b["attn"]["c_attn_b"].__setitem__(i, t)),
+        ("attn.c_proj.weight", lambda b, i, t: b["attn"]["c_proj_w"].__setitem__(i, t)),
+        ("attn.c_proj.bias", lambda b, i, t: b["attn"]["c_proj_b"].__setitem__(i, t)),
+        ("mlp.c_fc.weight", lambda b, i, t: b["mlp"]["c_fc_w"].__setitem__(i, t)),
+        ("mlp.c_fc.bias", lambda b, i, t: b["mlp"]["c_fc_b"].__setitem__(i, t)),
+        ("mlp.c_proj.weight", lambda b, i, t: b["mlp"]["c_proj_w"].__setitem__(i, t)),
+        ("mlp.c_proj.bias", lambda b, i, t: b["mlp"]["c_proj_b"].__setitem__(i, t)),
+    ]
+    for i in range(L):
+        for suffix, write in per_layer:
+            t = g(f"h.{i}.{suffix}")
+            write(blocks, i, t.astype(np_dt))
+            del t
+
+    params = {
+        "wte": g("wte.weight").astype(np_dt),
+        "wpe": g("wpe.weight").astype(np_dt),
+        "ln_f": {
+            "scale": g("ln_f.weight").astype(np_dt),
+            "bias": g("ln_f.bias").astype(np_dt),
+        },
+        "blocks": blocks,
+    }
+    return "gpt2", cfg, params
+
+
+register_checkpoint_map(
+    "gpt2", lambda c: c.get("model_type") == "gpt2", _load_gpt2
+)

@@ -11,6 +11,9 @@ setup(
         "console_scripts": [
             "deepspeed=deepspeed_tpu.launcher.runner:main",
             "ds_report=deepspeed_tpu.env_report:main",
+            "ds_ssh=deepspeed_tpu.launcher.tools:ds_ssh",
+            "ds_bench=deepspeed_tpu.launcher.tools:ds_bench",
+            "ds_elastic=deepspeed_tpu.launcher.tools:ds_elastic",
         ]
     },
 )

@@ -235,3 +235,69 @@ class TestMoEInference:
         np.testing.assert_allclose(
             np.asarray(logits_cached), np.asarray(logits_full), atol=2e-4, rtol=2e-3
         )
+
+
+class TestStreamedCheckpointLoad:
+    """Layer-streaming HF checkpoint load (VERDICT r2 missing #6; reference
+    module_inject/load_checkpoint.py:241): params come straight from the
+    checkpoint files, no torch module instantiated."""
+
+    @pytest.fixture
+    def saved_model(self, tmp_path):
+        torch = pytest.importorskip("torch")
+        from transformers import GPT2Config as HFConfig, GPT2LMHeadModel
+
+        torch.manual_seed(0)
+        cfg = HFConfig(
+            n_embd=64, n_layer=2, n_head=4, vocab_size=512, n_positions=128,
+            resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        )
+        model = GPT2LMHeadModel(cfg)
+        model.eval()
+        d = str(tmp_path / "ckpt")
+        model.save_pretrained(d)  # safetensors
+        d_bin = str(tmp_path / "ckpt_bin")
+        model.save_pretrained(d_bin, safe_serialization=False)  # torch .bin
+        return model, d, d_bin
+
+    @pytest.mark.parametrize("fmt", ["safetensors", "bin"])
+    def test_streamed_matches_policy_conversion(self, saved_model, fmt):
+        from deepspeed_tpu.module_inject import replace_transformer_layer
+        from deepspeed_tpu.module_inject.load_checkpoint import (
+            load_checkpoint_streamed,
+        )
+
+        model, d_st, d_bin = saved_model
+        path = d_st if fmt == "safetensors" else d_bin
+        kind, cfg, params = load_checkpoint_streamed(path, dtype=jnp.float32)
+        assert kind == "gpt2" and cfg.n_layer == 2
+        kind2, cfg2, params2 = replace_transformer_layer(model, dtype=jnp.float32)
+        flat_a = sorted(
+            jax.tree_util.tree_flatten_with_path(jax.tree.map(np.asarray, params))[0],
+            key=lambda kv: str(kv[0]),
+        )
+        flat_b = sorted(
+            jax.tree_util.tree_flatten_with_path(jax.tree.map(np.asarray, params2))[0],
+            key=lambda kv: str(kv[0]),
+        )
+        assert len(flat_a) == len(flat_b)
+        for (pa, a), (pb, b) in zip(flat_a, flat_b):
+            assert str(pa) == str(pb)
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6,
+                                       err_msg=str(pa))
+
+    def test_init_inference_from_checkpoint_generates(self, saved_model):
+        import deepspeed_tpu
+
+        model, d_st, _ = saved_model
+        eng = deepspeed_tpu.init_inference(checkpoint=d_st, dtype=jnp.float32)
+        ids = np.random.RandomState(0).randint(0, 512, (1, 8)).astype(np.int32)
+        out = eng.generate(ids, max_new_tokens=4)
+        assert out.shape == (1, 12)
+        # logits parity vs the live HF model
+        import torch
+
+        with torch.no_grad():
+            ref = model(torch.tensor(ids.astype(np.int64))).logits.numpy()
+        served = np.asarray(eng.forward({"input_ids": jnp.asarray(ids)}))
+        np.testing.assert_allclose(served, ref, atol=2e-3, rtol=2e-3)

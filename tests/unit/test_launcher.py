@@ -128,3 +128,41 @@ class TestCommBenchmarks:
         assert out.returncode == 0, out.stderr
         assert "all_reduce (world=8)" in out.stdout
         assert "busbw" in out.stdout
+
+
+class TestAuxCLIs:
+    """bin/ equivalents (reference bin/ds_ssh, ds_bench, ds_elastic)."""
+
+    def test_ds_elastic(self, tmp_path, capsys):
+        import json
+
+        from deepspeed_tpu.launcher.tools import ds_elastic
+
+        cfg = {
+            "elasticity": {
+                "enabled": True,
+                "max_train_batch_size": 1024,
+                "micro_batch_sizes": [2, 4],
+                "min_gpus": 1,
+                "max_gpus": 32,
+                "min_time": 0,
+                "version": 0.1,
+            },
+            "train_batch_size": 4,
+        }
+        p = tmp_path / "ds_config.json"
+        p.write_text(json.dumps(cfg))
+        assert ds_elastic(["-c", str(p)]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["final_batch_size"] >= 4 and out["valid_gpus"]
+
+    def test_ds_bench_runs(self, capsys, devices):
+        from deepspeed_tpu.launcher.tools import ds_bench
+
+        assert ds_bench(["--bytes", "4096", "--iters", "1", "--ops", "all_reduce"]) == 0
+        assert "all_reduce" in capsys.readouterr().out
+
+    def test_ds_ssh_missing_hostfile(self, tmp_path):
+        from deepspeed_tpu.launcher.tools import ds_ssh
+
+        assert ds_ssh(["-f", str(tmp_path / "nope"), "echo", "hi"]) == 1
