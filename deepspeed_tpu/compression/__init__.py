@@ -1,11 +1,18 @@
 from .basic_layer import (
     head_pruning_mask,
+    quantize_activation_ste,
     quantize_weight_ste,
     row_pruning_mask,
     sparse_pruning_mask,
 )
-from .compress import CompressionScheduler, apply_compression, init_compression
-from .compress import compression_scheduler_from_config
+from .compress import (
+    CompressionScheduler,
+    apply_compression,
+    compression_scheduler_from_config,
+    init_compression,
+    redundancy_clean,
+    shrink_row_pruned,
+)
 
 __all__ = [
     "CompressionScheduler",
@@ -13,7 +20,10 @@ __all__ = [
     "compression_scheduler_from_config",
     "head_pruning_mask",
     "init_compression",
+    "quantize_activation_ste",
     "quantize_weight_ste",
+    "redundancy_clean",
     "row_pruning_mask",
+    "shrink_row_pruned",
     "sparse_pruning_mask",
 ]

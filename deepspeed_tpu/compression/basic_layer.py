@@ -29,12 +29,16 @@ def quantize_weight_ste(w: jnp.ndarray, bits: int = 8, symmetric: bool = True) -
     return _fake_quant(w, bits, symmetric)
 
 
-def _fake_quant(w, bits, symmetric):
+def _fake_quant(w, bits, symmetric, axis=None):
+    """Shared fake-quant math; ``axis`` selects per-row (dynamic per-token)
+    vs whole-tensor scales."""
+    kd = axis is not None
     qmax = 2.0 ** (bits - 1) - 1
     if symmetric:
-        scale = jnp.maximum(jnp.max(jnp.abs(w)), 1e-8) / qmax
+        scale = jnp.maximum(jnp.max(jnp.abs(w), axis=axis, keepdims=kd), 1e-8) / qmax
         return jnp.round(w / scale) * scale
-    lo, hi = jnp.min(w), jnp.max(w)
+    lo = jnp.min(w, axis=axis, keepdims=kd)
+    hi = jnp.max(w, axis=axis, keepdims=kd)
     scale = jnp.maximum(hi - lo, 1e-8) / (2.0**bits - 1)
     zp = jnp.round(-lo / scale)
     return (jnp.clip(jnp.round(w / scale) + zp, 0, 2.0**bits - 1) - zp) * scale
@@ -92,3 +96,32 @@ def head_pruning_mask(w: jnp.ndarray, ratio: float, num_heads: int) -> jnp.ndarr
     keep = per_head > thresh  # [H]
     mask = jnp.broadcast_to(keep[:, None, None], (num_heads, head_dim, w.shape[1]))
     return mask.reshape(w.shape)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def quantize_activation_ste(
+    x: jnp.ndarray, bits: int = 8, symmetric: bool = True, per_token: bool = True
+) -> jnp.ndarray:
+    """Fake-quantize activations with a straight-through estimator.
+
+    Reference LinearLayer_Compress activation quantization (dynamic range per
+    token row, basic_layer.py activation_quantization branch). ``per_token``
+    computes the scale over the last dim per row — the reference's dynamic
+    per-token mode; otherwise one scale for the whole tensor.
+    """
+    return _fake_quant_act(x, bits, symmetric, per_token)
+
+
+def _fake_quant_act(x, bits, symmetric, per_token):
+    return _fake_quant(x, bits, symmetric, axis=-1 if per_token else None)
+
+
+def _qa_fwd(x, bits, symmetric, per_token):
+    return _fake_quant_act(x, bits, symmetric, per_token), None
+
+
+def _qa_bwd(bits, symmetric, per_token, _res, g):
+    return (g,)  # straight-through
+
+
+quantize_activation_ste.defvjp(_qa_fwd, _qa_bwd)

@@ -133,3 +133,25 @@ def compression_scheduler_from_config(ds_config):
     """Build a CompressionScheduler from a DeepSpeed config document
     (reference compression/scheduler.py entry)."""
     return CompressionScheduler(config=ds_config.get("compression_training", {}))
+
+
+def shrink_row_pruned(w, b, w_next, row_mask):
+    """Physically remove pruned output rows (reference redundancy_clean's
+    structural shrink: a row-pruned Linear drops rows AND the consumer layer
+    drops the matching input columns, yielding genuinely smaller matmuls
+    rather than zero-masked ones).
+
+    Args:
+      w:        [in, out] weight whose OUTPUT features were row-pruned.
+      b:        [out] bias or None.
+      w_next:   [out, anything] consumer weight, or None.
+      row_mask: [out] bool keep-mask (from row_pruning_mask, reduced over in).
+    Returns (w_small, b_small, w_next_small) with out' = mask.sum() columns.
+    """
+    import numpy as np
+
+    keep = np.asarray(row_mask).nonzero()[0]
+    w_small = jnp.take(w, keep, axis=-1)
+    b_small = jnp.take(b, keep, axis=-1) if b is not None else None
+    w_next_small = jnp.take(w_next, keep, axis=0) if w_next is not None else None
+    return w_small, b_small, w_next_small

@@ -92,10 +92,10 @@ class InferenceEngine:
         self._generate_cache: Dict = {}
 
         kind = None
-        if checkpoint is not None and model is not None:
+        if checkpoint is not None and (model is not None or params is not None):
             raise ValueError(
-                "pass either model= or checkpoint= to init_inference, not both "
-                "(a provided model would silently shadow the checkpoint weights)"
+                "pass either checkpoint= or model=/params= to init_inference, "
+                "not both (one source would silently shadow the other's weights)"
             )
         if model is None and checkpoint is not None:
             # layer-streaming load straight from checkpoint files — the big-

@@ -29,12 +29,24 @@ def ds_ssh(argv=None) -> int:
     if not hosts:
         print(f"ds_ssh: no hosts in {args.hostfile}", file=sys.stderr)
         return 1
+    if not args.command:
+        p.error("no command given")
     cmd = shlex.join(args.command)  # preserve quoting on the remote shell
+    # pdsh-style parallel fan-out: launch every host, then collect
+    procs = {
+        host: subprocess.Popen(
+            ["ssh", "-o", "StrictHostKeyChecking=no", host, cmd],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for host in hosts
+    }
     rc = 0
-    for host in hosts:
+    for host, proc in procs.items():
+        out, _ = proc.communicate()
         print(f"--- {host} ---")
-        r = subprocess.run(["ssh", "-o", "StrictHostKeyChecking=no", host, cmd])
-        rc = rc or r.returncode
+        if out:
+            print(out, end="")
+        rc = rc or proc.returncode
     return rc
 
 

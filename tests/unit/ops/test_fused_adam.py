@@ -67,3 +67,24 @@ def test_tree_apply():
     ref = optax.apply_updates(params, u)
     for k in params:
         np.testing.assert_allclose(np.asarray(p2[k]), np.asarray(ref[k]), rtol=2e-6, atol=2e-7)
+
+
+def test_lamb_flat_matches_optax():
+    n = 4096
+    rs = np.random.RandomState(3)
+    p = jnp.asarray(rs.randn(n), jnp.float32)
+    g = jnp.asarray(rs.randn(n), jnp.float32) * 0.1
+    from deepspeed_tpu.ops.fused_adam import fused_lamb_flat
+
+    # optax.lamb: trust ratio per-param-tensor; one flat tensor == one shard
+    tx = optax.lamb(1e-2, b1=0.9, b2=0.999, eps=1e-6, weight_decay=0.05)
+    st = tx.init(p)
+    u, st = tx.update(g, st, p)
+    p_ref = optax.apply_updates(p, u)
+
+    m = v = jnp.zeros_like(p)
+    p2, m2, v2 = fused_lamb_flat(
+        p, g, m, v, jnp.int32(1), 1e-2, (0.9, 0.999), 1e-6, 0.05,
+        min_trust=0.0, max_trust=1e9, interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(p2), np.asarray(p_ref), rtol=3e-5, atol=3e-6)

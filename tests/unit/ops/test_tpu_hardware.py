@@ -110,3 +110,19 @@ class TestFusedAdamHardware:
         u, _ = tx.update(g, tx.init(p), p)
         p_ref = optax.apply_updates(p, u)
         np.testing.assert_allclose(np.asarray(p2), np.asarray(p_ref), rtol=3e-6, atol=3e-7)
+
+
+class TestFusedLambHardware:
+    def test_lamb_kernel_compiles(self):
+        from deepspeed_tpu.ops.fused_adam import fused_lamb_flat
+
+        n = 1024 * 64
+        rs = np.random.RandomState(5)
+        p = jnp.asarray(rs.randn(n), jnp.float32)
+        g = jnp.asarray(rs.randn(n), jnp.float32) * 0.1
+        z = jnp.zeros_like(p)
+        p2, m2, v2 = jax.jit(
+            lambda p, g, m, v: fused_lamb_flat(p, g, m, v, jnp.int32(1), 1e-2)
+        )(p, g, z, z)
+        assert np.isfinite(np.asarray(p2)).all()
+        assert not np.allclose(np.asarray(p2), np.asarray(p))

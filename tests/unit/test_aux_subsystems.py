@@ -285,3 +285,38 @@ class TestFlopsProfiler:
         # engine still trains after profiling (donated-state handling)
         m = engine.train_batch(batch)
         assert np.isfinite(float(jax.device_get(m["loss"])))
+
+
+class TestCompressionDepth:
+    """Activation quantization + structural redundancy_clean shrink
+    (VERDICT r2 #65 depth gaps vs reference compression package)."""
+
+    def test_activation_quant_ste_grads_pass_through(self):
+        from deepspeed_tpu.compression import quantize_activation_ste
+
+        x = jnp.asarray(np.random.RandomState(0).randn(4, 32), jnp.float32)
+        q = quantize_activation_ste(x, 8, True, True)
+        # quantized but close; per-token scales differ per row
+        assert not np.allclose(np.asarray(q), np.asarray(x))
+        np.testing.assert_allclose(np.asarray(q), np.asarray(x), atol=0.05)
+        g = jax.grad(lambda x: jnp.sum(quantize_activation_ste(x, 8, True, True) ** 2))(x)
+        # STE: gradient = 2*q (passes through round)
+        np.testing.assert_allclose(np.asarray(g), 2 * np.asarray(q), atol=1e-5)
+
+    def test_shrink_row_pruned_matches_masked_forward(self):
+        from deepspeed_tpu.compression import row_pruning_mask, shrink_row_pruned
+
+        rs = np.random.RandomState(1)
+        w1 = jnp.asarray(rs.randn(16, 32), jnp.float32)  # [in, out]
+        b1 = jnp.asarray(rs.randn(32), jnp.float32)
+        w2 = jnp.asarray(rs.randn(32, 8), jnp.float32)  # consumer
+        mask2d = row_pruning_mask(w1, 0.5)  # [in, out] column-structured
+        col_keep = np.asarray(mask2d).any(axis=0)  # [out]
+        x = jnp.asarray(rs.randn(4, 16), jnp.float32)
+        # masked (zeroed) forward
+        h_masked = (x @ (w1 * mask2d) + b1 * col_keep) @ w2
+        # structurally shrunk forward: identical output, smaller matmuls
+        w1s, b1s, w2s = shrink_row_pruned(w1, b1, w2, jnp.asarray(col_keep))
+        assert w1s.shape[1] < w1.shape[1] and w2s.shape[0] == w1s.shape[1]
+        h_small = (x @ w1s + b1s) @ w2s
+        np.testing.assert_allclose(np.asarray(h_small), np.asarray(h_masked), atol=1e-5)
