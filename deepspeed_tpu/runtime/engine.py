@@ -281,6 +281,7 @@ class DeepSpeedEngine:
         self.gradient_accumulation_steps_value = config.gradient_accumulation_steps
         self.train_batch_size_value = config.train_batch_size
         self._train_step = self._infinity_dispatch
+        self._train_step_folds_rng = False
         self._eval_step = None  # eval_batch routes through the streamed sweep
 
     def _init_device_state(self, model, config, zcfg, seed, params, opt_cfg) -> None:
@@ -356,6 +357,7 @@ class DeepSpeedEngine:
 
         # --- compiled steps
         donate = (0,) if config.tpu.donate_state else ()
+        self._train_step_folds_rng = False
         if self.onebit:
             self._onebit_step_cache: Dict[Tuple, Callable] = {}
             self._train_step = self._onebit_dispatch
@@ -381,6 +383,7 @@ class DeepSpeedEngine:
                 donate_argnums=donate,
                 out_shardings=(self.state_shardings, None),
             )
+            self._train_step_folds_rng = True
         self._eval_step = jax.jit(self._make_eval_step())
 
     def _finish_init(self, model, config, training_data, collate_fn) -> None:
@@ -831,6 +834,12 @@ class DeepSpeedEngine:
         pipe_grad_fn = jax.value_and_grad(scaled_pipeline_loss_fn, has_aux=True)
 
         def train_step(state: TrainState, batch: PyTree, rng) -> Tuple[TrainState, Dict[str, Any]]:
+            # per-step key derived IN-GRAPH from the step counters: the host
+            # passes the same base key every call (no per-step jax.random.split
+            # dispatch on the host — two fewer tiny programs per step).
+            # skipped_steps keeps keys unique across fp16 overflow bursts,
+            # where global_step does not advance.
+            rng = jax.random.fold_in(rng, state.global_step + state.skipped_steps)
             scale = state.loss_scale.cur_scale if fp16 else jnp.float32(1.0)
             theta = (
                 (1.0 - pld_theta0)
@@ -1036,7 +1045,13 @@ class DeepSpeedEngine:
         if self.progressive_layer_drop is not None:
             self.progressive_layer_drop.update_state(self.global_steps)
         device_batch = self.shard_batch(batch)
-        self._rng, step_rng = jax.random.split(self._rng)
+        # the standard jitted step folds global_step into the key in-graph;
+        # the host-driven paths (offload/onebit/infinity) still need a fresh
+        # key per call
+        if self._train_step_folds_rng:
+            step_rng = self._rng
+        else:
+            self._rng, step_rng = jax.random.split(self._rng)
         if self._step_arg_structs is None:
             # abstract arg specs kept for HLO-level comms accounting
             # (comms_summary) without holding real buffers alive
