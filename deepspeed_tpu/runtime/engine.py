@@ -74,6 +74,14 @@ def _cast_params(params: PyTree, dtype) -> PyTree:
     return jax.tree.map(cast, params)
 
 
+def _path_str(path) -> str:
+    """Leaf-path key matching the codebase convention (compression/compress.py
+    _leaf_paths, quantize.py): dict keys and sequence indices joined by '/'."""
+    return "/".join(
+        str(getattr(p, "key", getattr(p, "idx", p))) for p in path
+    )
+
+
 def global_norm(tree: PyTree) -> jnp.ndarray:
     leaves = [jnp.sum(jnp.square(x.astype(jnp.float32))) for x in jax.tree.leaves(tree)]
     return jnp.sqrt(jnp.sum(jnp.stack(leaves))) if leaves else jnp.float32(0.0)
@@ -364,7 +372,7 @@ class DeepSpeedEngine:
         elif self.offload_enabled:
             self._grad_step = jax.jit(
                 self._make_grad_step(),
-                out_shardings=(None, self.grad_shardings, None, None),
+                out_shardings=(None, self.grad_shardings, None, None, None),
             )
             import functools
 
@@ -664,7 +672,12 @@ class DeepSpeedEngine:
         fp16 runs loss-scaled: the scale multiplies the loss in-graph and the
         unscale + overflow scan happen here, so the host sees clean fp32
         grads plus a skip flag (reference stage_1_and_2.py cpu_offload +
-        DynamicLossScaler)."""
+        DynamicLossScaler).
+
+        With ``sparse_gradients`` + model-declared sparse leaves, the program
+        additionally emits (row ids, rows) for each embedding-table grad so
+        the host fetches only touched rows across the PCIe/D2H boundary —
+        the engine.sparse_allreduce routing analog (engine.py:2286)."""
         model = self.module
         compute_dtype = self.compute_dtype
         acc_dtype = self.grad_accum_dtype
@@ -672,6 +685,7 @@ class DeepSpeedEngine:
         gas = self.gradient_accumulation_steps_value
         clip = self.config.gradient_clipping
         fp16 = self.fp16_enabled
+        sparse_leaves = self._sparse_grad_leaves()
 
         def grad_fn_inner(cparams, micro, mrng, scale):
             loss, _m = model.loss_fn(cparams, micro, mrng, True)
@@ -703,7 +717,28 @@ class DeepSpeedEngine:
             if clip > 0.0:
                 coef = jnp.minimum(1.0, clip / (gnorm + 1e-6))
                 grads = jax.tree.map(lambda g: g * coef, grads)
-            return loss_sum / (gas * scale), grads, gnorm, overflow
+            sparse = {}
+            if sparse_leaves:
+                flat = {
+                    _path_str(path): leaf
+                    for path, leaf in jax.tree_util.tree_flatten_with_path(grads)[0]
+                }
+                for leaf_path, ids_key in sparse_leaves.items():
+                    g = flat[leaf_path]
+                    # clamp out-of-range ids the way gather does (grad lands
+                    # on the last row on the dense path too), then a
+                    # static-shape unique capped at min(tokens, vocab)
+                    # distinct rows; fill slots point past the table
+                    tokens = jnp.clip(batch[ids_key].reshape(-1), 0, g.shape[0] - 1)
+                    size = min(int(tokens.shape[0]), int(g.shape[0]))
+                    ids = jnp.unique(
+                        tokens, size=size, fill_value=g.shape[0]
+                    ).astype(jnp.int32)
+                    padded = jnp.concatenate(
+                        [g, jnp.zeros((1,) + g.shape[1:], g.dtype)], axis=0
+                    )
+                    sparse[leaf_path] = (ids, padded[ids])
+            return loss_sum / (gas * scale), grads, gnorm, overflow, sparse
 
         return grad_step
 
@@ -728,9 +763,20 @@ class DeepSpeedEngine:
         }
         return new_state, metrics
 
+    def _sparse_grad_leaves(self) -> Dict[str, str]:
+        """{grad leaf path: batch ids key} for embedding tables the model
+        declares row-sparse (ModuleSpec.extra['sparse_grad_leaves']), active
+        only under config.sparse_gradients (reference sparse_gradients_enabled
+        gate, engine.py:2286)."""
+        if not self.config.sparse_gradients:
+            return {}
+        return dict((self.module.extra or {}).get("sparse_grad_leaves", {}))
+
     def _offload_dispatch(self, state: "TrainState", batch: PyTree, rng):
         scale = state.loss_scale.cur_scale if self.fp16_enabled else jnp.float32(1.0)
-        loss, grads, gnorm, overflow = self._grad_step(state.params, batch, rng, scale)
+        loss, grads, gnorm, overflow, sparse = self._grad_step(
+            state.params, batch, rng, scale
+        )
         # LR schedule is driven by APPLIED steps only — a skipped (overflow)
         # step must not advance it, or the applied LR silently diverges from
         # metrics['lr'] and from the non-offload path (scheduler not stepped
@@ -742,6 +788,24 @@ class DeepSpeedEngine:
             # (fp16/fused_optimizer.py skip semantics on the host-driven path)
             new_params = state.params
         else:
+            if sparse:
+                # host-side concat-then-apply (engine.sparse_allreduce:2301
+                # semantics): fetch only (ids, rows) across D2H, rebuild the
+                # dense grad in host RAM; the device dense buffer is never
+                # copied (and never on skipped steps)
+                flat, treedef = jax.tree_util.tree_flatten_with_path(grads)
+                rebuilt = []
+                for path, leaf in flat:
+                    name = _path_str(path)
+                    if name in sparse:
+                        ids, rows = jax.device_get(sparse[name])
+                        dense = np.zeros(leaf.shape, np.float32)
+                        valid = ids < leaf.shape[0]  # drop fill slots
+                        dense[ids[valid]] = np.asarray(rows)[valid]  # ids unique
+                        rebuilt.append(dense)
+                    else:
+                        rebuilt.append(leaf)
+                grads = jax.tree_util.tree_unflatten(treedef, rebuilt)
             # pipelined host step: grads stream D2H per subgroup while earlier
             # subgroups run the SIMD Adam; updated leaves upload H2D
             # immediately (see offload_engine.step docstring)
