@@ -35,11 +35,9 @@ PyTree = Any
 
 
 def _leaf_paths(tree: PyTree) -> List[Tuple[str, Any]]:
-    out = []
-    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
-        name = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
-        out.append((name, leaf))
-    return out
+    from ..utils.pytree import leaf_paths
+
+    return leaf_paths(tree)
 
 
 def _matches(path: str, modules: List[str]) -> bool:

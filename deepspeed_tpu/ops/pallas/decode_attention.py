@@ -1,0 +1,107 @@
+"""Pallas decode-attention kernel: one query step against a KV cache.
+
+Analog of the reference's fused inference attention (``softmax_context`` with
+``layer_past``: ``csrc/transformer/inference/csrc/pt_binding.cpp:1323``-region,
+``ops/transformer/inference/transformer_inference.py:231``): at decode time
+the hot op is q·K^T → masked softmax → ·V over the cache, with the valid
+length ``pos`` known only at runtime. The XLA fallback materializes the
+[B,H,1,Smax] score tensor in HBM; this kernel streams K/V blocks through
+VMEM with an online softmax, writing only the [B,H,D] output.
+
+Grid: one program per (batch, head). ``pos`` arrives as a scalar-prefetch
+operand so the same compiled kernel serves every decode step (no recompile
+as the cache fills); keys at positions > pos are masked, not skipped —
+compute is bounded by Smax, the usual TPU static-shape tradeoff.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+S_BLOCK = 512  # cache rows per online-softmax tile
+
+
+def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, sm_scale: float,
+                   s_max: int, s_block: int):
+    pos = pos_ref[0]
+    D = q_ref.shape[-1]
+    q = q_ref[...].reshape(1, D).astype(jnp.float32)
+    n_blocks = s_max // s_block
+
+    def body(j, carry):
+        m_prev, l_prev, acc = carry
+        k = k_ref[0, pl.dslice(j * s_block, s_block), 0, :].astype(jnp.float32)
+        v = v_ref[0, pl.dslice(j * s_block, s_block), 0, :].astype(jnp.float32)
+        s = jnp.dot(k, q.T, preferred_element_type=jnp.float32) * sm_scale  # [S,1]
+        idx = jax.lax.broadcasted_iota(jnp.int32, (s_block, 1), 0) + j * s_block
+        s = jnp.where(idx <= pos, s, -1e30)
+        m_cur = jnp.maximum(m_prev, jnp.max(s))
+        corr = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur)  # [S,1]
+        l_cur = l_prev * corr + jnp.sum(p)
+        acc = acc * corr + jnp.dot(p.T, v, preferred_element_type=jnp.float32)
+        return m_cur, l_cur, acc
+
+    init = (
+        jnp.float32(-1e30),
+        jnp.float32(0.0),
+        jnp.zeros((1, D), jnp.float32),
+    )
+    m, l, acc = jax.lax.fori_loop(0, n_blocks, body, init)
+    o_ref[...] = (acc / jnp.maximum(l, 1e-30)).reshape(o_ref.shape).astype(o_ref.dtype)
+
+
+def decode_attention(
+    q: jnp.ndarray,  # [B, H, D] current-step queries
+    k_cache: jnp.ndarray,  # [B, Smax, H, D]
+    v_cache: jnp.ndarray,  # [B, Smax, H, D]
+    pos: jnp.ndarray,  # i32: highest valid cache index (inclusive)
+    sm_scale: Optional[float] = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Single-token cached attention → [B, H, D]."""
+    B, H, D = q.shape
+    S = k_cache.shape[1]
+    s_block = S if S < S_BLOCK else S_BLOCK
+    assert S % s_block == 0, f"cache length {S} not a multiple of {s_block}"
+    scale = sm_scale if sm_scale is not None else 1.0 / (D**0.5)
+
+    kernel = functools.partial(
+        _decode_kernel, sm_scale=float(scale), s_max=S, s_block=s_block
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, H),
+            in_specs=[
+                pl.BlockSpec((1, 1, D), lambda b, h, pos: (b, h, 0)),
+                pl.BlockSpec((1, S, 1, D), lambda b, h, pos: (b, 0, h, 0)),
+                pl.BlockSpec((1, S, 1, D), lambda b, h, pos: (b, 0, h, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, D), lambda b, h, pos: (b, h, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
+        interpret=interpret,
+    )(jnp.asarray(pos, jnp.int32).reshape(1), q, k_cache, v_cache)
+    return out
+
+
+def decode_attention_ok(B: int, S: int, H: int, D: int, itemsize: int = 2) -> bool:
+    """Trace-time gate mirroring ops.attention._pallas_ok: TPU backend,
+    lane-friendly head dim, and the K+V slabs of one (batch, head) program
+    fitting the kernel's VMEM budget."""
+    from .flash_attention import VMEM_RESIDENT_BYTES
+
+    return (
+        jax.default_backend() == "tpu"
+        and D % 64 == 0
+        and (S < S_BLOCK or S % S_BLOCK == 0)
+        and 2 * S * D * itemsize <= VMEM_RESIDENT_BYTES
+    )

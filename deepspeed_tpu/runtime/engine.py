@@ -34,6 +34,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..parallel.topology import MeshSpec, mesh_axis_size
 from ..utils.logging import log_dist, logger
+from ..utils.pytree import path_str as _path_str
 from ..utils.timer import (
     STEP_GLOBAL_TIMER,
     SynchronizedWallClockTimer,
@@ -72,14 +73,6 @@ def _cast_params(params: PyTree, dtype) -> PyTree:
         return p
 
     return jax.tree.map(cast, params)
-
-
-def _path_str(path) -> str:
-    """Leaf-path key matching the codebase convention (compression/compress.py
-    _leaf_paths, quantize.py): dict keys and sequence indices joined by '/'."""
-    return "/".join(
-        str(getattr(p, "key", getattr(p, "idx", p))) for p in path
-    )
 
 
 def global_norm(tree: PyTree) -> jnp.ndarray:
@@ -734,10 +727,10 @@ class DeepSpeedEngine:
                     ids = jnp.unique(
                         tokens, size=size, fill_value=g.shape[0]
                     ).astype(jnp.int32)
-                    padded = jnp.concatenate(
-                        [g, jnp.zeros((1,) + g.shape[1:], g.dtype)], axis=0
-                    )
-                    sparse[leaf_path] = (ids, padded[ids])
+                    # fill ids (== vocab) gather-clamp to the last row; the
+                    # host-side valid mask drops those slots, so no padded
+                    # copy of the table is needed
+                    sparse[leaf_path] = (ids, g[ids])
             return loss_sum / (gas * scale), grads, gnorm, overflow, sparse
 
         return grad_step

@@ -1,0 +1,27 @@
+"""Shared pytree path naming — the ONE key-path convention.
+
+Every subsystem that names leaves by path (compression module matching,
+MoQ quantization, sparse-grad routing, zero_to_fp32 export) must produce
+identical strings for the same tree; this is the single implementation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+import jax
+
+
+def path_str(path) -> str:
+    """'/'-joined key path: dict keys, sequence indices, or named fields."""
+    return "/".join(
+        str(getattr(p, "key", getattr(p, "idx", getattr(p, "name", p))))
+        for p in path
+    )
+
+
+def leaf_paths(tree: Any) -> List[Tuple[str, Any]]:
+    return [
+        (path_str(path), leaf)
+        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]
+    ]

@@ -126,3 +126,26 @@ class TestFusedLambHardware:
         )(p, g, z, z)
         assert np.isfinite(np.asarray(p2)).all()
         assert not np.allclose(np.asarray(p2), np.asarray(p))
+
+
+class TestDecodeAttentionHardware:
+    def test_decode_kernel_compiles_and_matches(self):
+        from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
+
+        B, S, H, D = 2, 1024, 4, 64
+        rs = np.random.RandomState(6)
+        q = jnp.asarray(rs.randn(B, H, D), jnp.bfloat16)
+        k = jnp.asarray(rs.randn(B, S, H, D), jnp.bfloat16)
+        v = jnp.asarray(rs.randn(B, S, H, D), jnp.bfloat16)
+        out = jax.jit(lambda q, k, v, p: decode_attention(q, k, v, p))(
+            q, k, v, jnp.int32(700)
+        )
+        scores = jnp.einsum(
+            "bhd,bshd->bhs", q.astype(jnp.float32), k.astype(jnp.float32)
+        ) / np.sqrt(D)
+        mask = jnp.arange(S)[None, None, :] <= 700
+        probs = jax.nn.softmax(jnp.where(mask, scores, -1e30), axis=-1)
+        ref = jnp.einsum("bhs,bshd->bhd", probs, v.astype(jnp.float32))
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref), atol=2e-2, rtol=2e-2
+        )
