@@ -531,20 +531,16 @@ def _attention_cached(cfg: GPT2Config, lp, h, k_cache, v_cache, pos):
     Smax = k_cache.shape[1]
     scale = 1.0 / np.sqrt(D)
 
-    if S == 1:
-        # single-token decode: the Pallas online-softmax kernel streams the
-        # cache through VMEM instead of materializing [B,H,1,Smax] scores
-        # (reference softmax_context fused kernel)
-        from ..ops.pallas.decode_attention import decode_attention, decode_attention_ok
-        from ..utils.logging import warning_once
+    if S == 1 and cfg.attn_impl in ("auto", "pallas"):
+        # single-token decode: ops.cached_attention dispatches to the Pallas
+        # online-softmax kernel on TPU (streams the cache through VMEM
+        # instead of materializing [B,H,1,Smax] scores — the reference
+        # softmax_context fused kernel) with a jnp fallback built in
+        from ..ops.attention import cached_attention
 
-        if decode_attention_ok(B, Smax, H, D, k_cache.dtype.itemsize):
-            try:
-                o1 = decode_attention(q[:, 0], k_cache, v_cache, pos, sm_scale=scale)
-                o = o1.reshape(B, 1, E).astype(h.dtype)  # [B,H,D] -> [B,1,E]
-                return o @ _deq(lp["c_proj_w"], h.dtype) + lp["c_proj_b"], k_cache, v_cache
-            except Exception as e:  # pragma: no cover - fall back like attention.py
-                warning_once(f"pallas decode attention unavailable ({e}); using jnp path")
+        o1 = cached_attention(q[:, 0], k_cache, v_cache, pos, impl=cfg.attn_impl, sm_scale=scale)
+        o = o1.reshape(B, 1, E).astype(h.dtype)  # [B,H,D] -> [B,1,E]
+        return o @ _deq(lp["c_proj_w"], h.dtype) + lp["c_proj_b"], k_cache, v_cache
 
     scores = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32), k_cache.astype(jnp.float32)) * scale
     # query i sits at absolute position pos+i; may see keys j <= pos+i

@@ -45,6 +45,37 @@ def _pallas_ok(q) -> bool:
     return S % 128 == 0 and D % 64 == 0 and S * D * q.dtype.itemsize <= VMEM_RESIDENT_BYTES
 
 
+def cached_attention(q, k_cache, v_cache, pos, impl: str = "auto", sm_scale: Optional[float] = None):
+    """Single-token decode attention against a KV cache: q [B,H,D],
+    caches [B,Smax,H,D], pos = highest valid index → [B,H,D].
+
+    Dispatch mirrors :func:`causal_attention`: the Pallas online-softmax
+    decode kernel on TPU (reference softmax_context fused inference kernel),
+    jnp fallback elsewhere, with the same warn-and-fall-back contract.
+    """
+    B, H, D = q.shape
+    S = k_cache.shape[1]
+    if impl in ("auto", "pallas"):
+        from .pallas.decode_attention import decode_attention, decode_attention_ok
+
+        if impl == "pallas" or decode_attention_ok(S, D, k_cache.dtype.itemsize):
+            try:
+                return decode_attention(q, k_cache, v_cache, pos, sm_scale=sm_scale)
+            except Exception as e:  # pragma: no cover
+                if impl == "pallas":
+                    raise
+                warning_once(f"pallas decode attention unavailable ({e}); using jnp path")
+    elif impl != "jnp":
+        raise ValueError(f"unknown attention impl {impl}")
+    scale = sm_scale if sm_scale is not None else 1.0 / (D**0.5)
+    scores = jnp.einsum(
+        "bhd,bshd->bhs", q.astype(jnp.float32), k_cache.astype(jnp.float32)
+    ) * scale
+    mask = jnp.arange(S)[None, None, :] <= pos
+    probs = jax.nn.softmax(jnp.where(mask, scores, -1e30), axis=-1)
+    return jnp.einsum("bhs,bshd->bhd", probs, v_cache.astype(jnp.float32)).astype(q.dtype)
+
+
 def causal_attention(q, k, v, impl: str = "auto", sm_scale: Optional[float] = None):
     if impl == "jnp":
         return causal_attention_jnp(q, k, v, sm_scale)

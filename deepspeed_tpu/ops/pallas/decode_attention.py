@@ -93,10 +93,10 @@ def decode_attention(
     return out
 
 
-def decode_attention_ok(B: int, S: int, H: int, D: int, itemsize: int = 2) -> bool:
+def decode_attention_ok(S: int, D: int, itemsize: int = 2) -> bool:
     """Trace-time gate mirroring ops.attention._pallas_ok: TPU backend,
     lane-friendly head dim, and the K+V slabs of one (batch, head) program
-    fitting the kernel's VMEM budget."""
+    fitting the kernel's VMEM budget (per-program cost is B/H independent)."""
     from .flash_attention import VMEM_RESIDENT_BYTES
 
     return (

@@ -698,11 +698,22 @@ class DeepSpeedEngine:
                 grads_acc = jax.lax.with_sharding_constraint(grads_acc, grad_shardings)
                 return (grads_acc, loss_acc + loss), None
 
-            zero = jax.tree.map(lambda p: jnp.zeros(p.shape, acc_dtype), params)
-            zero = jax.lax.with_sharding_constraint(zero, grad_shardings)
-            (grads, loss_sum), _ = jax.lax.scan(
-                micro_step, (zero, jnp.float32(0.0)), jnp.arange(gas)
-            )
+            if gas == 1:
+                # single microbatch: skip the trip-count-1 scan (see
+                # _make_train_step note on fusion across the loop boundary)
+                loss_sum, grads = grad_fn(
+                    cparams, jax.tree.map(lambda x: x[0], batch),
+                    jax.random.fold_in(rng, 0), scale,
+                )
+                grads = jax.lax.with_sharding_constraint(
+                    jax.tree.map(lambda g: g.astype(acc_dtype), grads), grad_shardings
+                )
+            else:
+                zero = jax.tree.map(lambda p: jnp.zeros(p.shape, acc_dtype), params)
+                zero = jax.lax.with_sharding_constraint(zero, grad_shardings)
+                (grads, loss_sum), _ = jax.lax.scan(
+                    micro_step, (zero, jnp.float32(0.0)), jnp.arange(gas)
+                )
             inv = 1.0 / (scale * gas)
             grads = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, grads)
             overflow = ls.has_inf_or_nan(grads) if fp16 else jnp.bool_(False)
@@ -914,6 +925,20 @@ class DeepSpeedEngine:
                     jax.tree.map(lambda g: g.astype(acc_dtype), grads), grad_shardings
                 )
                 loss_sum = loss.astype(jnp.float32) * gas
+            elif gas == 1:
+                # no accumulation loop: a trip-count-1 lax.scan would wall the
+                # whole fwd+bwd behind a while-loop boundary, blocking XLA
+                # fusion with the optimizer update (and defeating overlap)
+                micro = jax.tree.map(lambda x: x[0], batch)
+                (_, (loss, _metrics)), grads = grad_fn(
+                    cparams, micro, jax.random.fold_in(rng, 0), scale, theta
+                )
+                if predivide:
+                    grads = jax.tree.map(lambda g: g / predivide_factor, grads)
+                grads = jax.lax.with_sharding_constraint(
+                    jax.tree.map(lambda g: g.astype(acc_dtype), grads), grad_shardings
+                )
+                loss_sum = loss.astype(jnp.float32)
             else:
 
                 def micro_step(carry, xs):

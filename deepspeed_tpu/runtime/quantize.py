@@ -68,10 +68,12 @@ class Quantizer:
         bits = self.bits_at(step, eigenvalue_ratio)
         if bits >= 16:
             return params
+        from ..utils.pytree import path_str
+
         flat = jax.tree_util.tree_flatten_with_path(params)[0]
         out = []
         for path, leaf in flat:
-            name = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+            name = path_str(path)
             if hasattr(leaf, "ndim") and leaf.ndim >= 2 and self._match(name):
                 out.append(quantize_weight_ste(leaf, bits, self.symmetric))
             else:

@@ -20,15 +20,9 @@ import numpy as np
 
 
 def _flatten_tree(tree, prefix=""):
-    import jax
+    from .pytree import leaf_paths
 
-    flat = {}
-    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
-        name = "/".join(
-            str(getattr(p, "key", getattr(p, "idx", getattr(p, "name", p)))) for p in path
-        )
-        flat[prefix + name] = np.asarray(leaf)
-    return flat
+    return {prefix + name: np.asarray(leaf) for name, leaf in leaf_paths(tree)}
 
 
 def convert_zero_checkpoint_to_fp32_state_dict(
