@@ -152,6 +152,21 @@ def _attention(cfg: DecoderConfig, lp, h, k_cache, v_cache, pos, layer_window):
 
     Smax = k_cache.shape[1]
     scale = cfg.attn_scale if cfg.attn_scale is not None else 1.0 / np.sqrt(D)
+
+    if S == 1 and cfg.pos_emb != "alibi" and not any(cfg.local_windows):
+        # single-token decode without score biasing: route through the
+        # decode-attention dispatch (Pallas online-softmax kernel on TPU,
+        # jnp fallback) — RoPE is already applied pre-cache so the kernel
+        # sees plain dot-product attention
+        from ..ops.attention import cached_attention
+
+        o1 = cached_attention(q[:, 0], k_cache, v_cache, pos, sm_scale=scale)
+        o = o1.reshape(B, 1, E).astype(h.dtype)
+        out = o @ lp["wo"]
+        if lp.get("bo") is not None:
+            out = out + lp["bo"]
+        return out, k_cache, v_cache
+
     scores = jnp.einsum(
         "bshd,bthd->bhst", q.astype(jnp.float32), k_cache.astype(jnp.float32)
     ) * scale
