@@ -1,0 +1,16 @@
+#!/bin/bash
+# Probe the axon TPU tunnel: tiny matmul with a hard timeout.
+# Appends one line per attempt to .tpu_probe.log; exits 0 iff compute works.
+set -o pipefail
+cd /root/repo
+ts=$(date +%H:%M:%S)
+out=$(timeout "${1:-90}" python -c "
+import time, jax, jax.numpy as jnp
+t0=time.time()
+x = jnp.ones((256,256), jnp.bfloat16)
+y = (x@x).block_until_ready()
+print('OK %.1fs' % (time.time()-t0))
+" 2>/dev/null | tail -1)
+rc=$?
+echo "$ts rc=$rc $out" >> /root/repo/.tpu_probe.log
+[ $rc -eq 0 ] && [[ "$out" == OK* ]]

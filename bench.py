@@ -66,7 +66,7 @@ def pick_model(hbm_bytes: float, seq: int):
     return "gpt2"
 
 
-def build_engine(model_name: str, seq: int, micro: int, n_dev: int, zero_stage: int):
+def build_engine(model_name: str, seq: int, micro: int, n_dev: int, zero_stage: int, remat: bool = None):
     from deepspeed_tpu.models import gpt2
     from deepspeed_tpu.parallel.topology import MeshSpec
     from deepspeed_tpu.runtime.config import DeepSpeedConfig
@@ -74,7 +74,8 @@ def build_engine(model_name: str, seq: int, micro: int, n_dev: int, zero_stage: 
 
     # remat only where activations wouldn't fit; it lengthens the (remote,
     # slow) first compile, so smaller presets skip it
-    remat = model_name in ("gpt2-large", "gpt2-xl")
+    if remat is None:
+        remat = model_name in ("gpt2-large", "gpt2-xl")
     cfg = gpt2.get_config(model_name, n_positions=seq, remat=remat)
     module = gpt2.make_module(cfg)
     mesh = MeshSpec(dp=n_dev).build_mesh()
@@ -110,6 +111,10 @@ def attn_impl_used(cfg, micro: int, seq: int) -> str:
 def main():
     import jax
 
+    from deepspeed_tpu.utils.jax_env import honor_jax_platforms
+
+    honor_jax_platforms()  # lets JAX_PLATFORMS=cpu smoke-run on TPU hosts
+
     n_dev = len(jax.devices())
     on_tpu = jax.default_backend() not in ("cpu",)
 
@@ -129,13 +134,20 @@ def main():
     if model_name == "auto":
         model_name = pick_model(hbm, seq)
 
-    # build with OOM fallback down the preset ladder
+    # build with OOM fallback: each preset tries its default remat choice,
+    # then remat=True (keeps a larger model at +33% flops instead of
+    # dropping a size), then the next-smaller preset
     tried = []
     cfg = engine = None
-    ladder = [model_name] + [c for c in CANDIDATES if CANDIDATES.index(c) > (CANDIDATES.index(model_name) if model_name in CANDIDATES else -1)]
-    for name in ladder:
+    names = [model_name] + [c for c in CANDIDATES if CANDIDATES.index(c) > (CANDIDATES.index(model_name) if model_name in CANDIDATES else -1)]
+    ladder = []
+    for c in names:
+        ladder.append((c, None))
+        if c not in ("gpt2-large", "gpt2-xl"):  # default remat already True there
+            ladder.append((c, True))
+    for name, remat in ladder:
         try:
-            cfg, engine = build_engine(name, seq, micro, n_dev, zero_stage)
+            cfg, engine = build_engine(name, seq, micro, n_dev, zero_stage, remat=remat)
             rs = np.random.RandomState(0)
             batch = {
                 "input_ids": rs.randint(
@@ -146,10 +158,10 @@ def main():
             jax.block_until_ready(m["loss"])
             model_name = name
             break
-        except Exception as e:  # OOM at compile or run: drop a size
-            tried.append(f"{name}: {type(e).__name__}")
+        except Exception as e:  # OOM at compile or run: next ladder rung
+            tried.append(f"{name}(remat={remat}): {type(e).__name__}")
             cfg = engine = None
-            if name == ladder[-1]:
+            if (name, remat) == ladder[-1]:
                 raise
     assert engine is not None, tried
 
@@ -259,6 +271,8 @@ def main():
         "flops_source": "analytic",
         "xla_flops_per_step": xla_flops,
         "attn_impl_used": attn_impl_used(cfg, micro, seq),
+        "remat": bool(cfg.remat),
+        "micro_batch": micro,
         "xl_equiv_tokens_per_sec_chip": round(xl_equiv_tok_per_sec_chip, 1),
         "loss_first_to_last": [round(first_loss, 4), round(last_loss, 4)],
     }

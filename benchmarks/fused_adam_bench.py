@@ -21,10 +21,19 @@ RESULTS (v5e, 2026-07-29, n=268435456 fp32):
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
 import jax
+
+# runnable as a standalone script from anywhere in the repo
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepspeed_tpu.utils.jax_env import honor_jax_platforms
+
+honor_jax_platforms()
+
 import jax.numpy as jnp
 import optax
 
@@ -42,7 +51,11 @@ def bench(fn, args, iters=20):
 
 
 def main():
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 256 * 1024 * 1024  # 256M fp32
+    on_tpu = jax.default_backend() == "tpu"
+    # CPU smoke: tiny shard + interpret-mode kernel (timings meaningless
+    # there; the measurement this bench records is the TPU one)
+    default_n = 256 * 1024 * 1024 if on_tpu else 64 * 1024
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else default_n
     key = jax.random.PRNGKey(0)
     p = jax.random.normal(key, (n,), jnp.float32)
     g = jax.random.normal(key, (n,), jnp.float32) * 1e-3
@@ -59,7 +72,10 @@ def main():
 
     @jax.jit
     def pallas_step(p, g, m, v):
-        return fused_adamw_flat(p, g, m, v, jnp.int32(1), 1e-3, weight_decay=0.01)
+        return fused_adamw_flat(
+            p, g, m, v, jnp.int32(1), 1e-3, weight_decay=0.01,
+            interpret=not on_tpu,
+        )
 
     t_optax = bench(optax_step, (p, g, state))
     t_pallas = bench(pallas_step, (p, g, m, v))

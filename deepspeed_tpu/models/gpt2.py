@@ -54,7 +54,12 @@ class GPT2Config:
     # and/or offload it to pinned host RAM between forward and backward
     partition_activations: bool = False
     cpu_checkpointing: bool = False
-    attn_impl: str = "auto"  # auto | pallas | jnp | ring | ulysses
+    attn_impl: str = "auto"  # auto | pallas | jnp | ring | ulysses | sparse
+    # for attn_impl="sparse": a SparsityConfig instance (or None → Fixed
+    # defaults). Built from the engine config's ``sparse_attention`` section
+    # via ops.sparse_attention.from_ds_config (reference
+    # get_sparse_attention_config, deepspeed/__init__.py)
+    sparsity: Any = None
     # mesh is required for the sequence-parallel attention impls ("ring",
     # "ulysses") — they shard_map over its sp axis (parallel/sequence.py)
     mesh: Any = None
@@ -93,6 +98,17 @@ PRESETS: Dict[str, Dict] = {
 def get_config(name: str, **overrides) -> GPT2Config:
     base = dict(PRESETS[name])
     base.update(overrides)
+    # the engine config's ``sparse_attention`` section (dict or typed) turns
+    # on the block-sparse kernel with the requested pattern (reference
+    # get_sparse_attention_config consumption in client models)
+    section = base.pop("sparse_attention", None)
+    if section is not None:
+        from ..ops.sparse_attention import from_ds_config
+
+        # an explicit attn_impl override wins (e.g. attn_impl="jnp" to A/B
+        # the dense path with the section still present)
+        base.setdefault("attn_impl", "sparse")
+        base["sparsity"] = from_ds_config(section, base.get("n_head", 12))
     return GPT2Config(**base)
 
 
@@ -221,6 +237,11 @@ def _attention(cfg: GPT2Config, lp, h, train: bool, rng=None):
 
         assert cfg.mesh is not None, f"attn_impl={cfg.attn_impl} requires cfg.mesh"
         o = sequence_parallel_attention(q, k_, v, cfg.mesh, impl=cfg.attn_impl)
+    elif cfg.attn_impl == "sparse":
+        from ..ops.sparse_attention import FixedSparsityConfig, sparse_attention
+
+        sp = cfg.sparsity or FixedSparsityConfig(num_heads=H)
+        o = sparse_attention(q, k_, v, sp, causal=True)
     else:
         from ..ops.attention import causal_attention
 

@@ -24,6 +24,22 @@ class AsyncIOHandle:
     thread_count — deepspeed_py_aio_handle.h:12 region).
     """
 
+    @classmethod
+    def from_config(cls, aio_cfg) -> Optional["AsyncIOHandle"]:
+        """Build a handle from the ``aio`` config section (reference
+        swap_tensor/aio_config.py), or return None when ``aio_cfg`` is None
+        (callers then get each swapper's default handle).
+        ``single_submit``/``overlap_events`` tune the reference's libaio
+        submission batching; the thread-pool design here has no equivalent
+        modes, so they are accepted and ignored."""
+        if aio_cfg is None:
+            return None
+        return cls(
+            block_size=int(aio_cfg.block_size),
+            queue_depth=int(aio_cfg.queue_depth),
+            thread_count=int(aio_cfg.thread_count),
+        )
+
     def __init__(self, block_size: int = 1 << 20, queue_depth: int = 32,
                  thread_count: int = 8):
         self._lib = AsyncIOBuilder().load()

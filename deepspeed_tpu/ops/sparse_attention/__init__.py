@@ -6,6 +6,7 @@ from .sparsity_config import (
     FixedSparsityConfig,
     SparsityConfig,
     VariableSparsityConfig,
+    from_ds_config,
     layout_density,
     layout_to_dense_mask,
 )
@@ -18,6 +19,7 @@ __all__ = [
     "SparseSelfAttention",
     "SparsityConfig",
     "VariableSparsityConfig",
+    "from_ds_config",
     "layout_density",
     "layout_to_dense_mask",
     "sparse_attention",

@@ -288,3 +288,59 @@ def layout_to_dense_mask(layout: np.ndarray, block: int) -> np.ndarray:
 
 def layout_density(layout: np.ndarray) -> float:
     return float(layout.mean())
+
+
+def from_ds_config(section, num_heads: int) -> SparsityConfig:
+    """Map the ``sparse_attention`` config section (runtime/config.py
+    SparseAttentionConfig; reference ``get_sparse_attention_config``,
+    deepspeed/__init__.py + ops/sparse_attention) to a SparsityConfig.
+
+    ``section`` may be the typed dataclass or a plain dict with the DS JSON
+    keys (``mode`` selects the pattern class; remaining keys are that
+    pattern's constructor args)."""
+    get = section.get if isinstance(section, dict) else lambda k, d=None: getattr(section, k, d)
+    mode = (get("mode", "fixed") or "fixed").lower()
+    common = dict(
+        num_heads=num_heads,
+        block=int(get("block", 16)),
+        different_layout_per_head=bool(get("different_layout_per_head", False)),
+    )
+    if mode == "dense":
+        return DenseSparsityConfig(**common)
+    if mode == "fixed":
+        return FixedSparsityConfig(
+            **common,
+            num_local_blocks=int(get("num_local_blocks", 4)),
+            num_global_blocks=int(get("num_global_blocks", 1)),
+            attention=get("attention", "bidirectional"),
+            horizontal_global_attention=bool(get("horizontal_global_attention", False)),
+            num_different_global_patterns=int(get("num_different_global_patterns", 1)),
+        )
+    nrb = get("num_random_blocks", None)  # None = mode-specific default
+    if mode == "bigbird":
+        return BigBirdSparsityConfig(
+            **common,
+            num_random_blocks=1 if nrb is None else int(nrb),
+            num_sliding_window_blocks=int(get("num_sliding_window_blocks", 3)),
+            num_global_blocks=int(get("num_global_blocks", 1)),
+            attention=get("attention", "bidirectional"),
+        )
+    if mode == "bslongformer":
+        return BSLongformerSparsityConfig(
+            **common,
+            num_sliding_window_blocks=int(get("num_sliding_window_blocks", 3)),
+            global_block_indices=get("global_block_indices", [0]) or [0],
+            global_block_end_indices=get("global_block_end_indices", None),
+            attention=get("attention", "bidirectional"),
+        )
+    if mode == "variable":
+        return VariableSparsityConfig(
+            **common,
+            num_random_blocks=0 if nrb is None else int(nrb),
+            local_window_blocks=get("local_window_blocks", [4]) or [4],
+            global_block_indices=get("global_block_indices", [0]) or [0],
+            global_block_end_indices=get("global_block_end_indices", None),
+            attention=get("attention", "bidirectional"),
+            horizontal_global_attention=bool(get("horizontal_global_attention", False)),
+        )
+    raise ValueError(f"unknown sparse_attention mode {mode!r}")

@@ -59,7 +59,17 @@ class BF16Config(DSConfigModel):
 
 @dataclass
 class OffloadDeviceConfig(DSConfigModel):
-    """zero_optimization.offload_{param,optimizer} (reference zero/offload_config.py)."""
+    """zero_optimization.offload_{param,optimizer} (reference zero/offload_config.py).
+
+    ``device``/``nvme_path`` drive the host/NVMe tier engines. The rest are
+    accepted for DS-JSON compatibility but subsumed here: ``pin_memory`` is a
+    CUDA staging concept (TPU-VM host DMA needs no pinned pool);
+    ``buffer_count``/``buffer_size``/``max_in_cpu`` tune the reference's
+    fixed swap-buffer pool, replaced by leaf-aligned subgroup buffers sized
+    by ``zero_optimization.sub_group_size``; ``pipeline_read``/
+    ``pipeline_write`` are always-on (PipelinedOptimizerSwapper overlaps
+    both directions unconditionally); ``fast_init``/``ratio`` tune
+    reference-specific init paths that do not exist here."""
 
     device: str = "none"  # none | cpu | nvme
     nvme_path: str = "/local_nvme"
@@ -75,7 +85,19 @@ class OffloadDeviceConfig(DSConfigModel):
 
 @dataclass
 class ZeroConfig(DSConfigModel):
-    """zero_optimization section (reference zero/config.py)."""
+    """zero_optimization section (reference zero/config.py).
+
+    Accepted-for-compatibility, subsumed-by-XLA keys (reference tunes its
+    hand-rolled NCCL pipeline with them; here sharding constraints make XLA
+    emit and schedule the collectives, so they have no effect):
+    ``contiguous_gradients``, ``reduce_scatter``, ``reduce_bucket_size``,
+    ``allgather_partitions``, ``allgather_bucket_size``, ``overlap_comm``,
+    ``stage3_max_live_parameters``, ``stage3_max_reuse_distance``,
+    ``stage3_prefetch_bucket_size`` (XLA latency-hiding scheduler decides
+    prefetch depth), ``round_robin_gradients``, ``zero_hpz_partition_size``.
+    ``sub_group_size`` and the offload sub-configs ARE consumed by the
+    host-tier engines (offload/infinity); ``stage3_param_persistence_threshold``
+    by the Infinity block streamer."""
 
     stage: int = 0
     contiguous_gradients: bool = True
@@ -154,11 +176,16 @@ class FlopsProfilerConfig(DSConfigModel):
 
 @dataclass
 class AIOConfig(DSConfigModel):
-    """aio section (reference swap_tensor/aio_config.py)."""
+    """aio section (reference swap_tensor/aio_config.py).
+
+    Defaults deviate from the reference's (queue_depth=8, thread_count=1):
+    the reference assumes kernel async I/O (libaio), where one submission
+    thread suffices; this runtime's handle is a C++ thread pool
+    (csrc/aio), so the defaults match AsyncIOHandle's pool sizing."""
 
     block_size: int = 1048576
-    queue_depth: int = 8
-    thread_count: int = 1
+    queue_depth: int = 32
+    thread_count: int = 8
     single_submit: bool = False
     overlap_events: bool = True
 
@@ -239,11 +266,14 @@ class SparseAttentionConfig(DSConfigModel):
     attention: str = "bidirectional"
     horizontal_global_attention: bool = False
     num_different_global_patterns: int = 1
-    num_random_blocks: int = 0
+    # None = mode-specific default (bigbird: 1, variable: 0) resolved by
+    # ops.sparse_attention.from_ds_config — the single source of truth for
+    # per-pattern defaults
+    num_random_blocks: Optional[int] = None
+    num_sliding_window_blocks: int = 3
     local_window_blocks: List[int] = field(default_factory=lambda: [4])
     global_block_indices: List[int] = field(default_factory=lambda: [0])
     global_block_end_indices: Optional[List[int]] = None
-    num_sliding_window_blocks: int = 3
 
 
 @dataclass

@@ -254,6 +254,7 @@ class DeepSpeedEngine:
             seed=seed,
             initial_params=params,
             trace_validator=trace_validator,
+            aio_config=config.aio,
         )
         self.offload_enabled = False
         self._offload = None
@@ -347,6 +348,7 @@ class DeepSpeedEngine:
                 nvme_path=off.nvme_path,
                 sub_group_size=int(zcfg.sub_group_size),
                 adamw_mode=bool(p.get("adam_w_mode", True)),
+                aio_config=config.aio,
             )
             # device keeps only the compute-dtype copy; the fp32 master +
             # moments live host-side (HBM cost drops from 16 to 2 B/param)
@@ -1254,6 +1256,12 @@ class DeepSpeedEngine:
 
     def get_lr(self) -> float:
         return float(jax.device_get(jnp.asarray(self.lr_schedule(self.state.global_step))))
+
+    def sparse_attention_config(self):
+        """The ``sparse_attention`` config section, for client models to feed
+        ``ops.sparse_attention.from_ds_config`` / ``gpt2.get_config``
+        (reference DeepSpeedEngine.sparse_attention_config)."""
+        return self.config.sparse_attention
 
     def zero_optimization(self) -> bool:
         return self.zero_stage > 0

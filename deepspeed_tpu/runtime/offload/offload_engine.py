@@ -61,6 +61,7 @@ class HostOffloadOptimizer:
         nvme_path: str = "/tmp/ds_tpu_nvme",
         sub_group_size: int = 1_000_000_000,
         adamw_mode: bool = True,
+        aio_config=None,
     ):
         assert device in ("cpu", "nvme"), device
         self.device = device
@@ -103,8 +104,14 @@ class HostOffloadOptimizer:
         self.swapper: Optional[PipelinedOptimizerSwapper] = None
         self._masters: List[Optional[np.ndarray]] = [None] * len(self._groups)
         if device == "nvme":
+            from ...ops.aio import AsyncIOHandle
+
+            # per-stream C++ thread pool sized by the ``aio`` config
+            # section (reference aio_config.py knobs)
             self.swapper = PipelinedOptimizerSwapper(
-                os.path.join(nvme_path, "zero_infinity"), n_tensors=3
+                os.path.join(nvme_path, "zero_infinity"), n_tensors=3,
+                read_handle=AsyncIOHandle.from_config(aio_config),
+                write_handle=AsyncIOHandle.from_config(aio_config),
             )
             for gid in range(len(self._groups)):
                 chunk = group_flat(gid)

@@ -134,6 +134,7 @@ class InfinityEngine:
         seed: int = 0,
         initial_params: Optional[PyTree] = None,
         trace_validator=None,
+        aio_config=None,
     ):
         assert device in ("cpu", "nvme"), device
         assert opt_device in ("cpu", "nvme"), opt_device
@@ -191,20 +192,27 @@ class InfinityEngine:
         if device == "nvme" or opt_device == "nvme":
             os.makedirs(nvme_path, exist_ok=True)
         if device == "nvme":
+            from ...ops.aio import AsyncIOHandle
             from ..swap_tensor.partitioned_param_swapper import (
                 AsyncPartitionedParameterSwapper,
             )
 
+            # each swapper/stream gets its own C++ thread pool sized by the
+            # ``aio`` config section (reference aio_config.py knobs)
             self._param_swapper = AsyncPartitionedParameterSwapper(
-                os.path.join(nvme_path, "infinity"), dtype=_BF16
+                os.path.join(nvme_path, "infinity"), dtype=_BF16,
+                aio_handle=AsyncIOHandle.from_config(aio_config),
             )
         if opt_device == "nvme":
+            from ...ops.aio import AsyncIOHandle
             from ..swap_tensor.partitioned_optimizer_swapper import (
                 PipelinedOptimizerSwapper,
             )
 
             self._opt_swapper = PipelinedOptimizerSwapper(
-                os.path.join(nvme_path, "infinity_opt"), n_tensors=3
+                os.path.join(nvme_path, "infinity_opt"), n_tensors=3,
+                read_handle=AsyncIOHandle.from_config(aio_config),
+                write_handle=AsyncIOHandle.from_config(aio_config),
             )
 
         for i in range(L):

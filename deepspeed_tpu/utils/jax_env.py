@@ -1,0 +1,26 @@
+"""Host-side platform selection helper.
+
+Environments that register an accelerator PJRT plugin from ``sitecustomize``
+may force their platform via ``jax.config`` at interpreter start, which
+silently overrides a ``JAX_PLATFORMS`` env var set by the caller. Host-side
+entry points (ds_report, checkpoint tools, CPU benches) call
+:func:`honor_jax_platforms` so an explicit ``JAX_PLATFORMS=cpu`` always wins
+and the tool never hangs probing an unreachable accelerator.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_jax_platforms() -> None:
+    """Re-assert the ``JAX_PLATFORMS`` env var over any plugin override.
+
+    No-op when the env var is unset or jax backends are already initialized
+    (too late to change selection)."""
+    val = os.environ.get("JAX_PLATFORMS")
+    if not val:
+        return
+    import jax
+
+    jax.config.update("jax_platforms", val)

@@ -369,3 +369,40 @@ class TestSparseGradRouting:
         assert not np.allclose(before[0], after[0])  # touched row moved
         # untouched rows exactly unchanged (zero grad, zero moments, no wd)
         np.testing.assert_array_equal(before[1:], after[1:])
+
+
+class TestAIOConfigPlumbing:
+    """The ``aio`` config section reaches the NVMe swapper thread pools
+    (reference aio_config.py -> AsyncIOBuilder handle args)."""
+
+    def test_host_offload_uses_aio_config(self, tmp_path):
+        from deepspeed_tpu.runtime.config import AIOConfig
+        from deepspeed_tpu.runtime.offload import HostOffloadOptimizer
+
+        params = {"w": jnp.ones(300, jnp.float32)}
+        cfg = AIOConfig(block_size=1 << 16, queue_depth=4, thread_count=2)
+        opt = HostOffloadOptimizer(
+            params, 1e-2, device="nvme", nvme_path=str(tmp_path),
+            sub_group_size=128, aio_config=cfg,
+        )
+        for h in (opt.swapper.handle, opt.swapper.write_handle):
+            assert (h.block_size, h.queue_depth, h.thread_count) == (1 << 16, 4, 2)
+        # still steps correctly with the custom pool
+        out = opt.step({"w": jnp.full(300, 0.5, jnp.float32)}, 0,
+                       compute_dtype=jnp.float32)
+        assert np.isfinite(np.asarray(out["w"])).all()
+
+    def test_default_aio_config_keeps_handle_defaults(self, tmp_path):
+        """Without an explicit aio section the engine-path pools match
+        AsyncIOHandle's own defaults (no silent bandwidth regression)."""
+        from deepspeed_tpu.ops.aio import AsyncIOHandle
+        from deepspeed_tpu.runtime.config import AIOConfig
+        from deepspeed_tpu.runtime.offload import HostOffloadOptimizer
+
+        default = AsyncIOHandle()
+        opt = HostOffloadOptimizer(
+            {"w": jnp.ones(300, jnp.float32)}, 1e-2, device="nvme",
+            nvme_path=str(tmp_path), sub_group_size=128, aio_config=AIOConfig(),
+        )
+        for h in (opt.swapper.handle, opt.swapper.write_handle):
+            assert (h.queue_depth, h.thread_count) == (default.queue_depth, default.thread_count)
