@@ -1,8 +1,9 @@
 #!/bin/bash
-# Probe the axon TPU tunnel: tiny matmul with a hard timeout.
-# Appends one line per attempt to .tpu_probe.log; exits 0 iff compute works.
+# Probe the TPU tunnel: tiny matmul with a hard timeout.
+# Appends one line per attempt to .tpu_probe.log next to this script;
+# exits 0 iff compute works.
 set -o pipefail
-cd /root/repo
+here="$(cd "$(dirname "$0")" && pwd)"
 ts=$(date +%H:%M:%S)
 out=$(timeout "${1:-90}" python -c "
 import time, jax, jax.numpy as jnp
@@ -12,5 +13,5 @@ y = (x@x).block_until_ready()
 print('OK %.1fs' % (time.time()-t0))
 " 2>/dev/null | tail -1)
 rc=$?
-echo "$ts rc=$rc $out" >> /root/repo/.tpu_probe.log
+echo "$ts rc=$rc $out" >> "$here/.tpu_probe.log"
 [ $rc -eq 0 ] && [[ "$out" == OK* ]]

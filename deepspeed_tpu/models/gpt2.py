@@ -54,7 +54,7 @@ class GPT2Config:
     # and/or offload it to pinned host RAM between forward and backward
     partition_activations: bool = False
     cpu_checkpointing: bool = False
-    attn_impl: str = "auto"  # auto | pallas | jnp | ring | ulysses | sparse
+    attn_impl: str = "auto"  # auto | pallas | jnp | ring | ring_flash | ulysses | sparse
     # for attn_impl="sparse": a SparsityConfig instance (or None → Fixed
     # defaults). Built from the engine config's ``sparse_attention`` section
     # via ops.sparse_attention.from_ds_config (reference
@@ -232,7 +232,7 @@ def _attention(cfg: GPT2Config, lp, h, train: bool, rng=None):
 
     q, k_, v = heads(q), heads(k_), heads(v)
 
-    if cfg.attn_impl in ("ring", "ulysses"):
+    if cfg.attn_impl in ("ring", "ring_flash", "ulysses"):
         from ..parallel.sequence import sequence_parallel_attention
 
         assert cfg.mesh is not None, f"attn_impl={cfg.attn_impl} requires cfg.mesh"

@@ -1,0 +1,193 @@
+"""Ring flash attention: sequence-parallel exact attention whose per-block
+compute runs the Pallas flash kernels.
+
+The jnp ring (``parallel/sequence.py::_ring_attention_local``) materializes a
+[B, H, S_loc, S_loc] probability block per ring step in XLA; this module does
+the same ring schedule but each block runs the VMEM-resident online-softmax
+kernels from ``flash_attention.py``, so HBM traffic per step is O(S_loc·D)
+instead of O(S_loc²). Capability analog of the reference's fused attention
+kernels (csrc/transformer softmax/attention fusions) composed with its
+sequence-parallel goal; the schedule follows the public Ring Attention
+construction (blockwise attention with K/V rotating over the ring,
+PAPERS.md) — merging per-block outputs by their logsumexp.
+
+Gradients are exact: the whole ring is one ``jax.custom_vjp``. Backward is a
+second ring pass — dK/dV accumulators travel WITH their K/V block around the
+ring and arrive home after n steps, the ``ppermute`` analog of the
+reference's gradient reduce in sequence parallelism. Per-block dq/dk/dv use
+the flash backward kernels with the GLOBAL logsumexp/delta, which is the
+flash recomputation identity (p = exp(s - lse_global) is each block's true
+probability slice).
+
+Layout: per-device [B, S_loc, H, D]; runs under ``shard_map`` over the sp
+axis. S_loc must be a multiple of 128 and the received K/V block must fit
+the kernel's VMEM budget (else callers keep the jnp ring).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .flash_attention import NUM_LANES, VMEM_RESIDENT_BYTES, _bwd, _fwd
+
+NEG_BIG = -1e30
+
+
+def ring_flash_ok(s_loc: int, d: int, itemsize: int) -> bool:
+    """Same constraints as the single-device kernel, per sequence shard."""
+    return s_loc % 128 == 0 and d % 64 == 0 and s_loc * d * itemsize <= VMEM_RESIDENT_BYTES
+
+
+def _merge(u, m, l, o_j, lse_j):
+    """Online logsumexp merge of one block's (normalized o_j, lse_j) into the
+    running (unnormalized u at max m, mass l) accumulators."""
+    m_new = jnp.maximum(m, lse_j)
+    m_safe = jnp.where(m_new <= NEG_BIG / 2, 0.0, m_new)
+    alpha = jnp.where(m <= NEG_BIG / 2, 0.0, jnp.exp(m - m_safe))
+    w = jnp.where(lse_j <= NEG_BIG / 2, 0.0, jnp.exp(lse_j - m_safe))
+    u = u * alpha[..., None] + o_j.astype(jnp.float32) * w[..., None]
+    l = l * alpha + w
+    return u, m_new, l
+
+
+def _ring_fwd_loop(q3, k3, v3, axis_name, sm_scale, causal, interpret):
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    BH, S, D = q3.shape
+    perm = [(j, (j - 1) % n) for j in range(n)]
+
+    u0 = jnp.zeros((BH, S, D), jnp.float32)
+    m0 = jnp.full((BH, S), NEG_BIG, jnp.float32)
+    l0 = jnp.zeros((BH, S), jnp.float32)
+
+    def diag(kb, vb):
+        o, lse = _fwd(q3, kb, vb, sm_scale, True, interpret)
+        return o, lse[..., 0]
+
+    def full(kb, vb):
+        o, lse = _fwd(q3, kb, vb, sm_scale, False, interpret)
+        return o, lse[..., 0]
+
+    def masked(kb, vb):
+        return jnp.zeros_like(q3), jnp.full((BH, S), NEG_BIG, jnp.float32)
+
+    def step(carry, j):
+        u, m, l, kb, vb = carry
+        src = (idx + j) % n
+        if causal:
+            # src == idx: the diagonal block (causal mask); src < idx: fully
+            # visible; src > idx: fully masked — skipped (the cond's cost
+            # asymmetry cannot shorten the ring step, but it saves the HBM
+            # reads/flops of a guaranteed-zero block)
+            branch = jnp.where(src == idx, 0, jnp.where(src < idx, 1, 2))
+            o_j, lse_j = lax.switch(branch, [diag, full, masked], kb, vb)
+        else:
+            o_j, lse_j = full(kb, vb)
+        u, m, l = _merge(u, m, l, o_j, lse_j)
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        return (u, m, l, kb, vb), None
+
+    (u, m, l, _, _), _ = lax.scan(step, (u0, m0, l0, k3, v3), jnp.arange(n))
+    l_safe = jnp.maximum(l, 1e-30)
+    o = (u / l_safe[..., None]).astype(q3.dtype)
+    lse = m + jnp.log(l_safe)  # [BH, S]
+    return o, lse
+
+
+def _ring_bwd_loop(q3, k3, v3, o3, lse, do3, axis_name, sm_scale, causal, interpret):
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    BH, S, D = q3.shape
+    perm = [(j, (j - 1) % n) for j in range(n)]
+    lse_b = jnp.broadcast_to(lse[..., None], (BH, S, NUM_LANES))
+
+    def diag(kb, vb):
+        return _bwd(q3, kb, vb, o3, lse_b, do3, sm_scale, True, interpret)
+
+    def full(kb, vb):
+        return _bwd(q3, kb, vb, o3, lse_b, do3, sm_scale, False, interpret)
+
+    def masked(kb, vb):
+        z = jnp.zeros_like(q3)
+        return z, z, z
+
+    def step(carry, j):
+        dq, kb, vb, dkb, dvb = carry
+        src = (idx + j) % n
+        if causal:
+            branch = jnp.where(src == idx, 0, jnp.where(src < idx, 1, 2))
+            dq_j, dk_j, dv_j = lax.switch(branch, [diag, full, masked], kb, vb)
+        else:
+            dq_j, dk_j, dv_j = full(kb, vb)
+        dq = dq + dq_j.astype(jnp.float32)
+        # the block's grad accumulators ride the ring WITH the block and
+        # arrive back at the owner after n steps (p2p grad reduce analog)
+        dkb = dkb + dk_j.astype(jnp.float32)
+        dvb = dvb + dv_j.astype(jnp.float32)
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        dkb = lax.ppermute(dkb, axis_name, perm)
+        dvb = lax.ppermute(dvb, axis_name, perm)
+        return (dq, kb, vb, dkb, dvb), None
+
+    dq0 = jnp.zeros((BH, S, D), jnp.float32)
+    z0 = jnp.zeros((BH, S, D), jnp.float32)
+    (dq, _, _, dk, dv), _ = lax.scan(
+        step, (dq0, k3, v3, z0, z0), jnp.arange(n)
+    )
+    return dq.astype(q3.dtype), dk.astype(k3.dtype), dv.astype(v3.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_flash(q3, k3, v3, axis_name, sm_scale, causal, interpret):
+    o, _ = _ring_fwd_loop(q3, k3, v3, axis_name, sm_scale, causal, interpret)
+    return o
+
+
+def _ring_flash_fwd_rule(q3, k3, v3, axis_name, sm_scale, causal, interpret):
+    o, lse = _ring_fwd_loop(q3, k3, v3, axis_name, sm_scale, causal, interpret)
+    return o, (q3, k3, v3, o, lse)
+
+
+def _ring_flash_bwd_rule(axis_name, sm_scale, causal, interpret, res, do3):
+    q3, k3, v3, o3, lse = res
+    return _ring_bwd_loop(
+        q3, k3, v3, o3, lse, do3, axis_name, sm_scale, causal, interpret
+    )
+
+
+_ring_flash.defvjp(_ring_flash_fwd_rule, _ring_flash_bwd_rule)
+
+
+def ring_flash_attention(
+    q,
+    k,
+    v,
+    axis_name: str,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    interpret: bool = False,
+):
+    """Per-device entry (call under shard_map): q/k/v [B, S_loc, H, D] →
+    [B, S_loc, H, D], attending over the full ring-distributed sequence."""
+    B, S, H, D = q.shape
+    if not ring_flash_ok(S, D, q.dtype.itemsize):
+        raise ValueError(
+            f"ring flash needs S_loc % 128 == 0, D % 64 == 0 and a VMEM-"
+            f"resident block (got S_loc={S}, D={D})"
+        )
+    scale = float(sm_scale) if sm_scale is not None else 1.0 / (D**0.5)
+
+    def to3(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+
+    o3 = _ring_flash(
+        to3(q), to3(k), to3(v), axis_name, scale, bool(causal), bool(interpret)
+    )
+    return o3.reshape(B, H, S, D).transpose(0, 2, 1, 3)

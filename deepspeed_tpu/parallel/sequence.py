@@ -65,6 +65,11 @@ def _ring_attention_local(q, k, v, *, axis_name: str, sm_scale: Optional[float],
     # blocks move "backwards" around the ring so device idx sees src idx, idx+1, …
     perm = [(j, (j - 1) % n) for j in range(n)]
 
+    # remat: without it, backward through the scan stores every ring step's
+    # [B,H,S_loc,S_loc] probability block (O(n·S_loc²) residuals — the full
+    # attention matrix, defeating the point of ring attention). Recomputing
+    # one block pair per step bounds residuals to the carries.
+    @jax.checkpoint
     def step(carry, step_i):
         o, m, l, k_blk, v_blk = carry
         src = (idx + step_i) % n
@@ -136,12 +141,19 @@ def _ulysses_local(q, k, v, *, axis_name: str, sm_scale: Optional[float], causal
 
         o = causal_attention(q, k, v, sm_scale=sm_scale)
     else:
-        scale = sm_scale if sm_scale is not None else 1.0 / (D**0.5)
-        logits = jnp.einsum(
-            "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
-        ) * scale
-        probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
-        o = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        from ..ops.attention import _pallas_ok
+
+        if _pallas_ok(q):
+            from ..ops.pallas.flash_attention import flash_attention
+
+            o = flash_attention(q, k, v, causal=False, sm_scale=sm_scale)
+        else:
+            scale = sm_scale if sm_scale is not None else 1.0 / (D**0.5)
+            logits = jnp.einsum(
+                "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+            ) * scale
+            probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+            o = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
     return heads_to_seq(o)
 
 
@@ -154,20 +166,26 @@ def sequence_parallel_attention(
     k,
     v,
     mesh: Mesh,
-    impl: str = "ring",  # "ring" | "ulysses"
+    impl: str = "ring",  # "ring" | "ring_flash" | "ulysses"
     causal: bool = True,
     sm_scale: Optional[float] = None,
     dp_axis: str = "dp",
     sp_axis: str = "sp",
     tp_axis: str = "tp",
+    interpret: bool = False,
 ):
     """Sequence-parallel exact attention over a named mesh.
 
     Inputs [B, S, H, D] logically; S sharded over ``sp_axis``, B over
     ``dp_axis``, H over ``tp_axis`` (any axis absent from the mesh degrades to
     replicated). Output has the same sharding as q.
+
+    ``impl="ring"`` auto-upgrades each ring step's blockwise compute to the
+    Pallas flash kernels on TPU when the shard shapes allow
+    (ops/pallas/ring_flash_attention.py); ``"ring_flash"`` forces that path
+    (with ``interpret=True`` it runs on CPU for tests).
     """
-    if impl not in ("ring", "ulysses"):
+    if impl not in ("ring", "ring_flash", "ulysses"):
         raise ValueError(f"unknown sequence-parallel impl {impl}")
     if mesh.shape.get("pp", 1) > 1 and mesh.shape.get(sp_axis, 1) > 1:
         raise NotImplementedError(
@@ -197,9 +215,28 @@ def sequence_parallel_attention(
             "falling back to ring attention"
         )
         impl = "ring"
+    if impl == "ring":
+        # auto-upgrade the ring's inner blockwise compute to the flash
+        # kernels when each device's received K/V block satisfies the
+        # kernel's constraints (VMEM-resident, MXU-tile-aligned)
+        from ..ops.pallas.ring_flash_attention import ring_flash_ok
+
+        s_loc = q.shape[1] // sp_size
+        if jax.default_backend() == "tpu" and ring_flash_ok(
+            s_loc, q.shape[3], q.dtype.itemsize
+        ):
+            impl = "ring_flash"
     spec = P(dp, sp, tp, None)
-    local = _ring_attention_local if impl == "ring" else _ulysses_local
-    fn = functools.partial(local, axis_name=sp, sm_scale=sm_scale, causal=causal)
+    if impl == "ring_flash":
+        from ..ops.pallas.ring_flash_attention import ring_flash_attention
+
+        fn = functools.partial(
+            ring_flash_attention, axis_name=sp, sm_scale=sm_scale,
+            causal=causal, interpret=interpret,
+        )
+    else:
+        local = _ring_attention_local if impl == "ring" else _ulysses_local
+        fn = functools.partial(local, axis_name=sp, sm_scale=sm_scale, causal=causal)
     return shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
     )(q, k, v)
