@@ -37,12 +37,14 @@ def _pallas_ok(q) -> bool:
     B, S, H, D = q.shape
     if jax.default_backend() not in ("tpu",):
         return False
-    from .pallas.flash_attention import VMEM_RESIDENT_BYTES
-
     # kernel tiling constraints: seq multiple of block, head_dim lane-friendly
     # (D=64 is lane-padded by Mosaic — still profitable vs materializing [S,S]);
-    # whole-K/V-in-VMEM design bounds the per-device sequence length
-    return S % 128 == 0 and D % 64 == 0 and S * D * q.dtype.itemsize <= VMEM_RESIDENT_BYTES
+    # whole-K/V-in-VMEM design bounds the per-device sequence length. The
+    # predicate itself lives in ring_flash_ok so the single-device and ring
+    # dispatchers can never disagree.
+    from .pallas.ring_flash_attention import ring_flash_ok
+
+    return ring_flash_ok(S, D, q.dtype.itemsize)
 
 
 def cached_attention(q, k_cache, v_cache, pos, impl: str = "auto", sm_scale: Optional[float] = None):

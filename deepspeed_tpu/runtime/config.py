@@ -205,6 +205,15 @@ class OptimizerConfig(DSConfigModel):
 
 @dataclass
 class CheckpointConfig(DSConfigModel):
+    """checkpoint section (reference runtime/config.py checkpoint keys).
+
+    ``tag_validation`` and ``async_save`` are consumed by the engine.
+    Subsumed-by-design keys: ``load_universal`` (every restore here is
+    universal — orbax/tensorstore checkpoints reshape across dp/tp/pp meshes
+    unconditionally, checkpoint/engine.py); ``parallel_write`` (tensorstore
+    writes shards concurrently by default); ``use_node_local_storage``
+    (single-controller saves have no per-node staging step)."""
+
     tag_validation: str = "Warn"  # Ignore | Warn | Fail
     load_universal: bool = False
     use_node_local_storage: bool = False

@@ -30,6 +30,18 @@ FIXED_DISCRETE = "fixed_discrete"
 class CurriculumScheduler:
     def __init__(self, config: Any):
         # accept either the typed CurriculumConfig or a raw dict
+        ctype = (
+            config.get("curriculum_type", "seqlen")
+            if isinstance(config, dict)
+            else getattr(config, "curriculum_type", "seqlen")
+        )
+        if ctype != "seqlen":
+            # the reference snapshot's curriculum is seqlen-based too
+            # (curriculum_scheduler.py); fail loud rather than schedule a
+            # difficulty nothing consumes
+            raise ValueError(
+                f"curriculum_type {ctype!r} is not supported (only 'seqlen')"
+            )
         if isinstance(config, dict):
             self.min_difficulty = int(config.get("min_difficulty", 8))
             self.max_difficulty = int(config.get("max_difficulty", 1024))

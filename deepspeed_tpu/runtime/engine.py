@@ -415,6 +415,20 @@ class DeepSpeedEngine:
                 gamma=config.progressive_layer_drop.gamma,
             )
 
+        # --- eigenvalue (reference engine.py eigenvalue_enabled: power
+        # iteration at gas boundaries feeding MoQ's schedule)
+        self.eigenvalue = None
+        if config.eigenvalue.enabled:
+            from .eigenvalue import Eigenvalue
+
+            ev = config.eigenvalue
+            self.eigenvalue = Eigenvalue(
+                verbose=ev.verbose, max_iter=ev.max_iter, tol=ev.tol,
+                stability=ev.stability,
+                gas_boundary_resolution=ev.gas_boundary_resolution,
+                layer_name=ev.layer_name, layer_num=ev.layer_num,
+            )
+
         # --- activation checkpointing config → global policy (reference
         # configure:825, which is equally process-global); models built from
         # GPT2Config-style configs read their own fields, models using
@@ -441,6 +455,29 @@ class DeepSpeedEngine:
             f"precision={'fp16' if self.fp16_enabled else ('bf16' if self.bf16_enabled else str(self.compute_dtype))} "
             f"batch=({self.train_batch_size_value}={self.micro_batch_size}x{self.gradient_accumulation_steps_value}x{self.dp_world_size})"
         )
+        if config.dump_state:
+            # reference engine.py dump_state: print the resolved engine
+            # configuration after init
+            import json as _json
+
+            log_dist(
+                "engine state dump:\n"
+                + _json.dumps(config.to_dict(), indent=2, sort_keys=True, default=str)
+            )
+
+    def memory_breakdown(self) -> Dict[str, int]:
+        """Per-device HBM usage (reference engine.py memory_breakdown — the
+        torch.cuda.memory_allocated/cached printout). Returns the first
+        addressable device's stats; logged each ``steps_per_print`` when
+        config ``memory_breakdown`` is on."""
+        try:
+            stats = jax.local_devices()[0].memory_stats() or {}
+        except Exception:
+            stats = {}
+        return {
+            k: int(stats.get(k, 0))
+            for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+        }
 
     # ------------------------------------------------------------------
     # 1-bit optimizer path (explicit compressed collectives via shard_map)
@@ -1178,6 +1215,15 @@ class DeepSpeedEngine:
                 )
             if self.wall_clock_breakdown:
                 self.timers.log([TRAIN_BATCH_TIMER])
+            if self.config.memory_breakdown:
+                mb = self.memory_breakdown()
+                log_dist(
+                    "memory: in_use={:.2f} GB peak={:.2f} GB limit={:.2f} GB".format(
+                        mb["bytes_in_use"] / 2**30,
+                        mb["peak_bytes_in_use"] / 2**30,
+                        mb["bytes_limit"] / 2**30,
+                    )
+                )
         return metrics
 
     def comms_summary(self, measure: bool = False) -> str:
@@ -1257,6 +1303,22 @@ class DeepSpeedEngine:
     def get_lr(self) -> float:
         return float(jax.device_get(jnp.asarray(self.lr_schedule(self.state.global_step))))
 
+    def compute_eigenvalue(self, batch: PyTree, rng=None):
+        """Top Hessian |eigenvalue| of the loss at the current params
+        (reference engine.py eigenvalue at gas boundaries, feeding the MoQ
+        quantize schedule). Requires config ``eigenvalue.enabled``."""
+        if self.eigenvalue is None:
+            raise ValueError("eigenvalue.enabled is off in the config")
+        device_batch = self.shard_batch(batch)
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+        def loss_fn(params):
+            loss, _ = self.module.loss_fn(params, device_batch, rng, True)
+            return loss.astype(jnp.float32)
+
+        ev, vec = self.eigenvalue.compute_eigenvalue(loss_fn, self.state.params, rng)
+        return ev, vec
+
     def sparse_attention_config(self):
         """The ``sparse_attention`` config section, for client models to feed
         ``ops.sparse_attention.from_ds_config`` / ``gpt2.get_config``
@@ -1285,10 +1347,28 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # checkpointing (reference engine.py:2881 save_checkpoint / :2531 load)
     # ------------------------------------------------------------------
+    def _checkpoint_tag_validation(self, tag: str) -> None:
+        """Cross-host tag consistency (reference engine.py:2863
+        ``_checkpoint_tag_validation`` — an allreduced tag hash). Mode comes
+        from ``checkpoint.tag_validation``: Ignore | Warn | Fail."""
+        mode = (self.config.checkpoint.tag_validation or "Warn").lower()
+        if mode == "ignore" or jax.process_count() == 1:
+            return
+        from .debug import check_config_consistency, config_fingerprint
+
+        try:
+            check_config_consistency(self.mesh, config_fingerprint({"tag": tag}))
+        except RuntimeError as e:
+            msg = f"checkpoint tag '{tag}' differs across hosts ({e})"
+            if mode == "fail":
+                raise RuntimeError(msg) from e
+            logger.warning(msg)
+
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None, client_state: Optional[Dict] = None, save_latest: bool = True):
         from ..checkpoint.engine import save_train_state
 
         tag = tag or f"global_step{self.get_global_step()}"
+        self._checkpoint_tag_validation(tag)
         path = save_train_state(
             save_dir, tag, self.state,
             client_state={**(client_state or {}), "global_steps": self.global_steps},

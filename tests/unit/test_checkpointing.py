@@ -79,3 +79,23 @@ def test_cross_mesh_restore(mesh_dp8, mesh_dp4_tp2, tmp_path):
     p1 = jax.device_get(e1.state.params)
     p2 = jax.device_get(e2.state.params)
     jax.tree.map(np.testing.assert_array_equal, p1, p2)
+
+
+def test_tag_validation_modes(mesh_dp8, tmp_path):
+    """checkpoint.tag_validation (reference engine.py:2863): single-process
+    saves pass under every mode; unknown-but-harmless modes don't break the
+    save path. The cross-host mismatch raise itself is exercised through
+    debug.check_config_consistency's own tests."""
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+    from .simple_model import base_config, make_simple_model, random_batches
+
+    for mode in ("Ignore", "Warn", "Fail"):
+        cfg_doc = base_config(stage=0, dp=8)
+        cfg_doc["checkpoint"] = {"tag_validation": mode}
+        cfg = DeepSpeedConfig.load(cfg_doc, dp_world_size=8)
+        assert cfg.checkpoint.tag_validation == mode
+        e = DeepSpeedEngine(make_simple_model(), cfg, mesh=mesh_dp8, seed=1)
+        e.train_batch(random_batches(1, e.train_batch_size)[0])
+        e.save_checkpoint(str(tmp_path / mode))

@@ -114,3 +114,36 @@ def test_initialize_api(mesh_dp8):
     assert optimizer is engine.optimizer
     batch = random_batches(1, engine.train_batch_size)[0]
     engine.train_batch(batch)
+
+
+class TestStateIntrospection:
+    """dump_state and memory_breakdown engine flags (reference engine.py
+    dump_state / memory_breakdown printouts)."""
+
+    def test_dump_state_and_memory_breakdown(self, mesh_dp8):
+        import io
+        import logging
+
+        from deepspeed_tpu.runtime.config import DeepSpeedConfig
+        from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+        from .simple_model import base_config, make_simple_model, random_batches
+
+        doc = base_config(stage=0, dp=8)
+        doc["dump_state"] = True
+        doc["memory_breakdown"] = True
+        doc["steps_per_print"] = 1
+        cfg = DeepSpeedConfig.load(doc, dp_world_size=8)
+        stream = io.StringIO()
+        handler = logging.StreamHandler(stream)
+        logging.getLogger("deepspeed_tpu").addHandler(handler)
+        try:
+            e = DeepSpeedEngine(make_simple_model(), cfg, mesh=mesh_dp8, seed=0)
+            e.train_batch(random_batches(1, e.train_batch_size)[0])
+        finally:
+            logging.getLogger("deepspeed_tpu").removeHandler(handler)
+        text = stream.getvalue()
+        assert "engine state dump" in text
+        assert "memory: in_use=" in text
+        mb = e.memory_breakdown()
+        assert set(mb) == {"bytes_in_use", "peak_bytes_in_use", "bytes_limit"}
