@@ -41,9 +41,32 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from ..parallel.topology import MeshSpec
 from ..runtime.module import ModuleSpec
 from ..runtime.zero.partitioning import ZeroShardingPolicy
-from ..utils.logging import log_dist
+from ..utils.logging import log_dist, warning_once
+
+_UNSET = object()  # distinguishes an explicit kwarg from its default
 
 PyTree = Any
+
+
+_DTYPE_NAMES = {
+    "fp16": jnp.float16, "half": jnp.float16, "float16": jnp.float16,
+    "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+    "fp32": jnp.float32, "float": jnp.float32, "float32": jnp.float32,
+    "int8": jnp.int8,
+}
+
+
+def _parse_dtype(d):
+    """Accept jnp dtypes, numpy dtypes, torch dtypes, or DS-config strings
+    ("fp16"/"bf16"/"int8"/torch.half names) — reference inference config
+    dtype coercion."""
+    if isinstance(d, str):
+        key = d.lower().replace("torch.", "")
+        if key not in _DTYPE_NAMES:
+            raise ValueError(f"unknown inference dtype {d!r}")
+        return _DTYPE_NAMES[key]
+    name = getattr(d, "__name__", None) or str(d).replace("torch.", "")
+    return _DTYPE_NAMES.get(name, d)
 
 
 def _is_torch_module(model) -> bool:
@@ -56,19 +79,60 @@ class InferenceEngine:
         self,
         model: Any = None,
         params: Optional[PyTree] = None,
-        mp_size: int = 1,
-        ep_size: int = 1,
-        dtype=jnp.bfloat16,
+        mp_size=_UNSET,
+        ep_size=_UNSET,
+        dtype=_UNSET,
         mesh: Optional[Mesh] = None,
-        replace_with_kernel_inject: bool = False,
+        replace_with_kernel_inject=_UNSET,
         injection_policy: Optional[type] = None,
-        quantize_bits: int = 0,
+        quantize_bits=_UNSET,
         quantize_groups: int = 64,
-        max_tokens: int = 1024,
+        max_tokens=_UNSET,
         seed: int = 0,
-        checkpoint: Optional[str] = None,
+        checkpoint=_UNSET,
+        config: Optional[Dict] = None,
         **kwargs,
     ):
+        # reference init_inference(config={...}) dict surface
+        # (deepspeed/inference/config.py keys). Precedence: an explicitly
+        # passed kwarg wins over the config dict; the dict wins over the
+        # built-in default.
+        c = dict(config or {})
+        cfg_mp = c.pop("mp_size", None)
+        tp_dict = c.pop("tensor_parallel", None)
+        if cfg_mp is None and isinstance(tp_dict, dict):
+            cfg_mp = tp_dict.get("tp_size")
+        mp_size = int(mp_size if mp_size is not _UNSET else (cfg_mp or 1))
+        ep_size = int(ep_size if ep_size is not _UNSET else c.pop("ep_size", 1))
+        cfg_dtype = c.pop("dtype", None)
+        dtype = _parse_dtype(
+            dtype if dtype is not _UNSET
+            else (cfg_dtype if cfg_dtype is not None else jnp.bfloat16)
+        )
+        replace_with_kernel_inject = bool(
+            replace_with_kernel_inject if replace_with_kernel_inject is not _UNSET
+            else c.pop("replace_with_kernel_inject", False)
+        )
+        max_tokens = int(
+            max_tokens if max_tokens is not _UNSET
+            else c.pop("max_out_tokens", c.pop("max_tokens", 1024))
+        )
+        checkpoint = checkpoint if checkpoint is not _UNSET else c.pop("checkpoint", None)
+        q = c.pop("quantization_setting", None)
+        if quantize_bits is _UNSET:
+            quantize_bits = 0
+            if q is not None:
+                quantize_bits = 8
+                quantize_groups = int(q if not isinstance(q, (tuple, list)) else q[-1])
+        if np.dtype(dtype) == np.int8:
+            # reference semantics: dtype=int8 means weight quantization, not
+            # casting float weights to integers; compute stays bf16
+            quantize_bits = 8
+            dtype = jnp.bfloat16
+        if c:
+            warning_once(f"init_inference: ignoring config keys {sorted(c)}")
+        if kwargs:
+            warning_once(f"init_inference: ignoring kwargs {sorted(kwargs)}")
         self.dtype = dtype
         self.max_tokens = max_tokens
         if mesh is None:
@@ -142,6 +206,16 @@ class InferenceEngine:
             )
             self.quantized = False
             self.model_config = (model.extra or {}).get("config")
+            if quantize_bits == 8 and params is not None:
+                # ModuleSpec path honors int8 too (reference engine.py:464
+                # _convert_to_dtype → GroupQuantizer over client weights)
+                from ..ops.quantizer import quantize_tree
+
+                params = quantize_tree(
+                    jax.tree.map(jnp.asarray, params),
+                    groups=quantize_groups, dtype=dtype,
+                )
+                self.quantized = True
 
         self.module = model
 
@@ -189,6 +263,8 @@ class InferenceEngine:
         max_new_tokens: int = 20,
         temperature: float = 0.0,
         seed: int = 0,
+        top_k: int = 0,
+        top_p: float = 1.0,
     ) -> np.ndarray:
         """Autoregressive generation.
 
@@ -207,7 +283,7 @@ class InferenceEngine:
             from ..models import decoder as gen_mod
 
         if gen_mod is not None:
-            key = (ids.shape, max_new_tokens, float(temperature))
+            key = (ids.shape, max_new_tokens, float(temperature), int(top_k), float(top_p))
             gen = self._generate_cache.get(key)
             if gen is None:
                 cfg = self.model_config
@@ -218,6 +294,7 @@ class InferenceEngine:
                     return mod.generate(
                         cfg, params, ids, max_new_tokens,
                         temperature=temperature, rng=rng, cache_dtype=cache_dtype,
+                        top_k=top_k, top_p=top_p,
                     )
 
                 gen = jax.jit(gen_fn)
@@ -227,13 +304,12 @@ class InferenceEngine:
             return np.asarray(jax.device_get(out))
 
         # fallback: full-prefix recompute each token
+        from ..ops.sampling import sample_logits
+
         for _ in range(max_new_tokens):
             logits = self._forward(self.params, {"input_ids": ids})
             last = logits[:, -1, :].astype(jnp.float32)
-            if temperature and temperature > 0.0:
-                rng, k = jax.random.split(rng)
-                nxt = jax.random.categorical(k, last / temperature, axis=-1)
-            else:
-                nxt = jnp.argmax(last, axis=-1)
+            rng, k = jax.random.split(rng)
+            nxt = sample_logits(last, k, temperature, top_k, top_p)
             ids = jnp.concatenate([ids, nxt[:, None].astype(ids.dtype)], axis=1)
         return np.asarray(jax.device_get(ids))

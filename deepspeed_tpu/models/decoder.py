@@ -289,6 +289,8 @@ def generate(
     rng=None,
     max_len: Optional[int] = None,
     cache_dtype=jnp.bfloat16,
+    top_k: int = 0,
+    top_p: float = 1.0,
 ):
     """Prefill + lax.scan decode (same structure as models/gpt2.generate)."""
     B, S = input_ids.shape
@@ -305,10 +307,10 @@ def generate(
     cache = init_cache(cfg, B, max_len, dtype=cache_dtype)
     logits, cache = forward_cached(cfg, params, input_ids, cache)
 
+    from ..ops.sampling import sample_logits
+
     def sample(logits, key):
-        if temperature and temperature > 0.0:
-            return jax.random.categorical(key, logits.astype(jnp.float32) / temperature, axis=-1)
-        return jnp.argmax(logits, axis=-1)
+        return sample_logits(logits, key, temperature, top_k, top_p)
 
     first = sample(logits, rng)
     if max_new_tokens == 1:

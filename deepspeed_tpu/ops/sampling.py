@@ -1,0 +1,60 @@
+"""In-graph token sampling for autoregressive decode.
+
+Shared by ``models/gpt2.generate`` and ``models/decoder.generate`` (the
+reference leaves sampling to HF's generate loop on host; here the whole
+decode — including top-k/top-p filtering — stays inside the compiled
+``lax.scan`` so no per-token host round trip exists).
+
+All transforms are shape-static and jit-safe: top-k masks via
+``jax.lax.top_k`` threshold, top-p (nucleus) masks in sorted space and
+scatters back through the inverse permutation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG = -1e30
+
+
+def top_k_mask(logits: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Keep the k highest logits per row, mask the rest to -inf."""
+    if k <= 0 or k >= logits.shape[-1]:
+        return logits
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]  # [.., 1] k-th largest
+    return jnp.where(logits < kth, NEG, logits)
+
+
+def top_p_mask(logits: jnp.ndarray, p: float) -> jnp.ndarray:
+    """Nucleus filtering: keep the smallest prefix of the probability-sorted
+    vocab whose cumulative mass reaches ``p`` (always keeps the argmax)."""
+    if p >= 1.0:
+        return logits
+    sort_idx = jnp.argsort(-logits, axis=-1)
+    sorted_logits = jnp.take_along_axis(logits, sort_idx, axis=-1)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    # exclusive cumulative mass: the first token always survives
+    cum = jnp.cumsum(probs, axis=-1) - probs
+    keep_sorted = cum < p
+    masked_sorted = jnp.where(keep_sorted, sorted_logits, NEG)
+    inv = jnp.argsort(sort_idx, axis=-1)
+    return jnp.take_along_axis(masked_sorted, inv, axis=-1)
+
+
+def sample_logits(
+    logits: jnp.ndarray,
+    key,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+) -> jnp.ndarray:
+    """[.., V] logits → token ids. temperature<=0 = greedy (top_k/top_p are
+    then irrelevant — argmax always survives both filters)."""
+    logits = logits.astype(jnp.float32)
+    if not temperature or temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    logits = top_k_mask(logits, int(top_k))
+    logits = top_p_mask(logits, float(top_p))
+    return jax.random.categorical(key, logits, axis=-1)

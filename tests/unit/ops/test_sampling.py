@@ -1,0 +1,98 @@
+"""In-graph sampling transforms (temperature / top-k / top-p)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.sampling import sample_logits, top_k_mask, top_p_mask
+
+
+class TestMasks:
+    def test_top_k_keeps_exactly_k(self):
+        logits = jnp.asarray(np.random.RandomState(0).randn(3, 50), jnp.float32)
+        out = top_k_mask(logits, 5)
+        assert int((out > -1e29).sum(axis=-1).max()) == 5
+        # the kept entries are the 5 largest
+        for r in range(3):
+            kept = set(np.where(np.asarray(out[r]) > -1e29)[0])
+            want = set(np.argsort(-np.asarray(logits[r]))[:5])
+            assert kept == want
+
+    def test_top_k_noop_for_zero_or_full(self):
+        logits = jnp.ones((2, 8))
+        np.testing.assert_array_equal(top_k_mask(logits, 0), logits)
+        np.testing.assert_array_equal(top_k_mask(logits, 8), logits)
+
+    def test_top_p_keeps_nucleus(self):
+        # peaked distribution: p=0.9 keeps only the two big tokens
+        logits = jnp.log(jnp.asarray([[0.6, 0.35, 0.03, 0.02]], jnp.float32))
+        out = np.asarray(top_p_mask(logits, 0.9))
+        assert (out[0, :2] > -1e29).all() and (out[0, 2:] < -1e29).all()
+
+    def test_top_p_always_keeps_argmax(self):
+        logits = jnp.asarray([[0.1, 5.0, 0.2]], jnp.float32)
+        out = np.asarray(top_p_mask(logits, 1e-6))
+        assert out[0, 1] > -1e29
+        assert (out[0, [0, 2]] < -1e29).all()
+
+    def test_top_p_unsorted_scatter_roundtrip(self):
+        rs = np.random.RandomState(3)
+        logits = jnp.asarray(rs.randn(4, 100), jnp.float32)
+        out = np.asarray(top_p_mask(logits, 0.5))
+        src = np.asarray(logits)
+        for r in range(4):
+            kept = out[r] > -1e29
+            # kept entries keep their original values at original positions
+            np.testing.assert_array_equal(out[r][kept], src[r][kept])
+            # kept set is a prefix of the probability sort
+            order = np.argsort(-src[r])
+            ranks = np.where(kept[order])[0]
+            assert ranks.max() == len(ranks) - 1  # contiguous prefix
+
+
+class TestSampleLogits:
+    def test_greedy(self):
+        logits = jnp.asarray([[0.0, 3.0, 1.0]], jnp.float32)
+        assert int(sample_logits(logits, jax.random.PRNGKey(0))[0]) == 1
+
+    def test_top_k_restricts_support(self):
+        rs = np.random.RandomState(0)
+        logits = jnp.asarray(rs.randn(1, 64) * 0.1, jnp.float32)  # near-flat
+        allowed = set(np.argsort(-np.asarray(logits[0]))[:4])
+        draws = {
+            int(sample_logits(logits, jax.random.PRNGKey(i), temperature=1.0, top_k=4)[0])
+            for i in range(64)
+        }
+        assert draws <= allowed and len(draws) > 1
+
+    def test_top_p_restricts_support(self):
+        logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]], jnp.float32))
+        draws = {
+            int(sample_logits(logits, jax.random.PRNGKey(i), temperature=1.0, top_p=0.8)[0])
+            for i in range(64)
+        }
+        assert draws <= {0, 1}
+
+    def test_jit_compatible(self):
+        f = jax.jit(
+            lambda l, k: sample_logits(l, k, temperature=0.7, top_k=8, top_p=0.9)
+        )
+        out = f(jnp.ones((2, 32)), jax.random.PRNGKey(0))
+        assert out.shape == (2,)
+
+
+class TestGenerateWithSampling:
+    def test_gpt2_generate_top_k_support(self):
+        from deepspeed_tpu.models import gpt2
+
+        cfg = gpt2.get_config("gpt2-tiny")
+        params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+        ids = jnp.zeros((1, 4), jnp.int32)
+        out_greedy = gpt2.generate(cfg, params, ids, 6)
+        out_topk = gpt2.generate(
+            cfg, params, ids, 6, temperature=1.0, top_k=2,
+            rng=jax.random.PRNGKey(1),
+        )
+        assert out_greedy.shape == out_topk.shape == (1, 6)
+        assert (np.asarray(out_topk) < cfg.vocab_size).all()

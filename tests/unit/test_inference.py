@@ -301,3 +301,62 @@ class TestStreamedCheckpointLoad:
             ref = model(torch.tensor(ids.astype(np.int64))).logits.numpy()
         served = np.asarray(eng.forward({"input_ids": jnp.asarray(ids)}))
         np.testing.assert_allclose(served, ref, atol=2e-3, rtol=2e-3)
+
+
+class TestInferenceConfigDict:
+    """init_inference(config={...}) dict surface (reference
+    deepspeed/inference/config.py keys)."""
+
+    def test_config_dict_drives_dtype_and_generate(self):
+        import deepspeed_tpu
+        from deepspeed_tpu.models import gpt2
+
+        cfg = gpt2.get_config("gpt2-tiny")
+        params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+        eng = deepspeed_tpu.init_inference(
+            gpt2.make_module(cfg), params=params,
+            config={"dtype": "fp32", "max_out_tokens": 64},
+        )
+        assert eng.dtype == jnp.float32
+        assert eng.max_tokens == 64
+        out = eng.generate(np.zeros((1, 4), np.int32), max_new_tokens=3,
+                           temperature=0.7, top_k=5, top_p=0.9)
+        assert out.shape == (1, 7)
+
+    def test_torch_dtype_and_tp_dict(self, devices):
+        import torch
+
+        import deepspeed_tpu
+        from deepspeed_tpu.inference.engine import _parse_dtype
+        from deepspeed_tpu.models import gpt2
+
+        assert _parse_dtype(torch.half) == jnp.float16
+        assert _parse_dtype("bf16") == jnp.bfloat16
+        assert _parse_dtype(jnp.float32) == jnp.float32
+        cfg = gpt2.get_config("gpt2-tiny", n_head=4)
+        params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+        eng = deepspeed_tpu.init_inference(
+            gpt2.make_module(cfg), params=params,
+            config={"tensor_parallel": {"tp_size": 2}, "dtype": "fp32"},
+        )
+        assert eng.mesh.shape.get("tp", 1) == 2
+
+    def test_kwarg_wins_over_config_and_int8_means_quantize(self):
+        import deepspeed_tpu
+        from deepspeed_tpu.models import gpt2
+
+        cfg = gpt2.get_config("gpt2-tiny")
+        params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+        # explicit kwarg beats the config dict
+        eng = deepspeed_tpu.init_inference(
+            gpt2.make_module(cfg), params=params,
+            dtype=jnp.float32, config={"dtype": "bf16"},
+        )
+        assert eng.dtype == jnp.float32
+        # dtype=int8 routes to weight quantization, never integer-casts
+        eng8 = deepspeed_tpu.init_inference(
+            gpt2.make_module(cfg), params=params, config={"dtype": "int8"},
+        )
+        assert eng8.quantized and eng8.dtype == jnp.bfloat16
+        out = eng8.generate(np.zeros((1, 4), np.int32), max_new_tokens=3)
+        assert out.shape == (1, 7)
