@@ -98,32 +98,40 @@ class InferenceEngine:
         # passed kwarg wins over the config dict; the dict wins over the
         # built-in default.
         c = dict(config or {})
+        # pop every recognized key unconditionally so the leftover-key
+        # warning below never flags a key that was merely out-prioritized
         cfg_mp = c.pop("mp_size", None)
         tp_dict = c.pop("tensor_parallel", None)
         if cfg_mp is None and isinstance(tp_dict, dict):
             cfg_mp = tp_dict.get("tp_size")
-        mp_size = int(mp_size if mp_size is not _UNSET else (cfg_mp or 1))
-        ep_size = int(ep_size if ep_size is not _UNSET else c.pop("ep_size", 1))
+        cfg_ep = c.pop("ep_size", None)
         cfg_dtype = c.pop("dtype", None)
+        cfg_inject = c.pop("replace_with_kernel_inject", None)
+        cfg_max = c.pop("max_out_tokens", c.pop("max_tokens", None))
+        cfg_ckpt = c.pop("checkpoint", None)
+        q = c.pop("quantization_setting", None)
+
+        mp_size = int(mp_size if mp_size is not _UNSET else (cfg_mp or 1))
+        ep_size = int(ep_size if ep_size is not _UNSET else (cfg_ep or 1))
         dtype = _parse_dtype(
             dtype if dtype is not _UNSET
             else (cfg_dtype if cfg_dtype is not None else jnp.bfloat16)
         )
         replace_with_kernel_inject = bool(
             replace_with_kernel_inject if replace_with_kernel_inject is not _UNSET
-            else c.pop("replace_with_kernel_inject", False)
+            else bool(cfg_inject)
         )
         max_tokens = int(
             max_tokens if max_tokens is not _UNSET
-            else c.pop("max_out_tokens", c.pop("max_tokens", 1024))
+            else (cfg_max if cfg_max is not None else 1024)
         )
-        checkpoint = checkpoint if checkpoint is not _UNSET else c.pop("checkpoint", None)
-        q = c.pop("quantization_setting", None)
-        if quantize_bits is _UNSET:
-            quantize_bits = 0
-            if q is not None:
-                quantize_bits = 8
-                quantize_groups = int(q if not isinstance(q, (tuple, list)) else q[-1])
+        checkpoint = checkpoint if checkpoint is not _UNSET else cfg_ckpt
+        if q is not None:
+            # quantization_setting: groups, or (mlp_extra_grouping, groups)
+            quantize_groups = int(q if not isinstance(q, (tuple, list)) else q[-1])
+        quantize_bits = int(
+            quantize_bits if quantize_bits is not _UNSET else (8 if q is not None else 0)
+        )
         if np.dtype(dtype) == np.int8:
             # reference semantics: dtype=int8 means weight quantization, not
             # casting float weights to integers; compute stays bf16

@@ -23,6 +23,7 @@ import numpy as np
 from jax import lax
 
 from ..ops.layer_norm import layer_norm
+from ..ops.quantizer import maybe_dequantize as _deq
 from ..runtime.module import ModuleSpec
 
 PyTree = Any
@@ -39,6 +40,10 @@ class BertConfig:
     type_vocab_size: int = 2
     layer_norm_epsilon: float = 1e-12
     dropout: float = 0.0
+    # adds the MLM transform/decoder + NSP heads and a training loss_fn —
+    # the BERT-large pretraining objective that is the reference's headline
+    # workload (docs/_pages/training.md:42 "44 min on 1024 V100")
+    pretraining: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -91,6 +96,20 @@ def init_params(cfg: BertConfig, rng) -> PyTree:
             "out_ln": dict(ln),
         },
         "pooler": {"w": nrm(next(k), (E, E)), "b": jnp.zeros((E,))},
+        **(
+            {
+                # MLM transform + tied decoder bias, NSP classifier
+                # (HF BertForPreTraining cls.predictions / cls.seq_relationship)
+                "mlm": {
+                    "w": nrm(next(k), (E, E)), "b": jnp.zeros((E,)),
+                    "ln": {"scale": jnp.ones((E,)), "bias": jnp.zeros((E,))},
+                    "decoder_b": jnp.zeros((cfg.vocab_size,)),
+                },
+                "nsp": {"w": nrm(next(k), (E, 2)), "b": jnp.zeros((2,))},
+            }
+            if cfg.pretraining
+            else {}
+        ),
     }
 
 
@@ -117,6 +136,18 @@ def logical_axes(cfg: Optional[BertConfig] = None) -> PyTree:
             "out_ln": ln,
         },
         "pooler": {"w": ("embed", "embed"), "b": ("embed",)},
+        **(
+            {
+                "mlm": {
+                    "w": ("embed", "embed"), "b": ("embed",),
+                    "ln": {"scale": ("embed",), "bias": ("embed",)},
+                    "decoder_b": ("vocab",),
+                },
+                "nsp": {"w": ("embed", None), "b": (None,)},
+            }
+            if cfg is not None and cfg.pretraining
+            else {}
+        ),
     }
 
 
@@ -124,17 +155,17 @@ def _block(cfg: BertConfig, lp, h, attn_bias):
     B, S, E = h.shape
     H, D = cfg.n_head, cfg.head_dim
     a = lp["attn"]
-    q = (h @ a["wq"] + a["bq"]).reshape(B, S, H, D)
-    k_ = (h @ a["wk"] + a["bk"]).reshape(B, S, H, D)
-    v = (h @ a["wv"] + a["bv"]).reshape(B, S, H, D)
+    q = (h @ _deq(a["wq"], h.dtype) + a["bq"]).reshape(B, S, H, D)
+    k_ = (h @ _deq(a["wk"], h.dtype) + a["bk"]).reshape(B, S, H, D)
+    v = (h @ _deq(a["wv"], h.dtype) + a["bv"]).reshape(B, S, H, D)
     scores = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32), k_.astype(jnp.float32))
     scores = scores / np.sqrt(D) + attn_bias
     probs = jax.nn.softmax(scores, axis=-1).astype(h.dtype)
     o = jnp.einsum("bhst,bthd->bshd", probs, v).reshape(B, S, E)
-    h = _ln(h + (o @ a["wo"] + a["bo"]), lp["attn_ln"]["scale"], lp["attn_ln"]["bias"], cfg.layer_norm_epsilon)
+    h = _ln(h + (o @ _deq(a["wo"], o.dtype) + a["bo"]), lp["attn_ln"]["scale"], lp["attn_ln"]["bias"], cfg.layer_norm_epsilon)
     m = lp["mlp"]
-    y = jax.nn.gelu(h @ m["fc_in_w"] + m["fc_in_b"], approximate=False)
-    y = y @ m["fc_out_w"] + m["fc_out_b"]
+    y = jax.nn.gelu(h @ _deq(m["fc_in_w"], h.dtype) + m["fc_in_b"], approximate=False)
+    y = y @ _deq(m["fc_out_w"], y.dtype) + m["fc_out_b"]
     return _ln(h + y, lp["out_ln"]["scale"], lp["out_ln"]["bias"], cfg.layer_norm_epsilon)
 
 
@@ -165,10 +196,53 @@ def forward(
     return h, pooled
 
 
+def pretraining_loss(cfg: BertConfig, params: PyTree, batch, rng=None, train: bool = True):
+    """Masked-LM + next-sentence-prediction loss (the BERT pretraining
+    objective; reference bing_bert workload semantics).
+
+    Batch keys: ``input_ids`` [B,S]; ``labels`` [B,S] with -100 on unmasked
+    positions; optional ``attention_mask``/``token_type_ids``;
+    optional ``next_sentence_label`` [B]."""
+    h, pooled = forward(
+        cfg, params, batch["input_ids"],
+        batch.get("attention_mask"), batch.get("token_type_ids"),
+    )
+    m = params["mlm"]
+    t = jax.nn.gelu(h @ m["w"] + m["b"], approximate=False)
+    t = _ln(t, m["ln"]["scale"], m["ln"]["bias"], cfg.layer_norm_epsilon)
+    logits = (
+        jnp.einsum("bse,ve->bsv", t, params["wte"].astype(t.dtype))
+        + m["decoder_b"]
+    ).astype(jnp.float32)
+
+    labels = batch["labels"]
+    mask = (labels != -100).astype(jnp.float32)
+    safe = jnp.where(labels == -100, 0, labels)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    tok_ll = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    mlm_loss = -(tok_ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+    metrics = {"mlm_loss": mlm_loss}
+    loss = mlm_loss
+    nsl = batch.get("next_sentence_label")
+    if nsl is not None:
+        cls_logits = (pooled @ params["nsp"]["w"] + params["nsp"]["b"]).astype(jnp.float32)
+        nsp_loss = -jnp.take_along_axis(
+            jax.nn.log_softmax(cls_logits, axis=-1), nsl[:, None], axis=-1
+        ).mean()
+        metrics["nsp_loss"] = nsp_loss
+        loss = loss + nsp_loss
+    return loss, metrics
+
+
 def make_module(cfg: BertConfig) -> ModuleSpec:
     return ModuleSpec(
         init=lambda rng: init_params(cfg, rng),
-        loss_fn=None,
+        loss_fn=(
+            (lambda params, batch, rng, train: pretraining_loss(cfg, params, batch, rng, train))
+            if cfg.pretraining
+            else None
+        ),
         apply_fn=lambda params, batch: forward(
             cfg, params, batch["input_ids"],
             batch.get("attention_mask"), batch.get("token_type_ids"),

@@ -31,6 +31,7 @@ import numpy as np
 from jax import lax
 
 from ..ops.layer_norm import layer_norm
+from ..ops.quantizer import maybe_dequantize as _deq
 from ..runtime.module import ModuleSpec
 
 PyTree = Any
@@ -135,7 +136,7 @@ def _attention(cfg: DecoderConfig, lp, h, k_cache, v_cache, pos, layer_window):
     H, D = cfg.n_head, cfg.head_dim
 
     def proj(w, b):
-        out = h @ w
+        out = h @ _deq(w, h.dtype)
         return out + b if b is not None else out
 
     q = proj(lp["wq"], lp.get("bq")).reshape(B, S, H, D)
@@ -162,7 +163,7 @@ def _attention(cfg: DecoderConfig, lp, h, k_cache, v_cache, pos, layer_window):
 
         o1 = cached_attention(q[:, 0], k_cache, v_cache, pos, sm_scale=scale)
         o = o1.reshape(B, 1, E).astype(h.dtype)
-        out = o @ lp["wo"]
+        out = o @ _deq(lp["wo"], o.dtype)
         if lp.get("bo") is not None:
             out = out + lp["bo"]
         return out, k_cache, v_cache
@@ -187,18 +188,18 @@ def _attention(cfg: DecoderConfig, lp, h, k_cache, v_cache, pos, layer_window):
     scores = jnp.where(mask[None, None, :, :], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
     o = jnp.einsum("bhst,bthd->bshd", probs, v_cache).reshape(B, S, E).astype(h.dtype)
-    out = o @ lp["wo"]
+    out = o @ _deq(lp["wo"], o.dtype)
     if lp.get("bo") is not None:
         out = out + lp["bo"]
     return out, k_cache, v_cache
 
 
 def _mlp(cfg: DecoderConfig, lp, x):
-    y = x @ lp["fc_in_w"]
+    y = x @ _deq(lp["fc_in_w"], x.dtype)
     if lp.get("fc_in_b") is not None:
         y = y + lp["fc_in_b"]
     y = _act(cfg, y)
-    y = y @ lp["fc_out_w"]
+    y = y @ _deq(lp["fc_out_w"], y.dtype)
     if lp.get("fc_out_b") is not None:
         y = y + lp["fc_out_b"]
     return y

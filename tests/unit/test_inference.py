@@ -360,3 +360,33 @@ class TestInferenceConfigDict:
         assert eng8.quantized and eng8.dtype == jnp.bfloat16
         out = eng8.generate(np.zeros((1, 4), np.int32), max_new_tokens=3)
         assert out.shape == (1, 7)
+
+    def test_int8_works_on_bert_and_decoder_paths(self):
+        import deepspeed_tpu
+        from deepspeed_tpu.models import bert
+
+        cfg = bert.get_config("bert-tiny")
+        params = bert.init_params(cfg, jax.random.PRNGKey(0))
+        eng = deepspeed_tpu.init_inference(
+            bert.make_module(cfg), params=params, config={"dtype": "int8"},
+        )
+        assert eng.quantized
+        out = eng({"input_ids": np.zeros((2, 8), np.int32)})
+        assert np.isfinite(np.asarray(out, np.float32)).all()
+
+    def test_quant_groups_honored_with_explicit_bits(self):
+        import deepspeed_tpu
+        from deepspeed_tpu.models import gpt2
+
+        cfg = gpt2.get_config("gpt2-tiny")
+        params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+        eng = deepspeed_tpu.init_inference(
+            gpt2.make_module(cfg), params=params, quantize_bits=8,
+            config={"quantization_setting": (False, 32)},
+        )
+        assert eng.quantized
+        # a quantized leaf carries groups=32 scales on its first dim blocks
+        qw = eng.params["blocks"]["attn"]["c_attn_w"]
+        from deepspeed_tpu.ops.quantizer import QuantizedWeight
+
+        assert isinstance(qw, QuantizedWeight)
