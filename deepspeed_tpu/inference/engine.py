@@ -86,7 +86,7 @@ class InferenceEngine:
         replace_with_kernel_inject=_UNSET,
         injection_policy: Optional[type] = None,
         quantize_bits=_UNSET,
-        quantize_groups: int = 64,
+        quantize_groups=_UNSET,
         max_tokens=_UNSET,
         seed: int = 0,
         checkpoint=_UNSET,
@@ -126,9 +126,12 @@ class InferenceEngine:
             else (cfg_max if cfg_max is not None else 1024)
         )
         checkpoint = checkpoint if checkpoint is not _UNSET else cfg_ckpt
-        if q is not None:
-            # quantization_setting: groups, or (mlp_extra_grouping, groups)
-            quantize_groups = int(q if not isinstance(q, (tuple, list)) else q[-1])
+        # quantization_setting: groups, or (mlp_extra_grouping, groups)
+        cfg_groups = None if q is None else int(q if not isinstance(q, (tuple, list)) else q[-1])
+        quantize_groups = int(
+            quantize_groups if quantize_groups is not _UNSET
+            else (cfg_groups if cfg_groups is not None else 64)
+        )
         quantize_bits = int(
             quantize_bits if quantize_bits is not _UNSET else (8 if q is not None else 0)
         )

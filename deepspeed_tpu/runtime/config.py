@@ -97,7 +97,8 @@ class ZeroConfig(DSConfigModel):
     prefetch depth), ``round_robin_gradients``, ``zero_hpz_partition_size``.
     ``sub_group_size`` and the offload sub-configs ARE consumed by the
     host-tier engines (offload/infinity); ``stage3_param_persistence_threshold``
-    by the Infinity block streamer."""
+    by the Infinity block streamer; ``stage3_gather_16bit_weights_on_model_save``
+    by the engine's save path (save_16bit_model)."""
 
     stage: int = 0
     contiguous_gradients: bool = True

@@ -1402,7 +1402,52 @@ class DeepSpeedEngine:
         )
         if self._offload is not None:
             np.savez(os.path.join(str(path), "offload_optimizer.npz"), **self._offload.state_dict())
+        if self.config.zero_optimization.stage3_gather_16bit_weights_on_model_save and self.zero_stage >= 3:
+            if self.state.params:
+                self.save_16bit_model(str(path))
+            else:
+                # Infinity/param-offload keeps params host-side — skip the
+                # device gather instead of failing the whole save
+                logger.warning(
+                    "stage3_gather_16bit_weights_on_model_save: params are "
+                    "host-offloaded; skipping 16-bit export (the offload "
+                    "checkpoint already holds the full weights)"
+                )
         log_dist(f"saved checkpoint: {path}")
+        return path
+
+    def save_16bit_model(self, save_dir: str, output_file: str = "pytorch_model.npz"):
+        """Gather the (possibly ZeRO-sharded) params to full arrays, cast to
+        the 16-bit compute dtype, and write ONE flat .npz — the model-only
+        export for serving (reference engine.save_16bit_model:3268 +
+        _zero3_consolidated_16bit_state_dict:3198; the allgather there is the
+        ``gather_full`` replication constraint here)."""
+        from ..utils.zero_to_fp32 import _flatten_tree
+        from .zero.partitioning import gather_full
+
+        if not self.state.params:
+            raise ValueError(
+                "save_16bit_model needs device-resident params (offload_param "
+                "engines export via their own checkpoint path)"
+            )
+        dtype = self.compute_dtype if self.bf16_enabled or self.fp16_enabled else jnp.bfloat16
+        full = gather_full(self.state.params, self.mesh)
+        full = jax.device_get(jax.tree.map(lambda p: p.astype(dtype), full))
+        flat = _flatten_tree(full)
+        # npz has no bf16: store bf16 as uint16 bit patterns + a dtype tag
+        out = {}
+        for k, v in flat.items():
+            a = np.asarray(v)
+            if a.dtype == jnp.bfloat16:
+                out[k] = a.view(np.uint16)
+                out[f"__bf16__{k}"] = np.asarray(True)
+            else:
+                out[k] = a
+        path = os.path.join(save_dir, output_file)
+        if jax.process_index() == 0:  # one writer per shared save_dir
+            os.makedirs(save_dir, exist_ok=True)
+            np.savez(path, **out)
+        log_dist(f"saved 16-bit model: {path}")
         return path
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None, load_optimizer_states: bool = True, load_lr_scheduler_states: bool = True):

@@ -363,7 +363,7 @@ class TestInferenceConfigDict:
 
     def test_int8_works_on_bert_and_decoder_paths(self):
         import deepspeed_tpu
-        from deepspeed_tpu.models import bert
+        from deepspeed_tpu.models import bert, decoder
 
         cfg = bert.get_config("bert-tiny")
         params = bert.init_params(cfg, jax.random.PRNGKey(0))
@@ -373,6 +373,35 @@ class TestInferenceConfigDict:
         assert eng.quantized
         out = eng({"input_ids": np.zeros((2, 8), np.int32)})
         assert np.isfinite(np.asarray(out, np.float32)).all()
+
+        dcfg = decoder.DecoderConfig(
+            vocab_size=128, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+            ffn_dim=64, pos_emb="rope",
+        )
+        rs = np.random.RandomState(1)
+        L, E, F = dcfg.n_layer, dcfg.n_embd, dcfg.ffn_dim
+
+        def nrm(*shape):
+            return jnp.asarray(rs.randn(*shape) * 0.02, jnp.float32)
+
+        ln = lambda: {"scale": jnp.ones((L, E)), "bias": jnp.zeros((L, E))}
+        dparams = {
+            "wte": nrm(dcfg.vocab_size, E),
+            "blocks": {
+                "ln_1": ln(), "ln_2": ln(),
+                "attn": {"wq": nrm(L, E, E), "wk": nrm(L, E, E),
+                         "wv": nrm(L, E, E), "wo": nrm(L, E, E)},
+                "mlp": {"fc_in_w": nrm(L, E, F), "fc_out_w": nrm(L, F, E)},
+            },
+            "ln_f": {"scale": jnp.ones((E,)), "bias": jnp.zeros((E,))},
+        }
+        deng = deepspeed_tpu.init_inference(
+            decoder.make_module(dcfg), params=dparams, config={"dtype": "int8"},
+        )
+        assert deng.quantized
+        gen = deng.generate(np.zeros((1, 4), np.int32), max_new_tokens=4)
+        assert gen.shape == (1, 8)
+        assert (np.asarray(gen) < dcfg.vocab_size).all()
 
     def test_quant_groups_honored_with_explicit_bits(self):
         import deepspeed_tpu
