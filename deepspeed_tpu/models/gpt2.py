@@ -55,6 +55,12 @@ class GPT2Config:
     partition_activations: bool = False
     cpu_checkpointing: bool = False
     attn_impl: str = "auto"  # auto | pallas | jnp | ring | ring_flash | ulysses | sparse
+    # >0: compute the LM cross-entropy in sequence chunks of this many
+    # positions, never materializing the full [B,S,V] logits (at GPT-2
+    # vocab 50257 and seq 1024 those are ~100 MB/sample in f32 — the
+    # dominant activation). Backward recomputes each chunk's logits
+    # (jax.checkpoint). 0 = classic full-logits path.
+    ce_chunk: int = 0
     # for attn_impl="sparse": a SparsityConfig instance (or None → Fixed
     # defaults). Built from the engine config's ``sparse_attention`` section
     # via ops.sparse_attention.from_ds_config (reference
@@ -350,7 +356,7 @@ def _pld_block(cfg: GPT2Config, layer_params, h, train: bool, key, theta, layer_
     return h + (hb - h) / kp.astype(h.dtype), aux / kp
 
 
-def forward_with_aux(
+def hidden_with_aux(
     cfg: GPT2Config,
     params: PyTree,
     input_ids: jnp.ndarray,
@@ -358,8 +364,10 @@ def forward_with_aux(
     rng=None,
     pld_theta=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """input_ids [B,S] → (logits [B,S,V], moe_aux_loss scalar). ``pld_theta``
-    (traced scalar) engages progressive layer drop during training."""
+    """input_ids [B,S] → (final-LN hidden states [B,S,E], moe_aux_loss
+    scalar) — the pre-head trunk, so losses can choose whether to
+    materialize full logits. ``pld_theta`` (traced scalar) engages
+    progressive layer drop during training."""
     B, S = input_ids.shape
     h = params["wte"][input_ids] + params["wpe"][:S][None, :, :]
     # rng per layer when dropout or MoE stochastic routing needs it
@@ -406,6 +414,21 @@ def forward_with_aux(
         body = jax.checkpoint(body, policy=_remat_policy(cfg), prevent_cse=False)
     (h, aux_total), _ = lax.scan(body, (h, jnp.float32(0.0)), xs)
     h = _layer_norm(h, params["ln_f"]["scale"], params["ln_f"]["bias"], cfg.layer_norm_epsilon)
+    return h, aux_total
+
+
+def forward_with_aux(
+    cfg: GPT2Config,
+    params: PyTree,
+    input_ids: jnp.ndarray,
+    train: bool = False,
+    rng=None,
+    pld_theta=None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """input_ids [B,S] → (logits [B,S,V], moe_aux_loss scalar)."""
+    h, aux_total = hidden_with_aux(
+        cfg, params, input_ids, train=train, rng=rng, pld_theta=pld_theta
+    )
     logits = h @ params["wte"].T  # tied embeddings
     return logits, aux_total
 
@@ -426,10 +449,10 @@ def lm_loss(
     """Next-token cross-entropy. batch: {"input_ids": [B,S]} and optional
     {"labels": [B,S]} (-100 = ignore, HF convention) / {"attention_mask"}."""
     ids = batch["input_ids"]
-    full_logits, moe_aux = forward_with_aux(
+    h, moe_aux = hidden_with_aux(
         cfg, params, ids, train=train, rng=rng, pld_theta=pld_theta
     )
-    loss, ntokens = _token_loss(cfg, params, full_logits, batch)
+    loss, ntokens = _head_token_loss(cfg, params["wte"], h, batch)
     # aux load-balancing penalty only shapes the training objective; eval loss
     # stays pure LM cross-entropy (comparable to dense baselines)
     if cfg.is_moe and train:
@@ -437,20 +460,68 @@ def lm_loss(
     return loss, {"ntokens": ntokens, "moe_aux": moe_aux}
 
 
-def _token_loss(cfg: GPT2Config, params, logits_full, batch):
-    """Shifted CE given full logits (shared by plain and pipeline paths).
-    Returns (mean nll, ntokens)."""
+def _shift_labels_mask(batch):
+    """Next-token shift + ignore-index/attention masking shared by every LM
+    loss path: returns (labels [B,S-1] clamped >=0, mask f32 [B,S-1])."""
     ids = batch["input_ids"]
-    logits = logits_full[:, :-1]
     labels = batch.get("labels", ids)[:, 1:]
     mask = (labels != -100).astype(jnp.float32)
     if "attention_mask" in batch:
         mask = mask * batch["attention_mask"][:, 1:].astype(jnp.float32)
-    labels = jnp.maximum(labels, 0)
+    return jnp.maximum(labels, 0), mask
+
+
+def _head_token_loss(cfg: GPT2Config, wte, h, batch):
+    """Head projection + shifted CE from final hidden states; chunked when
+    cfg.ce_chunk > 0 (shared by the plain, pipeline, and offload paths so
+    the knob works everywhere)."""
+    if cfg.ce_chunk > 0:
+        return _chunked_token_loss(cfg, wte, h, batch)
+    return _token_loss(cfg, None, h @ wte.T, batch)
+
+
+def _token_loss(cfg: GPT2Config, params, logits_full, batch):
+    """Shifted CE given full logits. Returns (mean nll, ntokens)."""
+    logits = logits_full[:, :-1]
+    labels, mask = _shift_labels_mask(batch)
     logz = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
     gold = jnp.take_along_axis(logits.astype(jnp.float32), labels[..., None], axis=-1)[..., 0]
     nll = (logz - gold) * mask
     return jnp.sum(nll) / jnp.maximum(jnp.sum(mask), 1.0), jnp.sum(mask)
+
+
+def _chunked_token_loss(cfg: GPT2Config, wte, h, batch):
+    """Shifted CE from hidden states in sequence chunks (cfg.ce_chunk
+    positions at a time): per chunk, project onto the tied embedding and
+    reduce to a scalar nll sum; ``jax.checkpoint`` on the chunk body makes
+    backward recompute the chunk's logits instead of storing them. Peak
+    logits memory drops from [B,S,V] to [B,C,V]. Numerically identical to
+    :func:`_token_loss` (same f32 logsumexp)."""
+    labels_all, mask = _shift_labels_mask(batch)
+    h = h[:, :-1]
+    B, S1, E = h.shape
+    C = int(cfg.ce_chunk)
+    pad = (-S1) % C
+    if pad:
+        h = jnp.pad(h, ((0, 0), (0, pad), (0, 0)))
+        labels_all = jnp.pad(labels_all, ((0, 0), (0, pad)))
+        mask = jnp.pad(mask, ((0, 0), (0, pad)))
+    n_chunks = h.shape[1] // C
+    h_c = h.reshape(B, n_chunks, C, E).transpose(1, 0, 2, 3)  # [nc,B,C,E]
+    lab_c = labels_all.reshape(B, n_chunks, C).transpose(1, 0, 2)
+    mask_c = mask.reshape(B, n_chunks, C).transpose(1, 0, 2)
+
+    @jax.checkpoint
+    def chunk_nll(carry, xs):
+        hc, lc, mc = xs
+        logits = (hc @ wte.T).astype(jnp.float32)  # [B,C,V]
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, lc[..., None], axis=-1)[..., 0]
+        return carry + jnp.sum((logz - gold) * mc), None
+
+    total, _ = lax.scan(chunk_nll, jnp.float32(0.0), (h_c, lab_c, mask_c))
+    ntokens = jnp.sum(mask)
+    return total / jnp.maximum(ntokens, 1.0), ntokens
 
 
 def pipeline_lm_loss(cfg: GPT2Config, params: PyTree, batch_micro, rng, train: bool, mesh):
@@ -502,8 +573,7 @@ def pipeline_lm_loss(cfg: GPT2Config, params: PyTree, batch_micro, rng, train: b
     # once would cost M× the activation memory the pipeline exists to save
     def per_micro(i, acc):
         micro_batch = jax.tree.map(lambda x: x[i], batch_micro)
-        logits_i = h_out[i] @ params["wte"].T  # [mb, S, V]
-        return acc + _token_loss(cfg, params, logits_i, micro_batch)[0]
+        return acc + _head_token_loss(cfg, params["wte"], h_out[i], micro_batch)[0]
 
     total = lax.fori_loop(0, M, per_micro, jnp.float32(0.0))
     return total / M, {}
@@ -718,8 +788,7 @@ def make_block_api(cfg: GPT2Config):
 
     def head_loss(pers, h, batch):
         h = _layer_norm(h, pers["ln_f"]["scale"], pers["ln_f"]["bias"], eps)
-        logits = h @ pers["wte"].T  # tied embeddings
-        loss, _ntok = _token_loss(cfg, None, logits, batch)
+        loss, _ntok = _head_token_loss(cfg, pers["wte"], h, batch)
         return loss
 
     def split_params(params):
