@@ -214,9 +214,14 @@ def main():
             out_shardings=(engine.state_shardings, None),
         )
         st, losses = multi(engine.state, device_batch)  # compile + warm
+        # the jit donated engine.state's buffers — rebind immediately after
+        # every call so a later failure can't leave the engine holding
+        # deleted arrays (the BENCH_PROFILE capture reuses it)
+        engine.state = st
         jax.block_until_ready(losses)
         t0 = time.perf_counter()
         st, losses = multi(st, device_batch)
+        engine.state = st
         jax.block_until_ready(losses)
         dt_device = time.perf_counter() - t0
     except Exception:
@@ -283,6 +288,12 @@ def main():
         "xl_equiv_tokens_per_sec_chip": round(xl_equiv_tok_per_sec_chip, 1),
         "loss_first_to_last": [round(first_loss, 4), round(last_loss, 4)],
     }
+    # BENCH_PROFILE=<dir>: capture an xplane/perfetto trace of 3 steady-state
+    # steps for wall-clock attribution (open in XProf / ui.perfetto.dev)
+    prof_dir = os.environ.get("BENCH_PROFILE")
+    if prof_dir:
+        engine.profile_step(batch, prof_dir)
+        result["profile_dir"] = prof_dir
     if tried:
         result["oom_fallbacks"] = tried
     print(json.dumps(result))

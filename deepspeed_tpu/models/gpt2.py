@@ -477,10 +477,10 @@ def _head_token_loss(cfg: GPT2Config, wte, h, batch):
     the knob works everywhere)."""
     if cfg.ce_chunk > 0:
         return _chunked_token_loss(cfg, wte, h, batch)
-    return _token_loss(cfg, None, h @ wte.T, batch)
+    return _token_loss(h @ wte.T, batch)
 
 
-def _token_loss(cfg: GPT2Config, params, logits_full, batch):
+def _token_loss(logits_full, batch):
     """Shifted CE given full logits. Returns (mean nll, ntokens)."""
     logits = logits_full[:, :-1]
     labels, mask = _shift_labels_mask(batch)

@@ -1249,6 +1249,24 @@ class DeepSpeedEngine:
                 )
         return metrics
 
+    def profile_step(self, batch: PyTree, trace_dir: str, steps: int = 3) -> str:
+        """Capture a ``jax.profiler`` trace (xplane/perfetto) around ``steps``
+        training steps — the wall-clock attribution tool the reference gets
+        from nsys/NVTX ranges (utils/nvtx.py); open in XProf/TensorBoard or
+        ui.perfetto.dev. Returns ``trace_dir``."""
+        import jax.profiler as _prof
+
+        device_batch = self.shard_batch(batch)
+        # warm the jit cache so the trace holds steady-state steps only
+        m = self.train_batch(device_batch)
+        jax.block_until_ready(m["loss"])
+        with _prof.trace(trace_dir):
+            for _ in range(steps):
+                m = self.train_batch(device_batch)
+            jax.block_until_ready(m["loss"])
+        log_dist(f"profiler trace written to {trace_dir}")
+        return trace_dir
+
     def comms_summary(self, measure: bool = False) -> str:
         """Account + print the compiled train step's collective mix
         (reference comm.log_summary, comms_logging.py:56).

@@ -147,3 +147,19 @@ class TestStateIntrospection:
         assert "memory: in_use=" in text
         mb = e.memory_breakdown()
         assert set(mb) == {"bytes_in_use", "peak_bytes_in_use", "bytes_limit"}
+
+    def test_profile_step_writes_trace(self, mesh_dp8, tmp_path):
+        import glob
+
+        from deepspeed_tpu.runtime.config import DeepSpeedConfig
+        from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+        from .simple_model import base_config, make_simple_model, random_batches
+
+        cfg = DeepSpeedConfig.load(base_config(stage=0, dp=8), dp_world_size=8)
+        e = DeepSpeedEngine(make_simple_model(), cfg, mesh=mesh_dp8, seed=0)
+        out = e.profile_step(
+            random_batches(1, e.train_batch_size)[0], str(tmp_path / "trace"), steps=1
+        )
+        files = glob.glob(out + "/**/*", recursive=True)
+        assert any("xplane" in f or f.endswith(".json.gz") for f in files), files
