@@ -224,8 +224,11 @@ def main():
         engine.state = st
         jax.block_until_ready(losses)
         dt_device = time.perf_counter() - t0
+        engine_usable = True
     except Exception:
-        pass
+        # a failed donated call may have deleted engine.state's buffers —
+        # the profile hook below must not touch the engine then
+        engine_usable = dt_device is not None
 
     # headline = blocked (defensible); others reported for attribution
     dt = dt_blocked
@@ -291,7 +294,7 @@ def main():
     # BENCH_PROFILE=<dir>: capture an xplane/perfetto trace of 3 steady-state
     # steps for wall-clock attribution (open in XProf / ui.perfetto.dev)
     prof_dir = os.environ.get("BENCH_PROFILE")
-    if prof_dir:
+    if prof_dir and engine_usable:
         engine.profile_step(batch, prof_dir)
         result["profile_dir"] = prof_dir
     if tried:

@@ -58,6 +58,9 @@ class DecoderConfig:
     pos_offset: int = 0  # OPT's embed_positions offset (2)
     attn_scale: Optional[float] = None  # None → 1/sqrt(head_dim); GPT-Neo → 1.0
     local_windows: Tuple[int, ...] = ()  # per-layer window, 0 = global (GPT-Neo)
+    # >0: chunked LM cross-entropy (models/lm_loss.py) — at BLOOM-class
+    # vocabs (250k) the full [B,S,V] logits dwarf every other activation
+    ce_chunk: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -264,8 +267,8 @@ def forward_cached(cfg: DecoderConfig, params, input_ids, cache: KVCache):
     return _head(cfg, params, h), KVCache(new_k, new_v, pos + input_ids.shape[1])
 
 
-def forward(cfg: DecoderConfig, params, input_ids, train: bool = False, rng=None):
-    """Full-sequence logits [B,S,V] (training/eval path, no cache)."""
+def hidden(cfg: DecoderConfig, params, input_ids, train: bool = False, rng=None):
+    """Full-sequence final-LN hidden states [B,S,E] (pre-head trunk)."""
     B, S = input_ids.shape
     h = _embed(cfg, params, input_ids, 0)
     k0 = jnp.zeros((cfg.n_layer, B, S, cfg.n_head, cfg.head_dim), h.dtype)
@@ -277,8 +280,12 @@ def forward(cfg: DecoderConfig, params, input_ids, train: bool = False, rng=None
         return h, None
 
     h, _ = lax.scan(body, h, (params["blocks"], k0, k0, _windows(cfg)))
-    h = _ln(h, params["ln_f"]["scale"], params["ln_f"]["bias"], cfg.layer_norm_epsilon)
-    return _head(cfg, params, h)
+    return _ln(h, params["ln_f"]["scale"], params["ln_f"]["bias"], cfg.layer_norm_epsilon)
+
+
+def forward(cfg: DecoderConfig, params, input_ids, train: bool = False, rng=None):
+    """Full-sequence logits [B,S,V] (training/eval path, no cache)."""
+    return _head(cfg, params, hidden(cfg, params, input_ids, train=train, rng=rng))
 
 
 def generate(
@@ -357,16 +364,13 @@ def logical_axes(cfg: DecoderConfig) -> PyTree:
 
 
 def lm_loss(cfg: DecoderConfig, params, batch, rng, train: bool):
-    ids = batch["input_ids"]
-    logits = forward(cfg, params, ids, train=train, rng=rng)
-    labels = batch.get("labels", ids)[:, 1:]
-    lg = logits[:, :-1].astype(jnp.float32)
-    mask = (labels != -100).astype(jnp.float32)
-    labels = jnp.maximum(labels, 0)
-    logz = jax.nn.logsumexp(lg, axis=-1)
-    gold = jnp.take_along_axis(lg, labels[..., None], axis=-1)[..., 0]
-    nll = (logz - gold) * mask
-    return jnp.sum(nll) / jnp.maximum(jnp.sum(mask), 1.0), {}
+    from .lm_loss import head_token_loss
+
+    h = hidden(cfg, params, batch["input_ids"], train=train, rng=rng)
+    loss, _ntok = head_token_loss(
+        lambda x: _head(cfg, params, x), h, batch, cfg.ce_chunk
+    )
+    return loss, {}
 
 
 def make_module(cfg: DecoderConfig) -> ModuleSpec:

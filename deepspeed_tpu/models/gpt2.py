@@ -460,68 +460,13 @@ def lm_loss(
     return loss, {"ntokens": ntokens, "moe_aux": moe_aux}
 
 
-def _shift_labels_mask(batch):
-    """Next-token shift + ignore-index/attention masking shared by every LM
-    loss path: returns (labels [B,S-1] clamped >=0, mask f32 [B,S-1])."""
-    ids = batch["input_ids"]
-    labels = batch.get("labels", ids)[:, 1:]
-    mask = (labels != -100).astype(jnp.float32)
-    if "attention_mask" in batch:
-        mask = mask * batch["attention_mask"][:, 1:].astype(jnp.float32)
-    return jnp.maximum(labels, 0), mask
-
-
 def _head_token_loss(cfg: GPT2Config, wte, h, batch):
     """Head projection + shifted CE from final hidden states; chunked when
     cfg.ce_chunk > 0 (shared by the plain, pipeline, and offload paths so
-    the knob works everywhere)."""
-    if cfg.ce_chunk > 0:
-        return _chunked_token_loss(cfg, wte, h, batch)
-    return _token_loss(h @ wte.T, batch)
+    the knob works everywhere). Math lives in models/lm_loss.py."""
+    from .lm_loss import head_token_loss
 
-
-def _token_loss(logits_full, batch):
-    """Shifted CE given full logits. Returns (mean nll, ntokens)."""
-    logits = logits_full[:, :-1]
-    labels, mask = _shift_labels_mask(batch)
-    logz = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
-    gold = jnp.take_along_axis(logits.astype(jnp.float32), labels[..., None], axis=-1)[..., 0]
-    nll = (logz - gold) * mask
-    return jnp.sum(nll) / jnp.maximum(jnp.sum(mask), 1.0), jnp.sum(mask)
-
-
-def _chunked_token_loss(cfg: GPT2Config, wte, h, batch):
-    """Shifted CE from hidden states in sequence chunks (cfg.ce_chunk
-    positions at a time): per chunk, project onto the tied embedding and
-    reduce to a scalar nll sum; ``jax.checkpoint`` on the chunk body makes
-    backward recompute the chunk's logits instead of storing them. Peak
-    logits memory drops from [B,S,V] to [B,C,V]. Numerically identical to
-    :func:`_token_loss` (same f32 logsumexp)."""
-    labels_all, mask = _shift_labels_mask(batch)
-    h = h[:, :-1]
-    B, S1, E = h.shape
-    C = int(cfg.ce_chunk)
-    pad = (-S1) % C
-    if pad:
-        h = jnp.pad(h, ((0, 0), (0, pad), (0, 0)))
-        labels_all = jnp.pad(labels_all, ((0, 0), (0, pad)))
-        mask = jnp.pad(mask, ((0, 0), (0, pad)))
-    n_chunks = h.shape[1] // C
-    h_c = h.reshape(B, n_chunks, C, E).transpose(1, 0, 2, 3)  # [nc,B,C,E]
-    lab_c = labels_all.reshape(B, n_chunks, C).transpose(1, 0, 2)
-    mask_c = mask.reshape(B, n_chunks, C).transpose(1, 0, 2)
-
-    @jax.checkpoint
-    def chunk_nll(carry, xs):
-        hc, lc, mc = xs
-        logits = (hc @ wte.T).astype(jnp.float32)  # [B,C,V]
-        logz = jax.nn.logsumexp(logits, axis=-1)
-        gold = jnp.take_along_axis(logits, lc[..., None], axis=-1)[..., 0]
-        return carry + jnp.sum((logz - gold) * mc), None
-
-    total, _ = lax.scan(chunk_nll, jnp.float32(0.0), (h_c, lab_c, mask_c))
-    ntokens = jnp.sum(mask)
-    return total / jnp.maximum(ntokens, 1.0), ntokens
+    return head_token_loss(lambda x: x @ wte.T, h, batch, cfg.ce_chunk)
 
 
 def pipeline_lm_loss(cfg: GPT2Config, params: PyTree, batch_micro, rng, train: bool, mesh):

@@ -1,0 +1,77 @@
+"""Shared next-token cross-entropy, full-logits or sequence-chunked.
+
+Used by every LM family (gpt2, decoder zoo): one shift/mask convention and
+one chunked path, so a label-convention change can't silently diverge
+between models. The chunked path (``ce_chunk > 0``) never materializes the
+full [B, S, V] logits — at GPT-2's 50k (or BLOOM's 250k) vocab those are
+the dominant activation — and ``jax.checkpoint`` recomputes each chunk's
+logits in backward, keeping gradients exact.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def shift_labels_mask(batch):
+    """Next-token shift + ignore-index/attention masking shared by every LM
+    loss path: returns (labels [B,S-1] clamped >=0, mask f32 [B,S-1])."""
+    ids = batch["input_ids"]
+    labels = batch.get("labels", ids)[:, 1:]
+    mask = (labels != -100).astype(jnp.float32)
+    if "attention_mask" in batch:
+        mask = mask * batch["attention_mask"][:, 1:].astype(jnp.float32)
+    return jnp.maximum(labels, 0), mask
+
+
+def token_loss(logits_full, batch):
+    """Shifted CE given full logits [B,S,V]. Returns (mean nll, ntokens)."""
+    logits = logits_full[:, :-1]
+    labels, mask = shift_labels_mask(batch)
+    logz = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+    gold = jnp.take_along_axis(logits.astype(jnp.float32), labels[..., None], axis=-1)[..., 0]
+    nll = (logz - gold) * mask
+    return jnp.sum(nll) / jnp.maximum(jnp.sum(mask), 1.0), jnp.sum(mask)
+
+
+def chunked_token_loss(project, h, batch, ce_chunk: int):
+    """Shifted CE from final hidden states in sequence chunks of ``ce_chunk``
+    positions: per chunk, ``project`` maps [..., E] hidden states to
+    [..., V] logits (tied-embedding matmul or a separate lm head) and the
+    chunk reduces to a scalar nll sum. Peak logits memory drops from
+    [B,S,V] to [B,C,V]. Numerically identical to :func:`token_loss`."""
+    labels_all, mask = shift_labels_mask(batch)
+    h = h[:, :-1]
+    B, S1, E = h.shape
+    C = int(ce_chunk)
+    pad = (-S1) % C
+    if pad:
+        h = jnp.pad(h, ((0, 0), (0, pad), (0, 0)))
+        labels_all = jnp.pad(labels_all, ((0, 0), (0, pad)))
+        mask = jnp.pad(mask, ((0, 0), (0, pad)))
+    n_chunks = h.shape[1] // C
+    h_c = h.reshape(B, n_chunks, C, E).transpose(1, 0, 2, 3)  # [nc,B,C,E]
+    lab_c = labels_all.reshape(B, n_chunks, C).transpose(1, 0, 2)
+    mask_c = mask.reshape(B, n_chunks, C).transpose(1, 0, 2)
+
+    @jax.checkpoint
+    def chunk_nll(carry, xs):
+        hc, lc, mc = xs
+        logits = project(hc).astype(jnp.float32)  # [B,C,V]
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, lc[..., None], axis=-1)[..., 0]
+        return carry + jnp.sum((logz - gold) * mc), None
+
+    total, _ = lax.scan(chunk_nll, jnp.float32(0.0), (h_c, lab_c, mask_c))
+    ntokens = jnp.sum(mask)
+    return total / jnp.maximum(ntokens, 1.0), ntokens
+
+
+def head_token_loss(project, h, batch, ce_chunk: int = 0):
+    """Head projection + shifted CE from final hidden states; chunked when
+    ``ce_chunk`` > 0. ``project``: [..., E] -> [..., V]."""
+    if ce_chunk > 0:
+        return chunked_token_loss(project, h, batch, ce_chunk)
+    return token_loss(project(h), batch)

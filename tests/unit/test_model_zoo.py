@@ -233,3 +233,41 @@ class TestBertPretraining:
         assert "mlm" not in params
         module = bert.make_module(cfg)
         assert module.loss_fn is None
+
+
+class TestDecoderChunkedCE:
+    def test_decoder_ce_chunk_matches_full(self):
+        from dataclasses import replace
+
+        from deepspeed_tpu.models import decoder
+
+        cfg = decoder.DecoderConfig(
+            vocab_size=128, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+            ffn_dim=64, pos_emb="rope",
+        )
+        rs = np.random.RandomState(2)
+        L, E, F = cfg.n_layer, cfg.n_embd, cfg.ffn_dim
+        nrm = lambda *sh: jnp.asarray(rs.randn(*sh) * 0.05, jnp.float32)
+        ln = lambda: {"scale": jnp.ones((L, E)), "bias": jnp.zeros((L, E))}
+        params = {
+            "wte": nrm(cfg.vocab_size, E),
+            "blocks": {
+                "ln_1": ln(), "ln_2": ln(),
+                "attn": {"wq": nrm(L, E, E), "wk": nrm(L, E, E),
+                         "wv": nrm(L, E, E), "wo": nrm(L, E, E)},
+                "mlp": {"fc_in_w": nrm(L, E, F), "fc_out_w": nrm(L, F, E)},
+            },
+            "ln_f": {"scale": jnp.ones((E,)), "bias": jnp.zeros((E,))},
+        }
+        ids = rs.randint(0, cfg.vocab_size, (2, 50)).astype(np.int32)
+        batch = {"input_ids": ids}
+
+        def loss(cfg_):
+            return lambda p: decoder.lm_loss(cfg_, p, batch, None, True)[0]
+
+        l_full, g_full = jax.value_and_grad(loss(cfg))(params)
+        cfg_c = replace(cfg, ce_chunk=16)  # 49 positions → pad path
+        l_chunk, g_chunk = jax.value_and_grad(loss(cfg_c))(params)
+        np.testing.assert_allclose(float(l_full), float(l_chunk), rtol=1e-6)
+        for gf, gc in zip(jax.tree.leaves(g_full), jax.tree.leaves(g_chunk)):
+            np.testing.assert_allclose(np.asarray(gf), np.asarray(gc), atol=1e-5, rtol=1e-4)
