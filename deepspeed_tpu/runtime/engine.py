@@ -1362,6 +1362,14 @@ class DeepSpeedEngine:
         ev, vec = self.eigenvalue.compute_eigenvalue(loss_fn, self.state.params, rng)
         return ev, vec
 
+    @property
+    def preempted(self) -> bool:
+        """True once a PreemptionGuard attached to this engine has seen a
+        termination signal (elasticity/preemption.py) — poll at step
+        boundaries to checkpoint-and-exit inside the grace window."""
+        guard = getattr(self, "_preemption_guard", None)
+        return bool(guard is not None and guard.should_stop())
+
     def sparse_attention_config(self):
         """The ``sparse_attention`` config section, for client models to feed
         ``ops.sparse_attention.from_ds_config`` / ``gpt2.get_config``
