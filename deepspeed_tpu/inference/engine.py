@@ -233,6 +233,13 @@ class InferenceEngine:
         # --- params: shard over tp, convert dtype (reference engine.py:464)
         init_rng = jax.random.PRNGKey(seed)
         if params is None:
+            if model.init is None:
+                raise ValueError(
+                    "model has no initializer (ModuleSpec.init=None — the "
+                    "decoder zoo builds params from converted checkpoints); "
+                    "pass them via init_inference(..., params=...) or "
+                    "checkpoint=<dir>"
+                )
             abstract = jax.eval_shape(model.init, init_rng)
             shardings = self.policy.param_shardings(abstract, model.logical_axes)
             params = jax.jit(model.init, out_shardings=shardings)(init_rng)

@@ -289,9 +289,19 @@ class DeepSpeedEngine:
     def _init_device_state(self, model, config, zcfg, seed, params, opt_cfg) -> None:
         """Standard path: params + optimizer state live on device (sharded)."""
         mesh = self.mesh
-        # --- params: born sharded (zero.Init analog)
+        # --- params: born sharded (zero.Init analog). Modules without an
+        # initializer (decoder zoo: params come from converted checkpoints)
+        # derive the abstract tree from the provided params instead.
         init_rng = jax.random.PRNGKey(seed)
-        abstract_params = jax.eval_shape(model.init, init_rng)
+        if model.init is not None:
+            abstract_params = jax.eval_shape(model.init, init_rng)
+        elif params is not None:
+            abstract_params = jax.eval_shape(lambda: params)
+        else:
+            raise ValueError(
+                "model has no initializer (ModuleSpec.init=None) — pass the "
+                "converted params to DeepSpeedEngine(..., params=...)"
+            )
         self.param_shardings = self.policy.param_shardings(abstract_params, model.logical_axes)
         self.grad_shardings = self.policy.grad_shardings(abstract_params, model.logical_axes)
         if params is None:

@@ -214,11 +214,60 @@ class ZeroShardingPolicy:
     def _tree_shardings(self, abstract_params, logical_axes, spec_fn) -> PyTree:
         if logical_axes is None:
             logical_axes = jax.tree.map(lambda p: tuple([None] * len(p.shape)), abstract_params)
+        else:
+            logical_axes = _align_axes(abstract_params, logical_axes)
 
         def make(p, axes):
             return NamedSharding(self.mesh, spec_fn(axes, tuple(p.shape)))
 
         return jax.tree.map(make, abstract_params, logical_axes, is_leaf=lambda x: hasattr(x, "shape"))
+
+
+def _is_axes_leaf(x):
+    """An axes annotation: a tuple/list of axis names (str) / None."""
+    return isinstance(x, (tuple, list)) and all(
+        e is None or isinstance(e, str) for e in x
+    )
+
+
+def _align_axes(abstract_params, logical_axes):
+    """Project a logical-axes tree onto the params structure by path.
+
+    Model families declare axes for their FULL surface (e.g. the decoder
+    zoo's optional biases / wpe); a converted checkpoint may carry only a
+    subset, and bias-less archs must not fail the pytree zip. Missing paths
+    default to unsharded (all-None axes)."""
+    by_path = {}
+    for path, axes in jax.tree_util.tree_flatten_with_path(
+        logical_axes, is_leaf=_is_axes_leaf
+    )[0]:
+        by_path[jax.tree_util.keystr(path)] = tuple(axes)
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(abstract_params)
+    aligned = []
+    matched = 0
+    for path, leaf in flat:
+        axes = by_path.get(jax.tree_util.keystr(path))
+        if axes is not None:
+            matched += 1
+        aligned.append(axes if axes is not None else tuple([None] * len(leaf.shape)))
+    if flat and by_path and matched == 0:
+        # a whole-tree miss is a structure bug (e.g. an extra nesting level),
+        # not a legitimate subset — silently replicating everything would
+        # drop every TP/ZeRO annotation
+        raise ValueError(
+            "logical_axes shares no paths with the param tree — the two "
+            f"structures are misaligned (params e.g. {jax.tree_util.keystr(flat[0][0])!r}, "
+            f"axes e.g. {next(iter(by_path))!r})"
+        )
+    if flat and matched < len(flat) / 2:
+        from ...utils.logging import warning_once
+
+        warning_once(
+            f"logical_axes covers only {matched}/{len(flat)} param leaves; "
+            "unmatched leaves are left unsharded (replicated)"
+        )
+    return jax.tree_util.tree_unflatten(treedef, aligned)
 
 
 def _is_sharding(x):
