@@ -58,6 +58,11 @@ class DecoderConfig:
     pos_offset: int = 0  # OPT's embed_positions offset (2)
     attn_scale: Optional[float] = None  # None → 1/sqrt(head_dim); GPT-Neo → 1.0
     local_windows: Tuple[int, ...] = ()  # per-layer window, 0 = global (GPT-Neo)
+    # LLaMA-family axes (beyond the reference snapshot's zoo):
+    norm: str = "layernorm"  # layernorm | rmsnorm (rmsnorm params: scale only)
+    mlp_type: str = "dense"  # dense | swiglu (adds fc_gate_w)
+    n_kv_head: Optional[int] = None  # grouped-query attention; None → n_head
+    rope_theta: float = 10000.0
     # >0: chunked LM cross-entropy (models/lm_loss.py) — at BLOOM-class
     # vocabs (250k) the full [B,S,V] logits dwarf every other activation
     ce_chunk: int = 0
@@ -65,6 +70,10 @@ class DecoderConfig:
     @property
     def head_dim(self) -> int:
         return self.n_embd // self.n_head
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_head or self.n_head
 
 
 class KVCache(NamedTuple):
@@ -74,7 +83,8 @@ class KVCache(NamedTuple):
 
 
 def init_cache(cfg: DecoderConfig, batch_size: int, max_len: int, dtype=jnp.bfloat16) -> KVCache:
-    shape = (cfg.n_layer, batch_size, max_len, cfg.n_head, cfg.head_dim)
+    # GQA caches only kv_heads — the memory saving that motivates it
+    shape = (cfg.n_layer, batch_size, max_len, cfg.kv_heads, cfg.head_dim)
     return KVCache(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype), jnp.int32(0))
 
 
@@ -82,8 +92,13 @@ def init_cache(cfg: DecoderConfig, batch_size: int, max_len: int, dtype=jnp.bflo
 # building blocks
 # ---------------------------------------------------------------------------
 
-def _ln(x, scale, bias, eps):
-    return layer_norm(x, scale, bias, eps)
+def _norm(cfg: DecoderConfig, x, p, eps):
+    """Norm dispatch: LayerNorm (scale+bias) or RMSNorm (scale only)."""
+    if cfg.norm == "rmsnorm":
+        from ..ops.layer_norm import rms_norm
+
+        return rms_norm(x, p["scale"], eps)
+    return layer_norm(x, p["scale"], p["bias"], eps)
 
 
 def _act(cfg: DecoderConfig, x):
@@ -108,7 +123,7 @@ def alibi_slopes(n_head: int) -> np.ndarray:
 
 def _rope_angles(cfg: DecoderConfig, positions: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     rot = cfg.rotary_dim or cfg.head_dim
-    inv_freq = 1.0 / (10000.0 ** (jnp.arange(0, rot, 2, dtype=jnp.float32) / rot))
+    inv_freq = 1.0 / (cfg.rope_theta ** (jnp.arange(0, rot, 2, dtype=jnp.float32) / rot))
     ang = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]  # [S, rot/2]
     return jnp.sin(ang), jnp.cos(ang)
 
@@ -134,17 +149,21 @@ def _apply_rope(cfg: DecoderConfig, x: jnp.ndarray, sin, cos) -> jnp.ndarray:
 
 
 def _attention(cfg: DecoderConfig, lp, h, k_cache, v_cache, pos, layer_window):
-    """Causal (optionally local-windowed / alibi-biased) attention with cache."""
+    """Causal (optionally local-windowed / alibi-biased) attention with cache.
+    GQA (kv_heads < n_head): K/V project and cache at kv_heads and broadcast
+    to the query heads only at score time."""
     B, S, E = h.shape
     H, D = cfg.n_head, cfg.head_dim
+    KV = cfg.kv_heads
 
-    def proj(w, b):
+    def proj(w, b, nh):
         out = h @ _deq(w, h.dtype)
-        return out + b if b is not None else out
+        out = out + b if b is not None else out
+        return out.reshape(B, S, nh, D)
 
-    q = proj(lp["wq"], lp.get("bq")).reshape(B, S, H, D)
-    k_ = proj(lp["wk"], lp.get("bk")).reshape(B, S, H, D)
-    v = proj(lp["wv"], lp.get("bv")).reshape(B, S, H, D)
+    q = proj(lp["wq"], lp.get("bq"), H)
+    k_ = proj(lp["wk"], lp.get("bk"), KV)
+    v = proj(lp["wv"], lp.get("bv"), KV)
 
     if cfg.pos_emb == "rope":
         sin, cos = _rope_angles(cfg, pos + jnp.arange(S))
@@ -157,7 +176,7 @@ def _attention(cfg: DecoderConfig, lp, h, k_cache, v_cache, pos, layer_window):
     Smax = k_cache.shape[1]
     scale = cfg.attn_scale if cfg.attn_scale is not None else 1.0 / np.sqrt(D)
 
-    if S == 1 and cfg.pos_emb != "alibi" and not any(cfg.local_windows):
+    if S == 1 and KV == H and cfg.pos_emb != "alibi" and not any(cfg.local_windows):
         # single-token decode without score biasing: route through the
         # decode-attention dispatch (Pallas online-softmax kernel on TPU,
         # jnp fallback) — RoPE is already applied pre-cache so the kernel
@@ -171,9 +190,19 @@ def _attention(cfg: DecoderConfig, lp, h, k_cache, v_cache, pos, layer_window):
             out = out + lp["bo"]
         return out, k_cache, v_cache
 
-    scores = jnp.einsum(
-        "bshd,bthd->bhst", q.astype(jnp.float32), k_cache.astype(jnp.float32)
-    ) * scale
+    if KV != H:
+        # grouped-query scores without materializing a repeated cache: the
+        # kv head is a shared contraction group (HBM traffic stays at KV)
+        rep = H // KV
+        qg = q.reshape(B, S, KV, rep, D)
+        scores = jnp.einsum(
+            "bsgrd,btgd->bgrst", qg.astype(jnp.float32), k_cache.astype(jnp.float32)
+        ) * scale
+        scores = scores.reshape(B, H, S, Smax)
+    else:
+        scores = jnp.einsum(
+            "bshd,bthd->bhst", q.astype(jnp.float32), k_cache.astype(jnp.float32)
+        ) * scale
 
     j_idx = jnp.arange(Smax)
     i_idx = pos + jnp.arange(S)
@@ -190,7 +219,11 @@ def _attention(cfg: DecoderConfig, lp, h, k_cache, v_cache, pos, layer_window):
         scores = scores + slopes[None, :, None, None] * j_idx[None, None, None, :]
     scores = jnp.where(mask[None, None, :, :], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
-    o = jnp.einsum("bhst,bthd->bshd", probs, v_cache).reshape(B, S, E).astype(h.dtype)
+    if KV != H:
+        pg = probs.reshape(B, KV, rep, S, Smax)
+        o = jnp.einsum("bgrst,btgd->bsgrd", pg, v_cache).reshape(B, S, E).astype(h.dtype)
+    else:
+        o = jnp.einsum("bhst,bthd->bshd", probs, v_cache).reshape(B, S, E).astype(h.dtype)
     out = o @ _deq(lp["wo"], o.dtype)
     if lp.get("bo") is not None:
         out = out + lp["bo"]
@@ -198,6 +231,11 @@ def _attention(cfg: DecoderConfig, lp, h, k_cache, v_cache, pos, layer_window):
 
 
 def _mlp(cfg: DecoderConfig, lp, x):
+    if cfg.mlp_type == "swiglu":
+        # LLaMA FFN: silu(x @ gate) * (x @ up) @ down — no biases
+        g = jax.nn.silu(x @ _deq(lp["fc_gate_w"], x.dtype))
+        y = g * (x @ _deq(lp["fc_in_w"], x.dtype))
+        return y @ _deq(lp["fc_out_w"], y.dtype)
     y = x @ _deq(lp["fc_in_w"], x.dtype)
     if lp.get("fc_in_b") is not None:
         y = y + lp["fc_in_b"]
@@ -210,14 +248,13 @@ def _mlp(cfg: DecoderConfig, lp, x):
 
 def _block(cfg: DecoderConfig, lp, h, k_c, v_c, pos, window):
     eps = cfg.layer_norm_epsilon
-    ln1 = _ln(h, lp["ln_1"]["scale"], lp["ln_1"]["bias"], eps)
+    ln1 = _norm(cfg, h, lp["ln_1"], eps)
     a, k_c, v_c = _attention(cfg, lp["attn"], ln1, k_c, v_c, pos, window)
     if cfg.parallel_residual:
-        mlp_in = ln1 if not cfg.use_ln2 else _ln(h, lp["ln_2"]["scale"], lp["ln_2"]["bias"], eps)
+        mlp_in = ln1 if not cfg.use_ln2 else _norm(cfg, h, lp["ln_2"], eps)
         return h + a + _mlp(cfg, lp["mlp"], mlp_in), k_c, v_c
     h = h + a
-    ln2 = _ln(h, lp["ln_2"]["scale"], lp["ln_2"]["bias"], eps)
-    return h + _mlp(cfg, lp["mlp"], ln2), k_c, v_c
+    return h + _mlp(cfg, lp["mlp"], _norm(cfg, h, lp["ln_2"], eps)), k_c, v_c
 
 
 # ---------------------------------------------------------------------------
@@ -231,7 +268,7 @@ def _embed(cfg: DecoderConfig, params, input_ids, pos):
         positions = pos + jnp.arange(S) + cfg.pos_offset
         h = h + params["wpe"][positions][None, :, :]
     if cfg.embed_ln:
-        h = _ln(h, params["emb_ln"]["scale"], params["emb_ln"]["bias"], cfg.layer_norm_epsilon)
+        h = _norm(cfg, h, params["emb_ln"], cfg.layer_norm_epsilon)
     return h
 
 
@@ -263,7 +300,7 @@ def forward_cached(cfg: DecoderConfig, params, input_ids, cache: KVCache):
         return h, (k_c, v_c)
 
     h, (new_k, new_v) = lax.scan(body, h, (params["blocks"], cache.k, cache.v, _windows(cfg)))
-    h = _ln(h[:, -1], params["ln_f"]["scale"], params["ln_f"]["bias"], cfg.layer_norm_epsilon)
+    h = _norm(cfg, h[:, -1], params["ln_f"], cfg.layer_norm_epsilon)
     return _head(cfg, params, h), KVCache(new_k, new_v, pos + input_ids.shape[1])
 
 
@@ -271,7 +308,7 @@ def hidden(cfg: DecoderConfig, params, input_ids, train: bool = False, rng=None)
     """Full-sequence final-LN hidden states [B,S,E] (pre-head trunk)."""
     B, S = input_ids.shape
     h = _embed(cfg, params, input_ids, 0)
-    k0 = jnp.zeros((cfg.n_layer, B, S, cfg.n_head, cfg.head_dim), h.dtype)
+    k0 = jnp.zeros((cfg.n_layer, B, S, cfg.kv_heads, cfg.head_dim), h.dtype)
 
     def body(carry, xs):
         h = carry
@@ -280,7 +317,7 @@ def hidden(cfg: DecoderConfig, params, input_ids, train: bool = False, rng=None)
         return h, None
 
     h, _ = lax.scan(body, h, (params["blocks"], k0, k0, _windows(cfg)))
-    return _ln(h, params["ln_f"]["scale"], params["ln_f"]["bias"], cfg.layer_norm_epsilon)
+    return _norm(cfg, h, params["ln_f"], cfg.layer_norm_epsilon)
 
 
 def forward(cfg: DecoderConfig, params, input_ids, train: bool = False, rng=None):
@@ -345,6 +382,8 @@ def logical_axes(cfg: DecoderConfig) -> PyTree:
     mlp = {
         "fc_in_w": ("layers", "embed", "mlp"), "fc_in_b": ("layers", "mlp"),
         "fc_out_w": ("layers", "mlp", "embed"), "fc_out_b": ("layers", "embed"),
+        # swiglu gate (LLaMA): column-parallel like fc_in
+        "fc_gate_w": ("layers", "embed", "mlp"),
     }
     ln = {"scale": ("layers", "embed"), "bias": ("layers", "embed")}
     axes = {

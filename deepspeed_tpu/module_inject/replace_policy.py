@@ -484,6 +484,70 @@ class HFBertLayerPolicy(DSPolicy):
         return "bert", cfg, params
 
 
+class HFLlamaLayerPolicy(DSPolicy):
+    """transformers LlamaForCausalLM / MistralForCausalLM → unified decoder
+    with RMSNorm + SwiGLU + GQA + neox-style RoPE. Beyond the reference
+    snapshot's zoo (its newest arch is BLOOM); Mistral adds a sliding
+    window, mapped onto the decoder's per-layer ``local_windows``."""
+
+    # bare LlamaModel/MistralModel are excluded: without lm_head the
+    # serving conversion would be incomplete
+    hf_class_names = ("LlamaForCausalLM", "MistralForCausalLM")
+
+    @classmethod
+    def convert(cls, hf_model):
+        from ..models.decoder import DecoderConfig
+
+        hc = hf_model.config
+        t = hf_model.model if hasattr(hf_model, "model") else hf_model
+        E = hc.hidden_size
+        L = hc.num_hidden_layers
+        window = int(getattr(hc, "sliding_window", 0) or 0)
+        cfg = DecoderConfig(
+            vocab_size=hc.vocab_size,
+            n_positions=hc.max_position_embeddings,
+            n_embd=E,
+            n_layer=L,
+            n_head=hc.num_attention_heads,
+            ffn_dim=hc.intermediate_size,
+            pos_emb="rope",
+            rope_style="neox",
+            rope_theta=float(getattr(hc, "rope_theta", 10000.0)),
+            norm="rmsnorm",
+            mlp_type="swiglu",
+            n_kv_head=int(getattr(hc, "num_key_value_heads", hc.num_attention_heads)),
+            tie_embeddings=bool(getattr(hc, "tie_word_embeddings", False)),
+            layer_norm_epsilon=hc.rms_norm_eps,
+            local_windows=(window,) * L if window else (),
+        )
+
+        def get(l):
+            return {
+                "ln_1": {"scale": _t(l.input_layernorm.weight)},
+                "ln_2": {"scale": _t(l.post_attention_layernorm.weight)},
+                "attn": {
+                    "wq": _linear_w(l.self_attn.q_proj),
+                    "wk": _linear_w(l.self_attn.k_proj),
+                    "wv": _linear_w(l.self_attn.v_proj),
+                    "wo": _linear_w(l.self_attn.o_proj),
+                },
+                "mlp": {
+                    "fc_gate_w": _linear_w(l.mlp.gate_proj),
+                    "fc_in_w": _linear_w(l.mlp.up_proj),
+                    "fc_out_w": _linear_w(l.mlp.down_proj),
+                },
+            }
+
+        params = {
+            "wte": _t(t.embed_tokens.weight),
+            "ln_f": {"scale": _t(t.norm.weight)},
+            "blocks": _tree_stack([get(l) for l in t.layers]),
+        }
+        if not cfg.tie_embeddings:
+            params["lm_head_w"] = _linear_w(hf_model.lm_head)
+        return "decoder", cfg, params
+
+
 POLICY_REGISTRY: List[type] = [
     HFGPT2LayerPolicy,
     HFOPTLayerPolicy,
@@ -491,6 +555,7 @@ POLICY_REGISTRY: List[type] = [
     HFGPTJLayerPolicy,
     HFGPTNEOLayerPolicy,
     GPTNEOXLayerPolicy,
+    HFLlamaLayerPolicy,
     HFBertLayerPolicy,
 ]
 

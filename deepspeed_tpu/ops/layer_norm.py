@@ -19,3 +19,11 @@ def layer_norm(x, scale, bias, eps):
     v = jnp.var(xf, axis=-1, keepdims=True)
     y = (xf - m) * lax.rsqrt(v + eps)
     return (y * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def rms_norm(x, scale, eps):
+    """RMSNorm (no mean subtraction, no bias) with fp32 statistics — the
+    LLaMA-family normalization."""
+    xf = x.astype(jnp.float32)
+    y = xf * lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (y * scale.astype(jnp.float32)).astype(x.dtype)
