@@ -201,6 +201,12 @@ class InferenceEngine:
             )
             self.quantized = quantize_bits == 8
         if kind is not None:
+            if kind == "decoder" and getattr(mcfg, "mlp_type", "") == "moe_swiglu":
+                # thread the serving mesh into the MoE layer so tp token
+                # de-dup (moe/mappings.py) engages under mp_size > 1
+                import dataclasses
+
+                mcfg = dataclasses.replace(mcfg, mesh=mesh)
             self.model_config = mcfg
             if kind == "gpt2":
                 from ..models import gpt2 as m_mod

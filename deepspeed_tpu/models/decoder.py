@@ -60,9 +60,18 @@ class DecoderConfig:
     local_windows: Tuple[int, ...] = ()  # per-layer window, 0 = global (GPT-Neo)
     # LLaMA-family axes (beyond the reference snapshot's zoo):
     norm: str = "layernorm"  # layernorm | rmsnorm (rmsnorm params: scale only)
-    mlp_type: str = "dense"  # dense | swiglu (adds fc_gate_w)
+    mlp_type: str = "dense"  # dense | swiglu (adds fc_gate_w) | moe_swiglu (Mixtral)
     n_kv_head: Optional[int] = None  # grouped-query attention; None → n_head
     rope_theta: float = 10000.0
+    # mlp_type="moe_swiglu": per-layer expert-parallel SwiGLU FFN
+    # (moe/sharded_moe.py). Routing is Mixtral-exact in eval mode: top-2
+    # argmax second expert, no token dropping, weights g_i/sum(topk g).
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_aux_loss_weight: float = 0.01
+    # mesh enables tp token de-duplication inside the MoE layer
+    # (moe/mappings.py); the inference engine threads its mesh in here
+    mesh: Any = None
     # >0: chunked LM cross-entropy (models/lm_loss.py) — at BLOOM-class
     # vocabs (250k) the full [B,S,V] logits dwarf every other activation
     ce_chunk: int = 0
@@ -230,12 +239,23 @@ def _attention(cfg: DecoderConfig, lp, h, k_cache, v_cache, pos, layer_window):
     return out, k_cache, v_cache
 
 
-def _mlp(cfg: DecoderConfig, lp, x):
+def _mlp(cfg: DecoderConfig, lp, x, train: bool = False, rng=None):
+    """Returns (out, aux_loss) — aux is 0 except for the MoE FFN."""
+    if cfg.mlp_type == "moe_swiglu":
+        from ..moe.sharded_moe import MoEConfig, moe_mlp
+
+        mcfg = MoEConfig(
+            num_experts=cfg.moe_experts, k=cfg.moe_top_k,
+            drop_tokens=False, use_rts=False, second_policy="argmax",
+        )
+        deq = {k: _deq(v, x.dtype) for k, v in lp.items()}
+        out, aux = moe_mlp(deq, x, mcfg, rng=rng, train=train, mesh=cfg.mesh)
+        return out, aux
     if cfg.mlp_type == "swiglu":
         # LLaMA FFN: silu(x @ gate) * (x @ up) @ down — no biases
         g = jax.nn.silu(x @ _deq(lp["fc_gate_w"], x.dtype))
         y = g * (x @ _deq(lp["fc_in_w"], x.dtype))
-        return y @ _deq(lp["fc_out_w"], y.dtype)
+        return y @ _deq(lp["fc_out_w"], y.dtype), jnp.float32(0.0)
     y = x @ _deq(lp["fc_in_w"], x.dtype)
     if lp.get("fc_in_b") is not None:
         y = y + lp["fc_in_b"]
@@ -243,18 +263,20 @@ def _mlp(cfg: DecoderConfig, lp, x):
     y = y @ _deq(lp["fc_out_w"], y.dtype)
     if lp.get("fc_out_b") is not None:
         y = y + lp["fc_out_b"]
-    return y
+    return y, jnp.float32(0.0)
 
 
-def _block(cfg: DecoderConfig, lp, h, k_c, v_c, pos, window):
+def _block(cfg: DecoderConfig, lp, h, k_c, v_c, pos, window, train: bool = False, rng=None):
     eps = cfg.layer_norm_epsilon
     ln1 = _norm(cfg, h, lp["ln_1"], eps)
     a, k_c, v_c = _attention(cfg, lp["attn"], ln1, k_c, v_c, pos, window)
     if cfg.parallel_residual:
         mlp_in = ln1 if not cfg.use_ln2 else _norm(cfg, h, lp["ln_2"], eps)
-        return h + a + _mlp(cfg, lp["mlp"], mlp_in), k_c, v_c
+        m, aux = _mlp(cfg, lp["mlp"], mlp_in, train, rng)
+        return h + a + m, k_c, v_c, aux
     h = h + a
-    return h + _mlp(cfg, lp["mlp"], _norm(cfg, h, lp["ln_2"], eps)), k_c, v_c
+    m, aux = _mlp(cfg, lp["mlp"], _norm(cfg, h, lp["ln_2"], eps), train, rng)
+    return h + m, k_c, v_c, aux
 
 
 # ---------------------------------------------------------------------------
@@ -296,7 +318,7 @@ def forward_cached(cfg: DecoderConfig, params, input_ids, cache: KVCache):
     def body(carry, xs):
         h = carry
         lp, k_c, v_c, window = xs
-        h, k_c, v_c = _block(cfg, lp, h, k_c, v_c, pos, window)
+        h, k_c, v_c, _aux = _block(cfg, lp, h, k_c, v_c, pos, window)
         return h, (k_c, v_c)
 
     h, (new_k, new_v) = lax.scan(body, h, (params["blocks"], cache.k, cache.v, _windows(cfg)))
@@ -305,24 +327,38 @@ def forward_cached(cfg: DecoderConfig, params, input_ids, cache: KVCache):
 
 
 def hidden(cfg: DecoderConfig, params, input_ids, train: bool = False, rng=None):
-    """Full-sequence final-LN hidden states [B,S,E] (pre-head trunk)."""
+    """Full-sequence final-LN hidden states [B,S,E] (pre-head trunk).
+    Returns (h, moe_aux_sum)."""
     B, S = input_ids.shape
     h = _embed(cfg, params, input_ids, 0)
     k0 = jnp.zeros((cfg.n_layer, B, S, cfg.kv_heads, cfg.head_dim), h.dtype)
+    keys = (
+        jax.random.split(rng, cfg.n_layer)
+        if (rng is not None and train and cfg.mlp_type == "moe_swiglu")
+        else None
+    )
 
     def body(carry, xs):
-        h = carry
-        lp, k_c, v_c, window = xs
-        h, _, _ = _block(cfg, lp, h, k_c, v_c, 0, window)
-        return h, None
+        h, aux_sum = carry
+        if keys is not None:
+            lp, k_c, v_c, window, key = xs
+        else:
+            lp, k_c, v_c, window = xs
+            key = None
+        h, _, _, aux = _block(cfg, lp, h, k_c, v_c, 0, window, train, key)
+        return (h, aux_sum + aux), None
 
-    h, _ = lax.scan(body, h, (params["blocks"], k0, k0, _windows(cfg)))
-    return _norm(cfg, h, params["ln_f"], cfg.layer_norm_epsilon)
+    xs = (params["blocks"], k0, k0, _windows(cfg))
+    if keys is not None:
+        xs = xs + (keys,)
+    (h, aux), _ = lax.scan(body, (h, jnp.float32(0.0)), xs)
+    return _norm(cfg, h, params["ln_f"], cfg.layer_norm_epsilon), aux
 
 
 def forward(cfg: DecoderConfig, params, input_ids, train: bool = False, rng=None):
     """Full-sequence logits [B,S,V] (training/eval path, no cache)."""
-    return _head(cfg, params, hidden(cfg, params, input_ids, train=train, rng=rng))
+    h, _aux = hidden(cfg, params, input_ids, train=train, rng=rng)
+    return _head(cfg, params, h)
 
 
 def generate(
@@ -379,12 +415,20 @@ def logical_axes(cfg: DecoderConfig) -> PyTree:
         "bq": ("layers", "heads"), "bk": ("layers", "heads"),
         "bv": ("layers", "heads"), "bo": ("layers", "embed"),
     }
-    mlp = {
-        "fc_in_w": ("layers", "embed", "mlp"), "fc_in_b": ("layers", "mlp"),
-        "fc_out_w": ("layers", "mlp", "embed"), "fc_out_b": ("layers", "embed"),
-        # swiglu gate (LLaMA): column-parallel like fc_in
-        "fc_gate_w": ("layers", "embed", "mlp"),
-    }
+    if cfg.mlp_type == "moe_swiglu":
+        from ..moe.sharded_moe import moe_mlp_logical_axes
+
+        mlp = {
+            k: ("layers",) + tuple(v)
+            for k, v in moe_mlp_logical_axes(swiglu=True).items()
+        }
+    else:
+        mlp = {
+            "fc_in_w": ("layers", "embed", "mlp"), "fc_in_b": ("layers", "mlp"),
+            "fc_out_w": ("layers", "mlp", "embed"), "fc_out_b": ("layers", "embed"),
+            # swiglu gate (LLaMA): column-parallel like fc_in
+            "fc_gate_w": ("layers", "embed", "mlp"),
+        }
     ln = {"scale": ("layers", "embed"), "bias": ("layers", "embed")}
     axes = {
         "wte": ("vocab", "embed"),
@@ -405,11 +449,14 @@ def logical_axes(cfg: DecoderConfig) -> PyTree:
 def lm_loss(cfg: DecoderConfig, params, batch, rng, train: bool):
     from .lm_loss import head_token_loss
 
-    h = hidden(cfg, params, batch["input_ids"], train=train, rng=rng)
+    h, aux = hidden(cfg, params, batch["input_ids"], train=train, rng=rng)
     loss, _ntok = head_token_loss(
         lambda x: _head(cfg, params, x), h, batch, cfg.ce_chunk
     )
-    return loss, {}
+    # MoE load-balancing penalty shapes training only (gpt2.lm_loss parity)
+    if cfg.mlp_type == "moe_swiglu" and train:
+        loss = loss + cfg.moe_aux_loss_weight * aux
+    return loss, {"moe_aux": aux}
 
 
 def make_module(cfg: DecoderConfig) -> ModuleSpec:

@@ -548,6 +548,71 @@ class HFLlamaLayerPolicy(DSPolicy):
         return "decoder", cfg, params
 
 
+class HFMixtralLayerPolicy(DSPolicy):
+    """transformers MixtralForCausalLM → unified decoder with per-layer
+    SwiGLU MoE (top-2, no-drop eval routing — Mixtral-exact) + GQA +
+    RMSNorm. The expert dim shards over the ep mesh axis when served with
+    init_inference(ep_size=...)."""
+
+    hf_class_names = ("MixtralForCausalLM",)
+
+    @classmethod
+    def convert(cls, hf_model):
+        from ..models.decoder import DecoderConfig
+
+        hc = hf_model.config
+        t = hf_model.model
+        E, L = hc.hidden_size, hc.num_hidden_layers
+        window = int(getattr(hc, "sliding_window", 0) or 0)
+        cfg = DecoderConfig(
+            vocab_size=hc.vocab_size,
+            n_positions=hc.max_position_embeddings,
+            n_embd=E,
+            n_layer=L,
+            n_head=hc.num_attention_heads,
+            ffn_dim=hc.intermediate_size,
+            pos_emb="rope",
+            rope_style="neox",
+            rope_theta=float(getattr(hc, "rope_theta", 10000.0)),
+            norm="rmsnorm",
+            mlp_type="moe_swiglu",
+            moe_experts=hc.num_local_experts,
+            moe_top_k=hc.num_experts_per_tok,
+            n_kv_head=int(getattr(hc, "num_key_value_heads", hc.num_attention_heads)),
+            tie_embeddings=bool(getattr(hc, "tie_word_embeddings", False)),
+            layer_norm_epsilon=hc.rms_norm_eps,
+            local_windows=(window,) * L if window else (),
+        )
+
+        def get(l):
+            m = l.block_sparse_moe
+            return {
+                "ln_1": {"scale": _t(l.input_layernorm.weight)},
+                "ln_2": {"scale": _t(l.post_attention_layernorm.weight)},
+                "attn": {
+                    "wq": _linear_w(l.self_attn.q_proj),
+                    "wk": _linear_w(l.self_attn.k_proj),
+                    "wv": _linear_w(l.self_attn.v_proj),
+                    "wo": _linear_w(l.self_attn.o_proj),
+                },
+                "mlp": {
+                    "gate_w": _linear_w(m.gate),  # router [E_model, X]
+                    "w_gate": _stack([_linear_w(x.w1) for x in m.experts]),
+                    "w_in": _stack([_linear_w(x.w3) for x in m.experts]),
+                    "w_out": _stack([_linear_w(x.w2) for x in m.experts]),
+                },
+            }
+
+        params = {
+            "wte": _t(t.embed_tokens.weight),
+            "ln_f": {"scale": _t(t.norm.weight)},
+            "blocks": _tree_stack([get(l) for l in t.layers]),
+        }
+        if not cfg.tie_embeddings:
+            params["lm_head_w"] = _linear_w(hf_model.lm_head)
+        return "decoder", cfg, params
+
+
 POLICY_REGISTRY: List[type] = [
     HFGPT2LayerPolicy,
     HFOPTLayerPolicy,
@@ -556,6 +621,7 @@ POLICY_REGISTRY: List[type] = [
     HFGPTNEOLayerPolicy,
     GPTNEOXLayerPolicy,
     HFLlamaLayerPolicy,
+    HFMixtralLayerPolicy,
     HFBertLayerPolicy,
 ]
 

@@ -132,9 +132,15 @@ def top2_gating(
     "random"`` and rng is given (reference :297 gumbel_rsample), else argmax.
     ``drop_tokens=False`` lifts capacity to the static no-drop bound 2T."""
     T, E = logits.shape
-    C = min(_capacity(T, E, 2 * capacity_factor, min_capacity), 2 * T)
+    C = min(_capacity(T, E, 2 * capacity_factor, min_capacity), T)
     if not drop_tokens:
-        C = 2 * T  # both assignments of every token always fit
+        # top-2 picks two DISTINCT experts per token, so any single expert
+        # receives at most T assignments across both choices — C = T is the
+        # tight static no-drop bound (not 2T). NOTE: the einsum dispatch is
+        # O(T·E·C·M); at no-drop this is quadratic in T — fine for decode
+        # steps and moderate prefills, long-prefill serving should chunk the
+        # sequence through the MoE layer.
+        C = T
     gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
 
     idx1 = jnp.argmax(gates, axis=-1)
@@ -201,14 +207,18 @@ def init_moe_mlp_params(rng, d_model: int, d_hidden: int, num_experts: int, dtyp
     }
 
 
-def moe_mlp_logical_axes() -> PyTree:
-    return {
+def moe_mlp_logical_axes(swiglu: bool = False) -> PyTree:
+    axes = {
         "gate_w": ("embed", None),
         "w_in": ("expert", "embed", "expert_mlp"),
         "b_in": ("expert", "expert_mlp"),
         "w_out": ("expert", "expert_mlp", "embed"),
         "b_out": ("expert", "embed"),
     }
+    if swiglu:
+        axes["w_gate"] = ("expert", "embed", "expert_mlp")
+        axes.pop("b_in"), axes.pop("b_out")  # SwiGLU experts carry no biases
+    return axes
 
 
 def moe_mlp(
@@ -257,8 +267,18 @@ def moe_mlp(
     dtype = x.dtype
     # dispatch: [T,E,C] x [T,M] -> [E,C,M]   (ICI all-to-all happens here)
     expert_in = jnp.einsum("tec,tm->ecm", dispatch.astype(dtype), xt)
-    h = activation(jnp.einsum("ecm,emh->ech", expert_in, params["w_in"]) + params["b_in"][:, None, :])
-    expert_out = jnp.einsum("ech,ehm->ecm", h, params["w_out"]) + params["b_out"][:, None, :]
+    if "w_gate" in params:
+        # SwiGLU experts (Mixtral-style): silu(x @ w_gate) * (x @ w_in)
+        g = jax.nn.silu(jnp.einsum("ecm,emh->ech", expert_in, params["w_gate"]))
+        u = jnp.einsum("ecm,emh->ech", expert_in, params["w_in"])
+        if params.get("b_in") is not None:
+            u = u + params["b_in"][:, None, :]
+        h = g * u
+    else:
+        h = activation(jnp.einsum("ecm,emh->ech", expert_in, params["w_in"]) + params["b_in"][:, None, :])
+    expert_out = jnp.einsum("ech,ehm->ecm", h, params["w_out"])
+    if params.get("b_out") is not None:
+        expert_out = expert_out + params["b_out"][:, None, :]
     # combine: [T,E,C] x [E,C,M] -> [T,M]    (all-to-all back)
     out = jnp.einsum("tec,ecm->tm", combine.astype(dtype), expert_out)
     out = _gather_tp(out, mesh)
