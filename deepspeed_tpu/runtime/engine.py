@@ -1190,14 +1190,7 @@ class DeepSpeedEngine:
         if self.wall_clock_breakdown:
             self.timers(TRAIN_BATCH_TIMER).start()
         self.tput_timer.start()
-        if self.curriculum_scheduler is not None:
-            # truncate seqlen to the scheduled difficulty; difficulty rounds
-            # to difficulty_step multiples so the set of compiled shapes
-            # (jit cache entries) stays small
-            self.curriculum_scheduler.update_difficulty(self.global_steps)
-            batch = self.curriculum_scheduler.truncate_batch(batch)
-        if self.progressive_layer_drop is not None:
-            self.progressive_layer_drop.update_state(self.global_steps)
+        batch = self._prepare_batch(batch)
         device_batch = self.shard_batch(batch)
         # the standard jitted step folds global_step into the key in-graph;
         # the host-driven paths (offload/onebit/infinity) still need a fresh
@@ -1277,6 +1270,67 @@ class DeepSpeedEngine:
         log_dist(f"profiler trace written to {trace_dir}")
         return trace_dir
 
+    # ------------------------------------------------------------------
+    # reference-style forward/backward/step triple (migration shim)
+    # ------------------------------------------------------------------
+    def _prepare_batch(self, batch: PyTree) -> PyTree:
+        """Per-step host-side batch shaping shared by train_batch and the
+        forward/backward/step shim: curriculum seqlen truncation + PLD
+        schedule update (both idempotent for a repeated global_step)."""
+        if self.curriculum_scheduler is not None:
+            # truncate seqlen to the scheduled difficulty; difficulty rounds
+            # to difficulty_step multiples so the set of compiled shapes
+            # (jit cache entries) stays small
+            self.curriculum_scheduler.update_difficulty(self.global_steps)
+            batch = self.curriculum_scheduler.truncate_batch(batch)
+        if self.progressive_layer_drop is not None:
+            self.progressive_layer_drop.update_state(self.global_steps)
+        return batch
+
+    def forward(self, batch: PyTree):
+        """Reference-style ``loss = engine(batch)`` (engine.forward:1599).
+
+        Functional-engine migration shim: the batch is stashed (after the
+        same curriculum/PLD prep train_batch applies) and the loss comes
+        from a pure forward on a THROWAWAY key — the training RNG stream is
+        untouched, so a shim loop updates params exactly like a train_batch
+        loop. The fused fwd+bwd+update runs inside :meth:`step`. One extra
+        forward per step vs :meth:`train_batch` — prefer train_batch in new
+        code, and eval_batch/predict for pure evaluation (a stray
+        backward()+step() after an eval-style call would train on that
+        batch)."""
+        from ..utils.logging import warning_once
+
+        warning_once(
+            "engine.forward/backward/step emulates the reference loop with "
+            "one extra forward per step; engine.train_batch(batch) is the "
+            "efficient single-call form (eval_batch/predict for evaluation)"
+        )
+        batch = self._prepare_batch(batch)
+        self._pending_batch = batch
+        # derived, non-consuming key: folding a constant keeps self._rng
+        # (the training stream) byte-identical to a train_batch-only loop
+        return self.eval_batch(batch, rng=jax.random.fold_in(self._rng, 0x5EED))
+
+    __call__ = forward
+
+    def backward(self, loss=None):
+        """Reference engine.backward(loss):1852. Gradients are produced
+        inside the fused step (see :meth:`forward`); this validates call
+        order only."""
+        if getattr(self, "_pending_batch", None) is None:
+            raise RuntimeError("backward() requires a preceding engine.forward(batch)")
+        self._backward_called = True
+
+    def step(self):
+        """Reference engine.step:1990 — runs the fused train step on the
+        batch stashed by :meth:`forward`."""
+        if getattr(self, "_pending_batch", None) is None or not getattr(self, "_backward_called", False):
+            raise RuntimeError("step() requires engine.forward(batch) then engine.backward()")
+        batch, self._pending_batch = self._pending_batch, None
+        self._backward_called = False
+        return self.train_batch(batch)
+
     def comms_summary(self, measure: bool = False) -> str:
         """Account + print the compiled train step's collective mix
         (reference comm.log_summary, comms_logging.py:56).
@@ -1308,12 +1362,13 @@ class DeepSpeedEngine:
             dscomm.comms_logger.measure(self.mesh)
         return dscomm.log_summary()
 
-    def eval_batch(self, batch: PyTree) -> jnp.ndarray:
+    def eval_batch(self, batch: PyTree, rng=None) -> jnp.ndarray:
         device_batch = self.shard_batch(batch)
-        self._rng, step_rng = jax.random.split(self._rng)
+        if rng is None:
+            self._rng, rng = jax.random.split(self._rng)
         if self.param_offload_enabled:
-            return jnp.float32(self._infinity.eval_loss(device_batch, step_rng))
-        return self._eval_step(self.state.params, device_batch, step_rng)
+            return jnp.float32(self._infinity.eval_loss(device_batch, rng))
+        return self._eval_step(self.state.params, device_batch, rng)
 
     def predict(self, batch: PyTree):
         assert self._jit_apply is not None, "module has no apply_fn"
