@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -111,7 +112,120 @@ def attn_impl_used(cfg, micro: int, seq: int) -> str:
     return "pallas" if (cfg.attn_impl == "pallas" or _pallas_ok(q)) else "jnp"
 
 
+def _probe_backend(timeout_s: float) -> tuple[bool, str]:
+    """Probe accelerator liveness in a SUBPROCESS with a hard timeout.
+
+    The failure mode this guards (seen rounds 2-3) is the remote TPU plugin
+    hanging *inside* ``import jax`` / backend init — unrecoverable from the
+    hung process itself. A subprocess probe can be killed and retried. The
+    probe runs a tiny matmul, not just ``jax.devices()``: round 3's tunnel
+    once enumerated devices and then wedged on the first compute.
+    """
+    code = (
+        "import jax, jax.numpy as jnp;"
+        "x = jnp.ones((128, 128), jnp.bfloat16);"
+        "(x @ x).block_until_ready();"
+        "print('BENCH_PROBE_OK', jax.default_backend())"
+    )
+    # Popen rather than subprocess.run: run()'s timeout handler reaps the
+    # killed child with an UN-timed wait, which blocks forever if the child
+    # is wedged in uninterruptible (D-state) plugin I/O. Here a child that
+    # survives SIGKILL is abandoned after a bounded grace wait.
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        try:
+            proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass  # unkillable child: orphan it, keep the parent live
+        return False, f"probe timed out after {timeout_s:.0f}s (backend hang)"
+    if proc.returncode == 0 and "BENCH_PROBE_OK" in out:
+        return True, out.strip().split()[-1]
+    tail = (err or out or "").strip().splitlines()
+    return False, tail[-1][:300] if tail else f"rc={proc.returncode}"
+
+
+def _await_backend() -> tuple[bool, str, int]:
+    """Retry-with-backoff until the accelerator answers, or budget runs out.
+
+    Budget: BENCH_BACKEND_WAIT seconds total (default 1200 — round 3's tunnel
+    had a brief recovery window that a patient loop would have caught),
+    probing with BENCH_PROBE_TIMEOUT (default 150s, first remote compile is
+    slow) and sleeping 15s -> 30 -> 60 -> ... capped at 240 between attempts.
+    Returns (ok, platform_or_error, attempts). CPU runs skip the probe.
+    """
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() in ("cpu", "cpu,"):
+        return True, "cpu", 0
+    budget = float(os.environ.get("BENCH_BACKEND_WAIT", "1200"))
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "150"))
+    deadline = time.monotonic() + budget
+    attempts, sleep_s, msg = 0, 15.0, ""
+    while True:
+        attempts += 1
+        ok, msg = _probe_backend(probe_timeout)
+        if ok:
+            return True, msg, attempts
+        sys.stderr.write(f"[bench] backend probe {attempts} failed: {msg}\n")
+        if time.monotonic() + sleep_s >= deadline:
+            return False, msg, attempts
+        time.sleep(sleep_s)
+        sleep_s = min(sleep_s * 2, 240.0)
+
+
+def _emit_backend_error(msg: str, attempts: int) -> None:
+    # label from the same env the success path uses, so a consumer keying
+    # on the metric string files the failure under the right config. With
+    # BENCH_MODEL unset the label stays "auto": resolving it to a concrete
+    # preset needs a live backend (HBM size), which is exactly what's absent
+    model = os.environ.get("BENCH_MODEL", "auto")
+    seq = os.environ.get("BENCH_SEQ", "1024")
+    zero = os.environ.get("BENCH_ZERO", "3")
+    print(json.dumps({
+        "metric": f"tokens/sec/chip {model} seq{seq} zero{zero} bf16 (XL-equivalent vs A100)",
+        "value": 0.0,
+        "unit": "tokens/sec/chip",
+        "vs_baseline": 0.0,
+        "error": "backend_unavailable",
+        "error_detail": msg,
+        "probe_attempts": attempts,
+    }))
+
+
+def _arm_inproc_watchdog(attempts: int):
+    """A hang AFTER the probe passes (tunnel re-wedges under the real init or
+    the first remote compile) raises nothing in-process, so an except clause
+    can't save the JSON line. A daemon timer emits the structured error and
+    hard-exits instead. Returns a disarm() to call once real compute finished.
+    Budget: first remote compile of a full train step can take 10-15 min."""
+    import threading
+
+    budget = float(os.environ.get("BENCH_INPROC_WATCHDOG", "2400"))
+
+    def _fire():
+        _emit_backend_error(
+            f"in-process hang: no completed train step within {budget:.0f}s "
+            "of a successful probe (backend re-wedged)", attempts)
+        sys.stdout.flush()
+        os._exit(0)
+
+    t = threading.Timer(budget, _fire)
+    t.daemon = True
+    t.start()
+    return t.cancel
+
+
 def main():
+    ok, platform, attempts = _await_backend()
+    if not ok:
+        _emit_backend_error(platform, attempts)
+        return
+    disarm_watchdog = _arm_inproc_watchdog(attempts)
+
     import jax
 
     from deepspeed_tpu.utils.jax_env import honor_jax_platforms
@@ -150,6 +264,10 @@ def main():
             ladder.append((c, True))
     for name, remat in ladder:
         try:
+            # fresh watchdog window per rung: each OOM fallback pays its own
+            # (slow, remote) compile; a hang inside any rung still trips it
+            disarm_watchdog()
+            disarm_watchdog = _arm_inproc_watchdog(attempts)
             cfg, engine = build_engine(name, seq, micro, n_dev, zero_stage, remat=remat)
             rs = np.random.RandomState(0)
             batch = {
@@ -167,6 +285,11 @@ def main():
             if (name, remat) == ladder[-1]:
                 raise
     assert engine is not None, tried
+    # a real step completed, but later phases still compile fresh programs
+    # (device-only K-step scan, cost_analysis lower+compile) that can wedge
+    # the same way: re-arm one window spanning the measurement phase
+    disarm_watchdog()
+    disarm_watchdog = _arm_inproc_watchdog(attempts)
 
     m = engine.train_batch(batch)  # warmup step 1
     jax.block_until_ready(m["loss"])
@@ -299,6 +422,7 @@ def main():
         result["profile_dir"] = prof_dir
     if tried:
         result["oom_fallbacks"] = tried
+    disarm_watchdog()  # measurements done: nothing left that can wedge
     print(json.dumps(result))
 
 
