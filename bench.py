@@ -196,27 +196,43 @@ def _emit_backend_error(msg: str, attempts: int) -> None:
     }))
 
 
-def _arm_inproc_watchdog(attempts: int):
+def _arm_inproc_watchdog(attempts: int, budget: float = None):
     """A hang AFTER the probe passes (tunnel re-wedges under the real init or
     the first remote compile) raises nothing in-process, so an except clause
     can't save the JSON line. A daemon timer emits the structured error and
     hard-exits instead. Returns a disarm() to call once real compute finished.
-    Budget: first remote compile of a full train step can take 10-15 min."""
+
+    Disarm is atomic (lock + flag): once disarm() returns, the timer can
+    never print — the script's one-JSON-line contract holds even if the
+    deadline races the final result assembly. Default budget: first remote
+    compile of a full train step can take 10-15 min."""
     import threading
 
-    budget = float(os.environ.get("BENCH_INPROC_WATCHDOG", "2400"))
+    if budget is None:
+        budget = float(os.environ.get("BENCH_INPROC_WATCHDOG", "2400"))
+    lock = threading.Lock()
+    disarmed = []
 
     def _fire():
-        _emit_backend_error(
-            f"in-process hang: no completed train step within {budget:.0f}s "
-            "of a successful probe (backend re-wedged)", attempts)
-        sys.stdout.flush()
-        os._exit(0)
+        with lock:
+            if disarmed:
+                return
+            _emit_backend_error(
+                f"in-process hang: no completed train step within {budget:.0f}s "
+                "of a successful probe (backend re-wedged)", attempts)
+            sys.stdout.flush()
+            os._exit(0)
 
     t = threading.Timer(budget, _fire)
     t.daemon = True
     t.start()
-    return t.cancel
+
+    def disarm():
+        with lock:
+            disarmed.append(True)
+        t.cancel()
+
+    return disarm
 
 
 def main():
@@ -287,9 +303,15 @@ def main():
     assert engine is not None, tried
     # a real step completed, but later phases still compile fresh programs
     # (device-only K-step scan, cost_analysis lower+compile) that can wedge
-    # the same way: re-arm one window spanning the measurement phase
+    # the same way: re-arm one window spanning the measurement phase. The
+    # budget scales with the work it covers (~4x steps train steps at a
+    # generous 30s/step, plus two fresh compiles) so a long healthy run is
+    # never misreported as a hang.
     disarm_watchdog()
-    disarm_watchdog = _arm_inproc_watchdog(attempts)
+    measure_budget = float(
+        os.environ.get("BENCH_INPROC_WATCHDOG", str(2400 + 4 * steps * 30))
+    )
+    disarm_watchdog = _arm_inproc_watchdog(attempts, budget=measure_budget)
 
     m = engine.train_batch(batch)  # warmup step 1
     jax.block_until_ready(m["loss"])

@@ -67,7 +67,7 @@ def test_pipeline_apply_grads_match(devices):
     np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=1e-4, rtol=1e-3)
 
 
-def _gpt2_losses(mesh, dp, pp_mode, steps=3):
+def _gpt2_losses(mesh, dp, pp_mode, steps=3, ds_extra=None):
     cfg = gpt2.get_config("gpt2-tiny", n_layer=4)
     module = gpt2.make_module(cfg)
     ds = DeepSpeedConfig.load(
@@ -76,6 +76,7 @@ def _gpt2_losses(mesh, dp, pp_mode, steps=3):
             "gradient_accumulation_steps": 4,
             "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
             "steps_per_print": 1000,
+            **(ds_extra or {}),
         },
         dp_world_size=dp,
     )
@@ -91,6 +92,49 @@ def test_gpt2_pipeline_parity(devices, mesh_single):
     pipe = _gpt2_losses(mesh_pp, dp=2, pp_mode=True)
     base = _gpt2_losses(mesh_single, dp=1, pp_mode=False)
     np.testing.assert_allclose(pipe, base, rtol=3e-4)
+
+
+@pytest.mark.parametrize("stage", [1, 3])
+def test_gpt2_pipeline_parity_with_zero(devices, mesh_single, stage):
+    """pp composed with ZeRO: the standard Megatron-DeepSpeed layout is
+    pp + ZeRO-1 (reference runtime/bf16_optimizer.py:35 partitions optimizer
+    state under pp); ZeRO-3 additionally shards params over dp on top of the
+    layer-stacked pp sharding. Loss trajectory must match single-device."""
+    zero = {"zero_optimization": {"stage": stage, "stage3_param_persistence_threshold": 0}}
+    mesh_pp = MeshSpec(dp=2, pp=4).build_mesh()
+    pipe = _gpt2_losses(mesh_pp, dp=2, pp_mode=True, ds_extra=zero)
+    base = _gpt2_losses(mesh_single, dp=1, pp_mode=False, ds_extra=zero)
+    np.testing.assert_allclose(pipe, base, rtol=3e-4)
+
+
+def test_gpt2_3d_mesh_parity(devices, mesh_single):
+    """dp×tp×pp together (reference PipeModelDataParallelTopology,
+    pipe/topology.py:243) + ZeRO-1: the full 3D layout on one mesh."""
+    mesh_3d = MeshSpec(dp=2, tp=2, pp=2).build_mesh()
+    zero = {"zero_optimization": {"stage": 1}}
+    three_d = _gpt2_losses(mesh_3d, dp=2, pp_mode=True, ds_extra=zero)
+    base = _gpt2_losses(mesh_single, dp=1, pp_mode=False, ds_extra=zero)
+    np.testing.assert_allclose(three_d, base, rtol=3e-4)
+
+
+def test_gpt2_3d_mesh_param_layout(devices):
+    """On dp2×tp2×pp2 a stacked attention weight must carry pp (layer dim)
+    AND tp (head dim); ZeRO-3 then adds dp on a remaining free dim."""
+    mesh_3d = MeshSpec(dp=2, tp=2, pp=2).build_mesh()
+    cfg = gpt2.get_config("gpt2-tiny", n_layer=4)
+    module = gpt2.make_module(cfg)
+    ds = DeepSpeedConfig.load(
+        {
+            "train_micro_batch_size_per_gpu": 4,
+            "gradient_accumulation_steps": 4,
+            "zero_optimization": {"stage": 3, "stage3_param_persistence_threshold": 0},
+            "steps_per_print": 1000,
+        },
+        dp_world_size=2,
+    )
+    engine = DeepSpeedEngine(module, ds, mesh=mesh_3d, seed=0)
+    spec = str(engine.state.params["blocks"]["attn"]["c_attn_w"].sharding.spec)
+    assert "pp" in spec and "tp" in spec, spec
 
 
 def test_pipeline_dropout_active(devices):
