@@ -219,17 +219,10 @@ class DeepSpeedEngine:
                 "zero_optimization.offload_param requires a model exposing a "
                 "block API (ModuleSpec.extra['block_api'])"
             )
-        if config.fp16.enabled:
-            raise ValueError("offload_param supports bf16/fp32 (no fp16 loss scaling)")
         if zcfg.stage != 3:
             raise ValueError(
                 "offload_param requires ZeRO stage 3 (reference: param offload "
                 "is a stage-3 feature, zero/config.py)"
-            )
-        if any(mesh_axis_size(self.mesh, ax) > 1 for ax in self.mesh.axis_names):
-            raise ValueError(
-                "offload_param streams blocks on a single chip per host; "
-                "use a 1-device mesh (dp composes at the host level)"
             )
         offp = zcfg.offload_param
         off = zcfg.offload_optimizer
@@ -255,6 +248,7 @@ class DeepSpeedEngine:
             initial_params=params,
             trace_validator=trace_validator,
             aio_config=config.aio,
+            mesh=self.mesh,
         )
         self.offload_enabled = False
         self._offload = None
@@ -285,6 +279,21 @@ class DeepSpeedEngine:
         self._train_step = self._infinity_dispatch
         self._train_step_folds_rng = False
         self._eval_step = None  # eval_batch routes through the streamed sweep
+        if self.fp16_enabled:
+            # fp16 dynamic loss scale on the streamed path (reference
+            # stage3.py:2052 — backward under the loss scaler with swappers
+            # active): the scale rides into each micro-sweep's head, the
+            # host tier sees scaled grads and skips on overflow
+            import functools
+
+            self._scale_update = jax.jit(
+                functools.partial(
+                    ls.update,
+                    dynamic=self.dynamic_loss_scale,
+                    scale_window=config.fp16.loss_scale_window,
+                    min_scale=config.fp16.min_loss_scale,
+                )
+            )
 
     def _init_device_state(self, model, config, zcfg, seed, params, opt_cfg) -> None:
         """Standard path: params + optimizer state live on device (sharded)."""
@@ -797,20 +806,41 @@ class DeepSpeedEngine:
 
     def _infinity_dispatch(self, state: "TrainState", batch: PyTree, rng):
         """Block-streamed step: fwd/bwd sweeps fetch params per layer from
-        host/NVMe; host SIMD Adam updates the masters (zero/infinity.py)."""
-        out = self._infinity.train_step(batch, self.global_steps, rng)
+        host/NVMe; host SIMD Adam updates the masters (zero/infinity.py).
+        Under fp16, the dynamic loss scale multiplies each micro-sweep's
+        head loss in-graph; an overflow skips the host step entirely and
+        backs the scale off (same semantics as the offload/_make_train_step
+        paths; LR advances on APPLIED steps only)."""
+        scale = (
+            float(jax.device_get(state.loss_scale.cur_scale))
+            if self.fp16_enabled
+            else None
+        )
+        # LR from APPLIED steps: state.global_step only advances on applied
+        # (non-overflow) steps and is restored by load_checkpoint, so the
+        # schedule survives resume without a separate host counter
+        step = int(jax.device_get(state.global_step))
+        out = self._infinity.train_step(batch, step, rng, scale=scale)
+        overflow = bool(out.get("overflow", False))
+        new_scale_state = (
+            self._scale_update(state.loss_scale, jnp.bool_(overflow))
+            if self.fp16_enabled
+            else state.loss_scale
+        )
         new_state = TrainState(
             params=(),
             opt_state=(),
-            loss_scale=state.loss_scale,
-            global_step=state.global_step + 1,
-            skipped_steps=state.skipped_steps,
+            loss_scale=new_scale_state,
+            global_step=state.global_step + (0 if overflow else 1),
+            skipped_steps=state.skipped_steps + (1 if overflow else 0),
         )
         metrics = {
             "loss": jnp.float32(out["loss"]),
             "grad_norm": jnp.float32(out["grad_norm"]),
-            "loss_scale": jnp.float32(1.0),
-            "overflow": jnp.bool_(False),
+            "loss_scale": (
+                state.loss_scale.cur_scale if self.fp16_enabled else jnp.float32(1.0)
+            ),
+            "overflow": jnp.bool_(overflow),
             "lr": jnp.float32(out["lr"]),
             "global_step": new_state.global_step,
         }

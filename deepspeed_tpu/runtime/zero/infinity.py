@@ -108,15 +108,20 @@ def memory_math(
 
 
 class InfinityEngine:
-    """Single-chip (per-host) block-streaming train step.
+    """Block-streaming train step over any device mesh.
 
-    Scope (matches the engine's mesh check, runtime/engine.py
-    ``_init_param_offload``): one chip per host, targeting the BASELINE
-    single-chip capacity row ("OPT-13B on one chip"). Multi-host dp would
-    compose by sharding the batch per host and all-reducing the host-side
-    grad accumulators before the optimizer step — NOT implemented yet; the
-    engine rejects >1-device meshes rather than silently training divergent
-    replicas.
+    Single chip: blocks upload whole. Multi-device mesh (dp>1): each block
+    streams as ONE contiguous flat buffer *sharded over every mesh axis* —
+    each chip uploads only its 1/N slice of the block (H2D bandwidth divides
+    by N, the analog of the reference's per-rank NVMe partitions,
+    ``swap_tensor/partitioned_param_swapper.py:35``), XLA allgathers the
+    flat buffer in-graph where the block math needs it, and the block's
+    grads are reduce-scattered back to the same layout so each chip D2H
+    streams only its slice. The batch rides the ``dp`` axis (sharded by
+    ``engine.shard_batch``), making the grads global means; the host tier
+    (one controller process) then steps masters exactly as at dp=1 — the
+    single-controller formulation of the reference's per-rank swapper +
+    grad-reduce design (``stage3.py:465``).
     """
 
     def __init__(
@@ -135,10 +140,12 @@ class InfinityEngine:
         initial_params: Optional[PyTree] = None,
         trace_validator=None,
         aio_config=None,
+        mesh=None,
     ):
         assert device in ("cpu", "nvme"), device
         assert opt_device in ("cpu", "nvme"), opt_device
         self.api = api
+        self.mesh = mesh
         # debug mode: block fetch order must replay the recorded trace
         # (runtime/debug.BlockTraceValidator; reference coordinator.py:300-307);
         # only train-step fetches are traced (eval's fwd-only order differs)
@@ -149,6 +156,13 @@ class InfinityEngine:
         self.lr_schedule = lr_schedule
         self.clip = float(gradient_clipping)
         self.compute_dtype = compute_dtype
+        # host compute-copy dtype follows the engine's compute dtype: fp16
+        # configs store fp16 block copies (loss-scaled math end to end)
+        self._cdt = (
+            np.dtype(np.float16)
+            if jnp.dtype(compute_dtype) == jnp.float16
+            else _BF16
+        )
         self.opt = DeepSpeedCPUAdam(
             lr=1e-3, betas=betas, eps=eps, weight_decay=weight_decay, adamw_mode=True
         )
@@ -183,6 +197,20 @@ class InfinityEngine:
         self._blk_offsets = np.cumsum([0] + self._blk_sizes)
         self.block_numel = int(self._blk_offsets[-1])
 
+        # multi-device layout: flat block buffers shard over every mesh axis
+        # (padded to divide); persistent params replicate. None => 1-device.
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        n_mesh = int(np.prod(list(mesh.shape.values()))) if mesh is not None else 1
+        if mesh is not None and n_mesh > 1:
+            self._flat_sharding = NamedSharding(mesh, PartitionSpec(tuple(mesh.axis_names)))
+            self._repl_sharding = NamedSharding(mesh, PartitionSpec())
+            self._blk_pad = (-self.block_numel) % n_mesh
+        else:
+            self._flat_sharding = None
+            self._repl_sharding = None
+            self._blk_pad = 0
+
         # bf16 compute copies per block (DRAM or NVMe)
         self._param_swapper = None
         self._blk_bf16: List[Optional[np.ndarray]] = [None] * L
@@ -200,7 +228,7 @@ class InfinityEngine:
             # each swapper/stream gets its own C++ thread pool sized by the
             # ``aio`` config section (reference aio_config.py knobs)
             self._param_swapper = AsyncPartitionedParameterSwapper(
-                os.path.join(nvme_path, "infinity"), dtype=_BF16,
+                os.path.join(nvme_path, "infinity"), dtype=self._cdt,
                 aio_handle=AsyncIOHandle.from_config(aio_config),
             )
         if opt_device == "nvme":
@@ -226,7 +254,7 @@ class InfinityEngine:
                 [np.asarray(l, np.float32).reshape(-1) for l in jax.tree.leaves(blk)]
             )
             self._store_block_master(i, flat, init=True)
-            self._store_block_bf16(i, flat.astype(_BF16))
+            self._store_block_bf16(i, flat.astype(self._cdt))
         del b0
 
         self._g_pers_acc: Optional[List[np.ndarray]] = None
@@ -246,7 +274,16 @@ class InfinityEngine:
         )
 
     # ---- block storage ----------------------------------------------------
+    def _pad_flat(self, flat: np.ndarray) -> np.ndarray:
+        """Host flat buffers carry the shard padding so every load is
+        upload-ready with no per-step concatenate."""
+        if self._blk_pad:
+            return np.concatenate([flat, np.zeros(self._blk_pad, flat.dtype)])
+        return flat
+
     def _store_block_bf16(self, i: int, flat_bf16: np.ndarray) -> None:
+        if flat_bf16.size == self.block_numel:
+            flat_bf16 = self._pad_flat(flat_bf16)
         if self._param_swapper is not None:
             # register adopts the array into an aligned buffer; swap_out
             # persists + frees the DRAM copy
@@ -285,20 +322,44 @@ class InfinityEngine:
 
         self._j_embed = jax.jit(api.embed_fwd, static_argnums=3)
 
-        self._j_block = jax.jit(api.block_fwd, static_argnums=3)
+        # blocks enter compute as ONE flat (possibly mesh-sharded) buffer and
+        # unflatten in-graph: XLA sees the slice/reshape and inserts the
+        # allgather exactly where a shard is consumed — the just-in-time
+        # param fetch of the reference coordinator, as a compiler decision
+        offs, shapes = self._blk_offsets, self._blk_shapes
+        blk_tree = self._blk_tree
+        flat_sharding = self._flat_sharding
 
-        def blk_bwd(blk, h, rng, dh):
-            _, vjp = jax.vjp(lambda b, x: api.block_fwd(b, x, rng, True), blk, h)
-            gb, dx = vjp(dh)
-            return gb, dx
+        def unflat(flat):
+            leaves = [
+                flat[int(offs[j]) : int(offs[j + 1])].reshape(shapes[j])
+                for j in range(len(shapes))
+            ]
+            return jax.tree.unflatten(blk_tree, leaves)
+
+        def block_fwd_flat(flat, h, rng, train):
+            return api.block_fwd(unflat(flat), h, rng, train)
+
+        self._j_block = jax.jit(block_fwd_flat, static_argnums=3)
+
+        def blk_bwd(flat, h, rng, dh):
+            _, vjp = jax.vjp(lambda f, x: block_fwd_flat(f, x, rng, True), flat, h)
+            gf, dx = vjp(dh)
+            if flat_sharding is not None:
+                # reduce-scatter: each chip keeps only its slice of the
+                # block's grads; the D2H fetch then streams 1/N per chip
+                gf = jax.lax.with_sharding_constraint(gf, flat_sharding)
+            return gf, dx
 
         self._j_block_bwd = jax.jit(blk_bwd)
 
-        def head(pers, h, batch):
-            return api.head_loss(pers, h, batch)
+        def head_scaled(pers, h, batch, scale):
+            # fp16: the dynamic loss scale multiplies the head loss so the
+            # whole backward sweep (dh through every block VJP) runs scaled
+            return api.head_loss(pers, h, batch) * scale
 
-        self._j_head = jax.jit(jax.value_and_grad(head, argnums=(0, 1)))
-        self._j_head_loss = jax.jit(head)
+        self._j_head = jax.jit(jax.value_and_grad(head_scaled, argnums=(0, 1)))
+        self._j_head_loss = jax.jit(api.head_loss)
 
         def embed_bwd(pers, batch, rng, dh):
             _, vjp = jax.vjp(lambda p: api.embed_fwd(p, batch, rng, True), pers)
@@ -309,21 +370,19 @@ class InfinityEngine:
 
     # ---- device staging ----------------------------------------------------
     def _put_block(self, i: int):
+        """Upload block i as one flat buffer; sharded over the mesh when
+        dp>1 (each chip receives only its slice), whole otherwise."""
         if self._trace_validator is not None and self._tracing:
             self._trace_validator.record_fetch(i)
         flat = self._load_block_bf16(i)
-        leaves = [
-            jnp.asarray(
-                flat[self._blk_offsets[j] : self._blk_offsets[j + 1]].reshape(
-                    self._blk_shapes[j]
-                )
-            )
-            for j in range(len(self._blk_shapes))
-        ]
+        if self._flat_sharding is not None:
+            dev = jax.device_put(flat, self._flat_sharding)
+        else:
+            dev = jnp.asarray(flat)
         self._release_block_bf16(i)
         self._resident_blocks += 1
         self.max_resident_blocks = max(self.max_resident_blocks, self._resident_blocks)
-        return jax.tree.unflatten(self._blk_tree, leaves)
+        return dev
 
     def _mark_block_released(self) -> None:
         """Caller drops its reference; XLA frees the buffers once the last
@@ -332,16 +391,22 @@ class InfinityEngine:
 
     def _persistent_device(self):
         if self._pers_dev is None:
+            # device_put the HOST arrays (one H2D per leaf, replicated in
+            # the same transfer on a mesh) — not jnp.asarray-then-replicate
             leaves = [
-                jnp.asarray(m.astype(_BF16).reshape(s))
+                jax.device_put(
+                    m.astype(self._cdt).reshape(s),
+                    *( (self._repl_sharding,) if self._repl_sharding is not None else () ),
+                )
                 for m, s in zip(self._pers_master, self._pers_shapes)
             ]
             self._pers_dev = jax.tree.unflatten(self._pers_tree, leaves)
         return self._pers_dev
 
     # ---- the streamed step -------------------------------------------------
-    def _micro_sweep(self, batch_dev: PyTree, rng) -> jnp.ndarray:
-        """One microbatch fwd+bwd; accumulates host grads. Returns loss."""
+    def _micro_sweep(self, batch_dev: PyTree, rng, scale: float = 1.0) -> jnp.ndarray:
+        """One microbatch fwd+bwd; accumulates host grads (loss-scaled when
+        ``scale`` != 1). Returns the UNscaled loss."""
         L = self.api.num_blocks
         pers = self._persistent_device()
         rngs = jax.random.split(rng, L + 1)
@@ -358,7 +423,10 @@ class InfinityEngine:
             cur = None
             self._mark_block_released()
 
-        (loss, (g_pers, dh)) = self._j_head(pers, acts[L], batch_dev)
+        (loss_scaled, (g_pers, dh)) = self._j_head(
+            pers, acts[L], batch_dev, jnp.float32(scale)
+        )
+        loss = loss_scaled / scale
         self._acc_pers(g_pers)
 
         nxt = self._put_block(L - 1)
@@ -392,20 +460,25 @@ class InfinityEngine:
             for a, g in zip(self._g_pers_acc, leaves):
                 a += g
 
-    def _acc_block(self, i: int, g_blk_dev: PyTree) -> None:
-        flat = np.concatenate(
-            [np.asarray(l, np.float32).reshape(-1) for l in jax.tree.leaves(
-                jax.device_get(g_blk_dev)
-            )]
-        )
+    def _acc_block(self, i: int, g_flat_dev) -> None:
+        flat = np.asarray(jax.device_get(g_flat_dev), np.float32).reshape(-1)
+        flat = flat[: self.block_numel]  # strip shard padding
         if i in self._g_blk_acc:
             self._g_blk_acc[i] += flat
         else:
             self._g_blk_acc[i] = flat
 
-    def train_step(self, batch_gas: PyTree, global_step: int, rng) -> Dict[str, Any]:
-        """batch_gas leaves are [gas, micro, ...] device (or host) arrays."""
+    def train_step(
+        self, batch_gas: PyTree, global_step: int, rng, scale: Optional[float] = None
+    ) -> Dict[str, Any]:
+        """batch_gas leaves are [gas, micro, ...] device (or host) arrays.
+
+        ``scale`` engages fp16 dynamic-loss-scale semantics: grads accumulate
+        scaled, an overflow (any non-finite accumulator) skips the host
+        optimizer step entirely (params/moments untouched) and returns
+        ``overflow=True`` for the engine to back the scale off."""
         gas = int(jax.tree.leaves(batch_gas)[0].shape[0])
+        scale_f = 1.0 if scale is None else float(scale)
         self._g_pers_acc = None
         self._g_blk_acc = {}
         losses = []
@@ -415,15 +488,40 @@ class InfinityEngine:
         try:
             for g in range(gas):
                 micro = jax.tree.map(lambda x: x[g], batch_gas)
-                losses.append(self._micro_sweep(micro, jax.random.fold_in(rng, g)))
+                losses.append(
+                    self._micro_sweep(micro, jax.random.fold_in(rng, g), scale_f)
+                )
         finally:
             # an aborted sweep must not leave a partial trace that makes the
             # next (healthy) step look divergent
             self._tracing = False
         loss = float(np.mean([float(jax.device_get(l)) for l in losses]))
 
-        # mean over gas + global grad norm (host side, all grads staged)
-        inv = 1.0 / gas
+        lr_now = (
+            float(self.lr_schedule(global_step))
+            if callable(self.lr_schedule)
+            else float(self.lr_schedule)
+        )
+        if scale is not None:
+            overflow = not (
+                all(np.isfinite(a).all() for a in self._g_blk_acc.values())
+                and all(np.isfinite(a).all() for a in self._g_pers_acc)
+            )
+            if overflow:
+                # drop grads, keep masters/moments/compute copies untouched
+                self._g_blk_acc = {}
+                self._g_pers_acc = None
+                if self._trace_validator is not None:
+                    self._trace_validator.end_step()
+                return {
+                    "loss": loss,
+                    "grad_norm": float("nan"),
+                    "lr": lr_now,
+                    "overflow": True,
+                }
+
+        # mean over gas, unscale + global grad norm (host side, all staged)
+        inv = 1.0 / (gas * scale_f)
         sq = 0.0
         for gacc in self._g_blk_acc.values():
             gacc *= inv
@@ -436,11 +534,7 @@ class InfinityEngine:
         if self.clip > 0.0 and gnorm > self.clip:
             coef = self.clip / (gnorm + 1e-6)
 
-        lr = (
-            float(self.lr_schedule(global_step))
-            if callable(self.lr_schedule)
-            else float(self.lr_schedule)
-        )
+        lr = lr_now
 
         # ---- per-block optimizer tier (pipelined when NVMe) -------------
         L = self.api.num_blocks
@@ -455,7 +549,7 @@ class InfinityEngine:
                 if coef != 1.0:
                     g = g * coef
                 self.opt.step(master, g, key=i, lr=lr)
-                self._store_block_bf16(i, master.astype(_BF16))
+                self._store_block_bf16(i, master.astype(self._cdt))
                 del self.opt._m[i], self.opt._v[i]  # views into the record
                 del self._g_blk_acc[i]
 
@@ -466,7 +560,7 @@ class InfinityEngine:
                 if coef != 1.0:
                     g = g * coef
                 self.opt.step(self._blk_master[i], g, key=i, lr=lr)
-                self._store_block_bf16(i, self._blk_master[i].astype(_BF16))
+                self._store_block_bf16(i, self._blk_master[i].astype(self._cdt))
 
         # ---- persistent part (always DRAM; key space above the blocks) --
         for j, (m, g) in enumerate(zip(self._pers_master, self._g_pers_acc)):
@@ -477,7 +571,7 @@ class InfinityEngine:
         self._g_pers_acc = None
         if self._trace_validator is not None:
             self._trace_validator.end_step()
-        return {"loss": loss, "grad_norm": gnorm * coef, "lr": lr}
+        return {"loss": loss, "grad_norm": gnorm * coef, "lr": lr, "overflow": False}
 
     def eval_loss(self, batch_gas: PyTree, rng) -> float:
         """Forward-only streamed sweep (train=False), mean loss over gas."""
@@ -542,7 +636,7 @@ class InfinityEngine:
             else:
                 self._blk_master[i] = master.copy()
                 self.opt.set_state(i, [np.array(sd["block_m"][i]), np.array(sd["block_v"][i])])
-            self._store_block_bf16(i, master.astype(_BF16))
+            self._store_block_bf16(i, master.astype(self._cdt))
         for j, (m, saved) in enumerate(zip(self._pers_master, sd["persistent"])):
             m[:] = saved
             if "persistent_m" in sd:
