@@ -1,6 +1,8 @@
 from .basic_layer import (
+    channel_pruning_mask,
     head_pruning_mask,
     quantize_activation_ste,
+    quantize_embedding_ste,
     quantize_weight_ste,
     row_pruning_mask,
     sparse_pruning_mask,
@@ -17,10 +19,12 @@ from .compress import (
 __all__ = [
     "CompressionScheduler",
     "apply_compression",
+    "channel_pruning_mask",
     "compression_scheduler_from_config",
     "head_pruning_mask",
     "init_compression",
     "quantize_activation_ste",
+    "quantize_embedding_ste",
     "quantize_weight_ste",
     "redundancy_clean",
     "row_pruning_mask",

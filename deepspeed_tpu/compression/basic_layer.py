@@ -98,6 +98,65 @@ def head_pruning_mask(w: jnp.ndarray, ratio: float, num_heads: int) -> jnp.ndarr
     return mask.reshape(w.shape)
 
 
+def channel_pruning_mask(w: jnp.ndarray, ratio: float) -> jnp.ndarray:
+    """Structured mask zeroing the lowest-L1 output CHANNELS of a conv
+    weight (reference channel_pruning, constants.py:155; Conv2dLayer_Compress
+    basic_layer.py:444). JAX conv kernels are [kH, kW, in_ch, out_ch] (HWIO):
+    the channel dim is the last one, scored by L1 over all other axes."""
+    if ratio <= 0.0:
+        return jnp.ones_like(w, dtype=bool)
+    axes = tuple(range(w.ndim - 1))
+    norms = jnp.sum(jnp.abs(w), axis=axes)  # [out_ch]
+    k = int(norms.size * ratio)
+    if k <= 0:
+        return jnp.ones_like(w, dtype=bool)
+    thresh = jnp.sort(norms)[k - 1]
+    keep = norms > thresh
+    return jnp.broadcast_to(keep, w.shape)
+
+
+def _quant_embedding(w, bits, symmetric):
+    """Token-wise (per-row) embedding quantization down to ternary/binary
+    (reference Embedding_Compress.enable_weight_quantization,
+    basic_layer.py:76-101: num_groups = vocab size, i.e. one scale per row;
+    bits==2 ternary and bits==1 binary are symmetric-only)."""
+    # checked here (shared by the primal AND the vjp fwd) so the invariant
+    # fires on the first training step, not at export time
+    assert bits >= 3 or symmetric, "ternary/binary quantization is symmetric-only"
+    if bits >= 3:
+        return _fake_quant(w, bits, symmetric, axis=-1)
+    absw = jnp.abs(w)
+    if bits == 2:  # ternary: {-a, 0, +a} with delta = 0.7 * mean|w| per row
+        delta = 0.7 * jnp.mean(absw, axis=-1, keepdims=True)
+        mask = absw > delta
+        alpha = jnp.sum(absw * mask, axis=-1, keepdims=True) / jnp.maximum(
+            jnp.sum(mask, axis=-1, keepdims=True), 1
+        )
+        return jnp.sign(w) * mask * alpha
+    # binary: sign(w) * mean|w| per row
+    alpha = jnp.mean(absw, axis=-1, keepdims=True)
+    return jnp.sign(w) * alpha
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def quantize_embedding_ste(w: jnp.ndarray, bits: int = 8, symmetric: bool = True) -> jnp.ndarray:
+    """Fake-quantize an embedding table token-wise with a straight-through
+    estimator. Supports 8..3-bit (sym/asym), 2-bit ternary, 1-bit binary —
+    the reference Embedding_Compress technique ladder (basic_layer.py:61)."""
+    return _quant_embedding(w, bits, symmetric)
+
+
+def _qe_fwd(w, bits, symmetric):
+    return _quant_embedding(w, bits, symmetric), None
+
+
+def _qe_bwd(bits, symmetric, _res, g):
+    return (g,)  # straight-through
+
+
+quantize_embedding_ste.defvjp(_qe_fwd, _qe_bwd)
+
+
 @partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
 def quantize_activation_ste(
     x: jnp.ndarray, bits: int = 8, symmetric: bool = True, per_token: bool = True

@@ -10,10 +10,21 @@ weights) — pure-functional, jit-compatible.
 Config shape (reference ``compression_training`` section vocabulary):
     {
       "weight_quantization": {"enabled": true, "bits": 8, "modules": ["attn", "mlp"], "start_step": 100},
+      "embedding_quantization": {"enabled": true, "bits": 2, "modules": ["wte"], "start_step": 0},
       "sparse_pruning":      {"enabled": true, "ratio": 0.5, "modules": ["mlp"], "start_step": 200},
       "row_pruning":         {"enabled": false, "ratio": 0.25, "modules": [...]},
-      "head_pruning":        {"enabled": false, "ratio": 0.25, "num_heads": 12, "modules": [...]}
+      "head_pruning":        {"enabled": false, "ratio": 0.25, "num_heads": 12, "modules": [...]},
+      "channel_pruning":     {"enabled": false, "ratio": 0.25, "modules": ["conv"]}
     }
+
+``embedding_quantization`` is the reference's weight-quantization group
+targeting Embedding modules (Embedding_Compress, basic_layer.py:61 —
+token-wise scales, ternary/binary capable); ``channel_pruning`` is the conv
+variant (Conv2dLayer_Compress:444). TP composition needs no special classes
+(reference Column/RowParallelLinear_Compress, basic_layer.py:834,877):
+these transforms act on the logically-global arrays, and the logical-axis
+sharding annotations carry through masking/fake-quant untouched — proven by
+the tp-mesh compression test.
 """
 
 from __future__ import annotations
@@ -25,7 +36,9 @@ import jax
 import jax.numpy as jnp
 
 from .basic_layer import (
+    channel_pruning_mask,
     head_pruning_mask,
+    quantize_embedding_ste,
     quantize_weight_ste,
     row_pruning_mask,
     sparse_pruning_mask,
@@ -68,14 +81,14 @@ def init_compression(params: PyTree, config: Dict[str, Any]) -> Dict[str, PyTree
     fix_compression semantics)."""
     masks: Dict[str, Optional[PyTree]] = {}
 
-    def build(technique, fn):
+    def build(technique, fn, ndim_ok=lambda n: n >= 2):
         t = config.get(technique, {})
         if not t.get("enabled", False):
             return None
         modules = t.get("modules", [])
 
         def visit(path, leaf):
-            if hasattr(leaf, "ndim") and leaf.ndim >= 2 and _matches(path, modules):
+            if hasattr(leaf, "ndim") and ndim_ok(leaf.ndim) and _matches(path, modules):
                 return fn(leaf, t)
             return None
 
@@ -87,6 +100,12 @@ def init_compression(params: PyTree, config: Dict[str, Any]) -> Dict[str, PyTree
     masks["head"] = build(
         "head_pruning",
         lambda w, t: head_pruning_mask(w, float(t.get("ratio", 0.25)), int(t.get("num_heads", 12))),
+    )
+    # conv channels: only 4D (HWIO) leaves qualify
+    masks["channel"] = build(
+        "channel_pruning",
+        lambda w, t: channel_pruning_mask(w, float(t.get("ratio", 0.25))),
+        ndim_ok=lambda n: n == 4,
     )
     return masks
 
@@ -103,16 +122,40 @@ def apply_compression(
     flat = _leaf_paths(params)
     q = config.get("weight_quantization", {})
     q_on = sched.active("weight_quantization", step)
+    eq = config.get("embedding_quantization", {})
+    eq_on = sched.active("embedding_quantization", step)
+    if eq_on and not eq.get("modules"):
+        # an empty pattern would claim EVERY 2D weight (shadowing
+        # weight_quantization on attn/mlp); embeddings must be named
+        raise ValueError(
+            "embedding_quantization requires explicit 'modules' patterns "
+            "naming the embedding tables (e.g. [\"wte\"])"
+        )
     out = {}
     for path, leaf in flat:
         w = leaf
         if masks:
-            for kind in ("sparse", "row", "head"):
-                tech = {"sparse": "sparse_pruning", "row": "row_pruning", "head": "head_pruning"}[kind]
+            for kind in ("sparse", "row", "head", "channel"):
+                tech = {
+                    "sparse": "sparse_pruning",
+                    "row": "row_pruning",
+                    "head": "head_pruning",
+                    "channel": "channel_pruning",
+                }[kind]
                 mtree = masks.get(kind)
                 if mtree and mtree.get(path) is not None and sched.active(tech, step):
                     w = w * mtree[path].astype(w.dtype)
-        if q_on and hasattr(w, "ndim") and w.ndim >= 2 and _matches(path, q.get("modules", [])):
+        if (
+            eq_on
+            and hasattr(w, "ndim")
+            and w.ndim == 2
+            and _matches(path, eq.get("modules", []))
+        ):
+            # embedding tables: token-wise scales, ternary/binary capable
+            w = quantize_embedding_ste(
+                w, int(eq.get("bits", 8)), bool(eq.get("symmetric", True))
+            )
+        elif q_on and hasattr(w, "ndim") and w.ndim >= 2 and _matches(path, q.get("modules", [])):
             w = quantize_weight_ste(w, int(q.get("bits", 8)), bool(q.get("symmetric", True)))
         out[path] = w
     # rebuild tree

