@@ -1,4 +1,12 @@
 from .autotuner import Autotuner
+from .scheduler import PodSweep, ResourceManager
 from .tuner import GridSearchTuner, ModelBasedTuner, RandomTuner
 
-__all__ = ["Autotuner", "GridSearchTuner", "ModelBasedTuner", "RandomTuner"]
+__all__ = [
+    "Autotuner",
+    "GridSearchTuner",
+    "ModelBasedTuner",
+    "PodSweep",
+    "RandomTuner",
+    "ResourceManager",
+]

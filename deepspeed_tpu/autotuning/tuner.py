@@ -77,9 +77,12 @@ class ModelBasedTuner(BaseTuner):
         rest = self.all_exps[self.seed_trials :]
         for exp in seed:
             self._record(exp, self.metric_fn(exp))
-        if rest and len(self.results) >= 2:
-            X = np.stack([self._featurize(e) for e, _ in self.results])
-            y = np.asarray([m for _, m in self.results])
+        # infeasible trials measure as -inf; they must not enter the fit or
+        # the least-squares turns NaN and "predicted-best" becomes arbitrary
+        finite = [(e, m) for e, m in self.results if np.isfinite(m)]
+        if rest and len(finite) >= 2:
+            X = np.stack([self._featurize(e) for e, _ in finite])
+            y = np.asarray([m for _, m in finite])
             coef, *_ = np.linalg.lstsq(X, y, rcond=None)
             preds = [(float(self._featurize(e) @ coef), e) for e in rest]
             preds.sort(key=lambda t: -t[0])

@@ -121,8 +121,10 @@ def _quant_embedding(w, bits, symmetric):
     basic_layer.py:76-101: num_groups = vocab size, i.e. one scale per row;
     bits==2 ternary and bits==1 binary are symmetric-only)."""
     # checked here (shared by the primal AND the vjp fwd) so the invariant
-    # fires on the first training step, not at export time
-    assert bits >= 3 or symmetric, "ternary/binary quantization is symmetric-only"
+    # fires on the first training step, not at export time; a real raise, not
+    # an assert, so python -O launchers can't strip it
+    if bits < 3 and not symmetric:
+        raise ValueError("ternary/binary quantization is symmetric-only")
     if bits >= 3:
         return _fake_quant(w, bits, symmetric, axis=-1)
     absw = jnp.abs(w)
