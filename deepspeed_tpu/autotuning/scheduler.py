@@ -102,7 +102,14 @@ class ResourceManager:
                     out.append((tag, p.returncode, so, se))
                 except subprocess.TimeoutExpired:
                     p.kill()
-                    out.append((tag, -1, "", f"timeout after {self.timeout}s"))
+                    try:
+                        # reap + keep partial output (a job that printed its
+                        # metric line before stalling still scores normally,
+                        # matching run_job's e.stdout preservation)
+                        so, se = p.communicate(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        so, se = "", ""
+                    out.append((tag, -1, so, (se or "") + f"\ntimeout after {self.timeout}s"))
         return out
 
 

@@ -80,13 +80,19 @@ class ModelBasedTuner(BaseTuner):
         # infeasible trials measure as -inf; they must not enter the fit or
         # the least-squares turns NaN and "predicted-best" becomes arbitrary
         finite = [(e, m) for e, m in self.results if np.isfinite(m)]
-        if rest and len(finite) >= 2:
-            X = np.stack([self._featurize(e) for e, _ in finite])
-            y = np.asarray([m for _, m in finite])
-            coef, *_ = np.linalg.lstsq(X, y, rcond=None)
-            preds = [(float(self._featurize(e) @ coef), e) for e in rest]
-            preds.sort(key=lambda t: -t[0])
+        if rest:
             budget = self.top_k if max_trials is None else max(0, max_trials - len(seed))
-            for _, exp in preds[:budget]:
+            if len(finite) >= 2:
+                X = np.stack([self._featurize(e) for e, _ in finite])
+                y = np.asarray([m for _, m in finite])
+                coef, *_ = np.linalg.lstsq(X, y, rcond=None)
+                preds = [(float(self._featurize(e) @ coef), e) for e in rest]
+                preds.sort(key=lambda t: -t[0])
+                ordered = [e for _, e in preds]
+            else:
+                # too few feasible seeds to fit a model: keep exploring in
+                # order rather than abandoning the (possibly feasible) rest
+                ordered = list(rest)
+            for exp in ordered[:budget]:
                 self._record(exp, self.metric_fn(exp))
         return self.best_exp, self.best_metric

@@ -4,7 +4,7 @@ from .elasticity import (
     compute_elastic_config,
     get_compatible_gpus,
 )
-from .elastic_agent import ElasticAgent
+from .elastic_agent import ElasticAgent, resize_restart
 
 __all__ = [
     "ElasticAgent",
@@ -12,4 +12,5 @@ __all__ = [
     "ElasticityError",
     "compute_elastic_config",
     "get_compatible_gpus",
+    "resize_restart",
 ]

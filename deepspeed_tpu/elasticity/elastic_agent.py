@@ -23,6 +23,44 @@ from ..utils.logging import log_dist
 from .elasticity import ElasticityError, compute_elastic_config
 
 
+def resize_restart(
+    engine_factory: Callable[[int, int, int], Any],
+    ds_config: Dict[str, Any],
+    ckpt_dir: str,
+    world_size: int,
+    tag: Optional[str] = None,
+):
+    """Resume training at a NEW slice size from the universal checkpoint.
+
+    The slice-resize arm of the reference's elastic restart (DSElasticAgent
+    restart + compute_elastic_config:287): the elastic ladder fixes ONE
+    effective batch size across every compatible chip count, so a resize is
+
+    1. look up ``world_size``'s micro batch on the ladder (convergence
+       contract preserved: same effective batch, new micro x gas x dp split),
+    2. build the engine at the new mesh geometry via ``engine_factory
+       (world_size, train_batch, micro_batch)``,
+    3. restore the mesh-agnostic universal checkpoint into the resized
+       shardings (params AND optimizer state reshard on load).
+
+    Returns the restored engine; training continues with an identical loss
+    trajectory to an uninterrupted run (rehearsed in
+    tests/unit/test_aux_subsystems.py::TestElasticResize).
+    """
+    batch, _, micro = compute_elastic_config(
+        ds_config, world_size=world_size, return_microbatch=True
+    )
+    if micro is None:
+        raise ElasticityError(f"no micro batch for world size {world_size}")
+    engine = engine_factory(world_size, batch, micro)
+    engine.load_checkpoint(ckpt_dir, tag=tag)
+    log_dist(
+        f"elastic resize: resumed at world_size={world_size} "
+        f"batch={batch} micro={micro} from {ckpt_dir}"
+    )
+    return engine
+
+
 class ElasticAgent:
     def __init__(
         self,

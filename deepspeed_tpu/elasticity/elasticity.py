@@ -29,11 +29,17 @@ LATEST_ELASTICITY_VERSION = 0.2
 MINIMUM_DEEPSPEED_VERSION = "0.3.8"
 
 
-def _valid_gpus(batch: int, micro_batches: Sequence[int], min_gpus: int, max_gpus: int) -> List[int]:
+def _valid_gpus(
+    batch: int, micro_batches: Sequence[int], min_gpus: int, max_gpus: int,
+    unit: int = 1,
+) -> List[int]:
     """Chip counts g that can realise ``batch`` with some micro batch:
-    exists m, k >= 1 with batch == m * k * g."""
+    exists m, k >= 1 with batch == m * k * g. ``unit`` > 1 admits only
+    whole-host counts (v0.2 node granularity)."""
     out = []
     for g in range(min_gpus, max_gpus + 1):
+        if g % unit:
+            continue
         if any(batch % (m * g) == 0 for m in micro_batches):
             out.append(g)
     return out
@@ -45,9 +51,14 @@ def get_compatible_gpus(
     min_gpus: int = 1,
     max_gpus: Optional[int] = None,
     prefer_larger: bool = True,
+    unit: int = 1,
 ) -> Tuple[int, List[int]]:
     """v0.1 algorithm: choose the batch size <= max that maximises the number
-    of compatible chip counts (ties → larger batch when prefer_larger)."""
+    of compatible chip counts (ties → larger batch when prefer_larger).
+    ``unit`` applies the v0.2 whole-host constraint DURING the search
+    (reference _get_compatible_gpus_v02 evaluates candidates at node
+    granularity, elasticity.py:173 — filtering after choosing the batch
+    would pick batches that maximize counts the constraint then removes)."""
     if not micro_batches or any(m <= 0 for m in micro_batches):
         raise ElasticityConfigError(f"invalid micro_batches {micro_batches}")
     max_gpus = max_gpus or max_acceptable_batch_size // min(micro_batches)
@@ -55,7 +66,7 @@ def get_compatible_gpus(
     for batch in range(1, max_acceptable_batch_size + 1):
         if not any(batch % m == 0 for m in micro_batches):
             continue
-        gpus = _valid_gpus(batch, micro_batches, min_gpus, max_gpus)
+        gpus = _valid_gpus(batch, micro_batches, min_gpus, max_gpus, unit)
         better = len(gpus) > len(best[1]) or (
             len(gpus) == len(best[1]) and best[0] and (
                 batch > best[0] if prefer_larger else batch < best[0]
@@ -69,15 +80,6 @@ def get_compatible_gpus(
             f"{micro_batches} and gpus [{min_gpus}, {max_gpus}]"
         )
     return best
-
-
-def _apply_v02_constraints(
-    gpus: List[int], model_parallel_size: int, num_gpus_per_node: int
-) -> List[int]:
-    """v0.2: world size must be a multiple of mp_size and fill whole nodes
-    (whole TPU hosts)."""
-    step = model_parallel_size * num_gpus_per_node
-    return [g for g in gpus if (g * model_parallel_size) % step == 0]
 
 
 def compute_elastic_config(
@@ -105,15 +107,26 @@ def compute_elastic_config(
         raise ElasticityConfigError("micro_batch_sizes and max_train_batch_size required")
     min_time = int(e.get("min_time", 0))  # accepted for parity; not used here
 
-    final_batch, valid_gpus = get_compatible_gpus(
-        micro_batches, max_batch, min_gpus, max_gpus, prefer_larger
-    )
+    # v0.2 searches at whole-host granularity so the chosen batch maximises
+    # counts that actually survive the node constraint. g counts chips, so
+    # "(g*mp) % (mp*per_node) == 0" reduces to "g % per_node == 0".
+    unit = 1
     if version >= 0.2:
-        mp = int(e.get("model_parallel_size", 1))
         per_node = int(e.get("num_gpus_per_node", 4))  # chips per TPU host
-        constrained = _apply_v02_constraints(valid_gpus, mp, per_node)
-        if constrained:
-            valid_gpus = constrained
+        unit = per_node
+    try:
+        final_batch, valid_gpus = get_compatible_gpus(
+            micro_batches, max_batch, min_gpus, max_gpus, prefer_larger, unit=unit
+        )
+    except ElasticityError:
+        if unit == 1:
+            raise
+        # no whole-host count fits [min_gpus, max_gpus] (e.g. a sub-host
+        # dev slice): lenient fallback to the unconstrained ladder, matching
+        # the reference's keep-going behavior when the node filter empties
+        final_batch, valid_gpus = get_compatible_gpus(
+            micro_batches, max_batch, min_gpus, max_gpus, prefer_larger, unit=1
+        )
 
     if world_size > 0:
         if world_size not in valid_gpus:

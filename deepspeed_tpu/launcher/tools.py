@@ -77,11 +77,41 @@ def ds_elastic(argv=None) -> int:
     p = argparse.ArgumentParser("ds_elastic", description="elastic config ladder")
     p.add_argument("-c", "--config", required=True, help="ds_config JSON path")
     p.add_argument("-w", "--world-size", type=int, default=0)
+    p.add_argument(
+        "--verify-resize",
+        default=None,
+        metavar="W1,W2,...",
+        help="validate that a job could resize across these world sizes: each "
+        "must sit on the ladder with the SAME effective batch; prints the "
+        "micro x gas x dp split per size (rc 1 if any is incompatible)",
+    )
     args = p.parse_args(argv)
-    from ..elasticity.elasticity import compute_elastic_config
+    from ..elasticity.elasticity import ElasticityError, compute_elastic_config
 
     with open(args.config) as f:
         doc = json.load(f)
+    if args.verify_resize:
+        sizes = [int(s) for s in args.verify_resize.split(",") if s]
+        plan, ok = [], True
+        for ws in sizes:
+            try:
+                batch, _, micro = compute_elastic_config(
+                    doc, world_size=ws, return_microbatch=True
+                )
+                if micro is None:
+                    raise ElasticityError(f"no micro batch for world size {ws}")
+                plan.append({
+                    "world_size": ws, "final_batch_size": batch,
+                    "micro_batch_per_gpu": micro,
+                    "gradient_accumulation_steps": batch // (micro * ws),
+                })
+            except ElasticityError as e:
+                ok = False
+                plan.append({"world_size": ws, "error": str(e)})
+        batches = {e["final_batch_size"] for e in plan if "final_batch_size" in e}
+        ok = ok and len(batches) == 1
+        print(json.dumps({"resize_ok": ok, "plan": plan}, indent=2))
+        return 0 if ok else 1
     res = compute_elastic_config(
         doc, world_size=args.world_size, return_microbatch=args.world_size > 0
     )
