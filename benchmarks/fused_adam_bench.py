@@ -11,11 +11,12 @@ shows achieved GB/s against the chip's peak. Result is printed as one JSON
 line; paste the winner + number into RESULTS below when re-run on new
 hardware.
 
-RESULTS (v5e, 2026-07-29, n=268435456 fp32):
-  measured by the driver round — see BENCH notes / commit message. The optax
-  update and the Pallas kernel are both bandwidth-bound; whichever wins is
-  kept as the default (optimizers.py build_optimizer stays optax unless the
-  kernel shows a material edge).
+RESULTS: not yet captured on hardware — every TPU window since round 2 was
+lost to the wedged tunnel (see ROUND3_NOTES.md / .tpu_probe.log). Both paths
+are bandwidth-bound in theory; optax remains the default
+(optimizers.py build_optimizer) until a chip run shows the Pallas kernel a
+material edge. When the backend is reachable, run this script and replace
+this paragraph with the JSON line it prints.
 """
 
 from __future__ import annotations

@@ -128,9 +128,11 @@ class InferenceEngine:
         checkpoint = checkpoint if checkpoint is not _UNSET else cfg_ckpt
         # quantization_setting: groups, or (mlp_extra_grouping, groups)
         cfg_groups = None if q is None else int(q if not isinstance(q, (tuple, list)) else q[-1])
+        # no quantization_setting -> 1 group, matching the reference's
+        # _init_quantization_setting default (engine.py quantize_groups=1)
         quantize_groups = int(
             quantize_groups if quantize_groups is not _UNSET
-            else (cfg_groups if cfg_groups is not None else 64)
+            else (cfg_groups if cfg_groups is not None else 1)
         )
         quantize_bits = int(
             quantize_bits if quantize_bits is not _UNSET else (8 if q is not None else 0)

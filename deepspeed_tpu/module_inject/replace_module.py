@@ -28,7 +28,7 @@ def replace_transformer_layer(
     policy: Optional[type] = None,
     dtype=jnp.bfloat16,
     quantize_bits: int = 0,
-    quantize_groups: int = 64,
+    quantize_groups: int = 1,  # reference _init_quantization_setting default
 ) -> Tuple[str, Any, PyTree]:
     """Convert an HF torch model via its injection policy.
 

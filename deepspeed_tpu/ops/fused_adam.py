@@ -5,8 +5,10 @@ Capability analog of reference ``csrc/adam/multi_tensor_adam.cu:163`` +
 the optax update already fuses into the train step, so this kernel exists to
 answer SURVEY §2.7's own question — "Pallas fused optimizer kernel over flat
 param shards (or jax.jit fused update — **measure**)" — with a measurement:
-``benchmarks/fused_adam_bench.py`` times both at large param counts and
-records the winner (see that file's header for the number).
+``benchmarks/fused_adam_bench.py`` times both at large param counts. The
+number has NOT yet been captured on hardware (no working TPU window since
+the harness landed — that file's RESULTS section tracks the status); optax
+stays the default optimizer until the kernel measures a material edge.
 
 Design: the update is purely elementwise and HBM-bandwidth-bound (reads
 p,g,m,v + writes p,m,v = 28 B/param fp32). The kernel streams 2D tiles

@@ -1328,7 +1328,13 @@ class DeepSpeedEngine:
         forward per step vs :meth:`train_batch` — prefer train_batch in new
         code, and eval_batch/predict for pure evaluation (a stray
         backward()+step() after an eval-style call would train on that
-        batch)."""
+        batch).
+
+        Note the returned loss is the EVAL-mode loss (deterministic: no
+        dropout masks, no MoE aux penalty); the training-mode loss that
+        :meth:`step` actually optimizes can differ. The reference's
+        engine.forward returns the train-mode loss — read
+        ``train_batch(...)['loss']`` when that exact value matters."""
         from ..utils.logging import warning_once
 
         warning_once(

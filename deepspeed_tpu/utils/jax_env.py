@@ -17,10 +17,29 @@ def honor_jax_platforms() -> None:
     """Re-assert the ``JAX_PLATFORMS`` env var over any plugin override.
 
     No-op when the env var is unset or jax backends are already initialized
-    (too late to change selection)."""
+    (too late to change selection — the update would be silently ineffective
+    or warn depending on jax version, so it is skipped explicitly)."""
     val = os.environ.get("JAX_PLATFORMS")
     if not val:
         return
     import jax
 
-    jax.config.update("jax_platforms", val)
+    try:
+        from jax._src import xla_bridge
+
+        if getattr(xla_bridge, "backends_are_initialized", lambda: False)():
+            return
+    except Exception:  # private-API drift: fall through to the best effort
+        pass
+    try:
+        jax.config.update("jax_platforms", val)
+    except Exception as e:
+        # backends already pinned (update races backend init) or config-key
+        # drift — either way the selection did NOT change; say so instead of
+        # letting a host tool silently proceed onto the wrong platform
+        from .logging import warning_once
+
+        warning_once(
+            f"honor_jax_platforms: could not apply JAX_PLATFORMS={val!r} "
+            f"({type(e).__name__}: {e}); jax platform selection is unchanged"
+        )
