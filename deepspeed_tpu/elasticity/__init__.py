@@ -4,13 +4,22 @@ from .elasticity import (
     compute_elastic_config,
     get_compatible_gpus,
 )
-from .elastic_agent import ElasticAgent, resize_restart
+from .elastic_agent import (
+    DeviceMonitor,
+    ElasticAgent,
+    choose_compatible_world_size,
+    make_progress_probe,
+    resize_restart,
+)
 
 __all__ = [
+    "DeviceMonitor",
     "ElasticAgent",
+    "choose_compatible_world_size",
     "ElasticityConfigError",
     "ElasticityError",
     "compute_elastic_config",
     "get_compatible_gpus",
+    "make_progress_probe",
     "resize_restart",
 ]

@@ -170,6 +170,116 @@ class TestTuners:
         assert (tmp_path / "ds_config_optimal.json").exists()
 
 
+class TestDeviceMonitor:
+    """Accelerator health watching + ladder-aware restart (reference
+    DSElasticAgent worker monitoring, elastic_agent.py:23)."""
+
+    def test_trips_after_consecutive_failures_and_recovers(self):
+        from deepspeed_tpu.elasticity import DeviceMonitor
+
+        answers = iter([True, False, False, True])
+        mon = DeviceMonitor(failures_to_trip=2, probe_fn=lambda t: next(answers))
+        assert mon.probe_once() and mon.healthy
+        assert not mon.probe_once() and mon.healthy  # one failure: not yet
+        assert not mon.probe_once() and not mon.healthy  # second: tripped
+        assert mon.probe_once() and mon.healthy  # recovery clears it
+
+    def test_default_probe_is_subprocess(self):
+        from deepspeed_tpu.elasticity.elastic_agent import _default_probe
+
+        # killable even if the plugin would hang: an unreasonable timeout
+        # simply fails the probe instead of wedging the caller
+        assert _default_probe(0.01) is False
+
+    def test_progress_probe(self):
+        """The no-subprocess probe for exclusive-libtpu deployments: healthy
+        while the step counter advances, stalls after stall_s without it."""
+        import time as _time
+
+        from deepspeed_tpu.elasticity import make_progress_probe
+
+        step = {"n": 0}
+        probe = make_progress_probe(lambda: step["n"], stall_s=0.05)
+        assert probe(0)  # first sample
+        step["n"] += 1
+        assert probe(0)  # progressed
+        assert probe(0)  # no progress, but within stall window
+        _time.sleep(0.08)
+        assert not probe(0)  # stalled past the window
+        step["n"] += 1
+        assert probe(0)  # progress clears the stall
+
+    def test_choose_compatible_world_size(self):
+        from deepspeed_tpu.elasticity import (
+            ElasticityError,
+            choose_compatible_world_size,
+        )
+
+        cfg = {
+            "elasticity": {
+                "enabled": True,
+                "max_train_batch_size": 16,
+                "micro_batch_sizes": [1, 2, 4],
+                "min_gpus": 1,
+                "max_gpus": 8,
+                "version": 0.2,
+                "num_gpus_per_node": 4,
+            }
+        }
+        assert choose_compatible_world_size(cfg, 8) == 8
+        assert choose_compatible_world_size(cfg, 7) == 4  # off-ladder: step down
+        assert choose_compatible_world_size(cfg, 4) == 4
+        with pytest.raises(ElasticityError):
+            choose_compatible_world_size(cfg, 3)
+
+    def test_agent_waits_for_health_then_restarts(self):
+        from deepspeed_tpu.elasticity import DeviceMonitor, ElasticAgent
+
+        cfg = {
+            "elasticity": {
+                "enabled": True,
+                "max_train_batch_size": 16,
+                "micro_batch_sizes": [1, 2, 4],
+                "min_gpus": 1,
+                "max_gpus": 8,
+                "version": 0.2,
+                "num_gpus_per_node": 4,
+            }
+        }
+        import threading
+
+        lock = threading.Lock()
+        seq = [False, False]  # unhealthy window after the crash, then healthy
+        probes = []
+
+        def probe(t):
+            with lock:  # the monitor thread and _await_healthy share this
+                ok = seq.pop(0) if seq else True
+                probes.append(ok)
+            return ok
+
+        calls = []
+
+        def train_fn(ws, batch, micro):
+            calls.append((ws, batch, micro))
+            if len(calls) == 1:
+                raise RuntimeError("device lost")
+            return "done"
+
+        # the background thread (every interval_s) and _await_healthy race
+        # for the seq pops; the lock + count-based assertions below are
+        # deliberately order-tolerant, so either consumer may see the
+        # unhealthy window
+        mon = DeviceMonitor(interval_s=0.01, failures_to_trip=2, probe_fn=probe)
+        agent = ElasticAgent(cfg, train_fn, restart_delay_s=0.0, monitor=mon)
+        agent._current_world_size = lambda: 8
+        assert agent.run() == "done"
+        assert agent.restart_count == 1
+        # the agent probed through the unhealthy window before relaunching
+        assert probes.count(False) == 2 and probes[-1] is True
+        assert calls[0] == (8, 16, 2) and calls[1] == (8, 16, 2)
+
+
 class TestElasticResize:
     """Slice-resize rehearsal (VERDICT r3 missing #6): the elastic ladder +
     universal checkpoint carry a run across dp8->dp4->dp8 with an identical
