@@ -23,7 +23,17 @@ __git_branch__ = "main"
 
 from . import comm  # noqa: F401
 from .comm.comm import init_distributed  # noqa: F401
-from .runtime.config import DeepSpeedConfig  # noqa: F401
+from .module_inject import (  # noqa: F401
+    replace_transformer_layer,
+    revert_transformer_layer,
+)
+from .ops.transformer import (  # noqa: F401
+    DeepSpeedTransformerConfig,
+    DeepSpeedTransformerLayer,
+)
+from .runtime.config import DeepSpeedConfig, DeepSpeedConfigError  # noqa: F401
+from .runtime.lr_schedules import add_tuning_arguments  # noqa: F401
+from .utils.init_on_device import OnDevice  # noqa: F401
 from .runtime.engine import DeepSpeedEngine  # noqa: F401
 from .runtime.module import ModuleSpec  # noqa: F401
 from .parallel.topology import (  # noqa: F401

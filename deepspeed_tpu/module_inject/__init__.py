@@ -1,4 +1,4 @@
-from .replace_module import replace_transformer_layer
+from .replace_module import replace_transformer_layer, revert_transformer_layer
 from .replace_policy import (
     DSPolicy,
     HFGPT2LayerPolicy,
@@ -12,4 +12,5 @@ __all__ = [
     "POLICY_REGISTRY",
     "match_policy",
     "replace_transformer_layer",
+    "revert_transformer_layer",
 ]

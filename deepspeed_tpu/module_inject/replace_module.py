@@ -62,6 +62,42 @@ def replace_transformer_layer(
     return kind, cfg, params
 
 
+def revert_transformer_layer(hf_model, params: PyTree, policy: Optional[type] = None):
+    """Write a (possibly fine-tuned) converted param tree BACK into the HF
+    torch model — the reference's reverse surgery
+    (``module_inject/replace_module.py:1001`` restores original layers from
+    the fused modules). Our conversion is whole-model, so revert is the
+    per-policy inverse tensor mapping; policies declare it via a ``revert``
+    classmethod (GPT-2's mapping is 1:1, so it round-trips exactly).
+
+    Returns ``hf_model`` with weights updated in place.
+    """
+    pol = policy or match_policy(hf_model)
+    if pol is None:
+        raise ValueError(f"no injection policy matches {type(hf_model).__name__}")
+    if not hasattr(pol, "revert"):
+        raise NotImplementedError(
+            f"{pol.__name__} defines no inverse mapping (revert); only "
+            "policies with a declared revert support writing weights back "
+            "into the HF model"
+        )
+    from ..ops.quantizer import QuantizedWeight
+
+    if any(
+        isinstance(l, QuantizedWeight)
+        for l in jax.tree.leaves(
+            params, is_leaf=lambda x: isinstance(x, QuantizedWeight)
+        )
+    ):
+        raise ValueError(
+            "cannot revert int8-quantized params (replace_transformer_layer "
+            "with quantize_bits>0); convert with quantize_bits=0 to round-trip"
+        )
+    pol.revert(hf_model, params)
+    log_dist(f"revert_transformer_layer: restored HF weights via {pol.__name__}")
+    return hf_model
+
+
 def np_floating(x) -> bool:
     import numpy as np
 

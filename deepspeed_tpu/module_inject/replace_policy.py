@@ -102,6 +102,39 @@ class HFGPT2LayerPolicy(DSPolicy):
         }
         return "gpt2", cfg, params
 
+    @classmethod
+    def revert(cls, hf_model, params) -> None:
+        """Inverse of :meth:`convert`: unstack the layer dim and copy each
+        tensor back into the torch module in place (Conv1D layout is already
+        ours, so the mapping is exact — fine-tune here, export to HF)."""
+        import torch
+
+        t = hf_model.transformer if hasattr(hf_model, "transformer") else hf_model
+
+        def put(dst, src) -> None:
+            arr = np.asarray(src, dtype=np.float32)
+            with torch.no_grad():
+                dst.copy_(torch.from_numpy(arr).to(dst.dtype))
+
+        put(t.wte.weight, params["wte"])
+        put(t.wpe.weight, params["wpe"])
+        put(t.ln_f.weight, params["ln_f"]["scale"])
+        put(t.ln_f.bias, params["ln_f"]["bias"])
+        blocks = params["blocks"]
+        for i, h in enumerate(t.h):
+            put(h.ln_1.weight, blocks["ln_1"]["scale"][i])
+            put(h.ln_1.bias, blocks["ln_1"]["bias"][i])
+            put(h.ln_2.weight, blocks["ln_2"]["scale"][i])
+            put(h.ln_2.bias, blocks["ln_2"]["bias"][i])
+            put(h.attn.c_attn.weight, blocks["attn"]["c_attn_w"][i])
+            put(h.attn.c_attn.bias, blocks["attn"]["c_attn_b"][i])
+            put(h.attn.c_proj.weight, blocks["attn"]["c_proj_w"][i])
+            put(h.attn.c_proj.bias, blocks["attn"]["c_proj_b"][i])
+            put(h.mlp.c_fc.weight, blocks["mlp"]["c_fc_w"][i])
+            put(h.mlp.c_fc.bias, blocks["mlp"]["c_fc_b"][i])
+            put(h.mlp.c_proj.weight, blocks["mlp"]["c_proj_w"][i])
+            put(h.mlp.c_proj.bias, blocks["mlp"]["c_proj_b"][i])
+
 
 def _linear_w(layer) -> np.ndarray:
     """torch Linear weight [out, in] → matmul layout [in, out]."""

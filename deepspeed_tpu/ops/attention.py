@@ -93,3 +93,41 @@ def causal_attention(q, k, v, impl: str = "auto", sm_scale: Optional[float] = No
                 warning_once(f"pallas flash attention unavailable ({e}); using jnp path")
         return causal_attention_jnp(q, k, v, sm_scale)
     raise ValueError(f"unknown attention impl {impl}")
+
+
+def bidirectional_attention_jnp(q, k, v, mask=None, sm_scale: Optional[float] = None):
+    """Encoder attention: [B,S,H,D] -> [B,S,H,D], optional padding ``mask``
+    [B,S] (1 = attend), f32 softmax."""
+    B, S, H, D = q.shape
+    scale = sm_scale if sm_scale is not None else 1.0 / (D**0.5)
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if mask is not None:
+        logits = jnp.where(mask[:, None, None, :].astype(bool), logits, jnp.float32(-1e30))
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def bidirectional_attention(
+    q, k, v, mask=None, impl: str = "auto", sm_scale: Optional[float] = None
+):
+    """Non-causal dispatcher with the same warn-and-fall-back contract as
+    :func:`causal_attention`. The Pallas flash kernel serves the unmasked
+    case; a padding mask routes to the jnp path (the kernel has no mask
+    input — masked encoder batches are typically short enough that the
+    materialized [S,S] is cheap)."""
+    if impl == "jnp" or mask is not None:
+        return bidirectional_attention_jnp(q, k, v, mask, sm_scale)
+    if impl in ("auto", "pallas"):
+        if impl == "pallas" or _pallas_ok(q):
+            try:
+                from .pallas.flash_attention import flash_attention
+
+                return flash_attention(q, k, v, causal=False, sm_scale=sm_scale)
+            except Exception as e:  # pragma: no cover
+                if impl == "pallas":
+                    raise
+                warning_once(f"pallas flash attention unavailable ({e}); using jnp path")
+        return bidirectional_attention_jnp(q, k, v, None, sm_scale)
+    raise ValueError(f"unknown attention impl {impl}")

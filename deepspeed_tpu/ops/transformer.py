@@ -1,0 +1,125 @@
+"""Standalone fused transformer (encoder) layer — the public kernel-layer API.
+
+Capability analog of the reference's ``DeepSpeedTransformerLayer``
+(``ops/transformer/transformer.py:459`` wrapping the ~6.4k-LoC fused CUDA
+encoder kernel ``csrc/transformer/ds_transformer_cuda.cpp``): one layer =
+QKV matmul + self-attention + output projection + residual/dropout + GELU
+MLP, pre- or post-LayerNorm, fwd AND bwd. TPU-first formulation: the layer
+is a pure function jitted as one XLA program — the elementwise chain fuses
+into the matmuls, attention dispatches to the Pallas flash kernel when
+shapes/backing allow (``ops/attention.py``), and the backward pass is
+autodiff over the same fused program rather than a second hand-written
+kernel. Config mirrors the reference's ``DeepSpeedTransformerConfig``
+(``transformer.py:38``) where the concept transfers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .attention import bidirectional_attention
+from .layer_norm import layer_norm
+
+
+@dataclass
+class DeepSpeedTransformerConfig:
+    """Reference DeepSpeedTransformerConfig (ops/transformer/transformer.py:38)
+    minus CUDA-only knobs (streams, seeds are per-call rngs here; fp16 flag is
+    the ``dtype``). ``stochastic_mode`` has no analog: XLA programs are
+    deterministic for fixed rng."""
+
+    hidden_size: int = 768
+    intermediate_size: Optional[int] = None  # defaults to 4*hidden
+    heads: int = 12
+    attn_dropout_ratio: float = 0.1
+    hidden_dropout_ratio: float = 0.1
+    layer_norm_eps: float = 1e-12
+    initializer_range: float = 0.02
+    pre_layer_norm: bool = True
+    dtype: jnp.dtype = jnp.float32
+
+    def __post_init__(self):
+        if self.intermediate_size is None:
+            self.intermediate_size = 4 * self.hidden_size
+        assert self.hidden_size % self.heads == 0
+
+
+def _dropout(x, rate, rng, train):
+    if not train or rate <= 0.0 or rng is None:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+class DeepSpeedTransformerLayer:
+    """Functional encoder layer: ``params = layer.init(rng)``;
+    ``y = layer(params, x, attention_mask, train, rng)``.
+
+    ``x`` is [B, S, E]; ``attention_mask`` (optional) is the HF convention
+    [B, S] with 1 = attend, 0 = padding. Bidirectional (encoder) attention;
+    for causal decoders use the model families in ``deepspeed_tpu.models``.
+    """
+
+    def __init__(self, config: DeepSpeedTransformerConfig):
+        self.config = config
+
+    # -- params -------------------------------------------------------------
+    def init(self, rng):
+        c = self.config
+        E, I = c.hidden_size, c.intermediate_size
+        k = jax.random.split(rng, 4)
+        s = c.initializer_range
+
+        def norm(key, shape):
+            return (jax.random.normal(key, shape) * s).astype(c.dtype)
+
+        return {
+            "attn": {
+                "qkv_w": norm(k[0], (E, 3 * E)),
+                "qkv_b": jnp.zeros((3 * E,), c.dtype),
+                "out_w": norm(k[1], (E, E)),
+                "out_b": jnp.zeros((E,), c.dtype),
+            },
+            "mlp": {
+                "fc_w": norm(k[2], (E, I)),
+                "fc_b": jnp.zeros((I,), c.dtype),
+                "proj_w": norm(k[3], (I, E)),
+                "proj_b": jnp.zeros((E,), c.dtype),
+            },
+            "ln1": {"scale": jnp.ones((E,), c.dtype), "bias": jnp.zeros((E,), c.dtype)},
+            "ln2": {"scale": jnp.ones((E,), c.dtype), "bias": jnp.zeros((E,), c.dtype)},
+        }
+
+    # -- forward ------------------------------------------------------------
+    def __call__(self, params, x, attention_mask=None, train: bool = False, rng=None):
+        c = self.config
+        B, S, E = x.shape
+        H, D = c.heads, c.hidden_size // c.heads
+        rngs = jax.random.split(rng, 3) if rng is not None else (None, None, None)
+
+        def attn_block(h):
+            qkv = h @ params["attn"]["qkv_w"] + params["attn"]["qkv_b"]
+            q, k, v = jnp.split(qkv.reshape(B, S, 3, H, D), 3, axis=2)
+            out = bidirectional_attention(
+                q[:, :, 0], k[:, :, 0], v[:, :, 0], mask=attention_mask
+            )
+            out = _dropout(out, c.attn_dropout_ratio, rngs[0], train)
+            return out.reshape(B, S, E) @ params["attn"]["out_w"] + params["attn"]["out_b"]
+
+        def mlp_block(h):
+            h = jax.nn.gelu(h @ params["mlp"]["fc_w"] + params["mlp"]["fc_b"])
+            return h @ params["mlp"]["proj_w"] + params["mlp"]["proj_b"]
+
+        ln1 = lambda h: layer_norm(h, params["ln1"]["scale"], params["ln1"]["bias"], c.layer_norm_eps)
+        ln2 = lambda h: layer_norm(h, params["ln2"]["scale"], params["ln2"]["bias"], c.layer_norm_eps)
+
+        if c.pre_layer_norm:
+            x = x + _dropout(attn_block(ln1(x)), c.hidden_dropout_ratio, rngs[1], train)
+            return x + _dropout(mlp_block(ln2(x)), c.hidden_dropout_ratio, rngs[2], train)
+        x = ln1(x + _dropout(attn_block(x), c.hidden_dropout_ratio, rngs[1], train))
+        return ln2(x + _dropout(mlp_block(x), c.hidden_dropout_ratio, rngs[2], train))

@@ -162,3 +162,38 @@ def get_lr_schedule(name: Optional[str], params: Optional[Dict[str, Any]] = None
     if name not in _REGISTRY:
         raise ValueError(f"unknown scheduler type {name}; valid: {VALID_LR_SCHEDULES}")
     return _REGISTRY[name](**(params or {}))
+
+
+def add_tuning_arguments(parser):
+    """Add LR-schedule tuning CLI args (reference lr_schedules.py:55 —
+    convert_lr_range_test/OneCycle knob groups). Values land in the parsed
+    namespace; feed them into a ds_config ``scheduler.params`` section."""
+    group = parser.add_argument_group("Convergence Tuning", "Convergence tuning configurations")
+    group.add_argument("--lr_schedule", type=str, default=None,
+                       help="LR schedule: LRRangeTest | OneCycle | WarmupLR | WarmupDecayLR")
+    # LRRangeTest
+    group.add_argument("--lr_range_test_min_lr", type=float, default=0.001)
+    group.add_argument("--lr_range_test_step_rate", type=float, default=1.0)
+    group.add_argument("--lr_range_test_step_size", type=int, default=1000)
+    group.add_argument(
+        "--lr_range_test_staircase",
+        type=lambda s: str(s).lower() in ("1", "true", "yes"),
+        default=False,
+    )
+    # OneCycle
+    group.add_argument("--cycle_first_step_size", type=int, default=1000)
+    group.add_argument("--cycle_first_stair_count", type=int, default=-1)
+    group.add_argument("--cycle_second_step_size", type=int, default=-1)
+    group.add_argument("--cycle_second_stair_count", type=int, default=-1)
+    group.add_argument("--decay_step_size", type=int, default=1000)
+    group.add_argument("--cycle_min_lr", type=float, default=0.01)
+    group.add_argument("--cycle_max_lr", type=float, default=0.1)
+    group.add_argument("--decay_lr_rate", type=float, default=0.0)
+    group.add_argument("--cycle_min_mom", type=float, default=0.8)
+    group.add_argument("--cycle_max_mom", type=float, default=0.9)
+    group.add_argument("--decay_mom_rate", type=float, default=0.0)
+    # Warmup
+    group.add_argument("--warmup_min_lr", type=float, default=0)
+    group.add_argument("--warmup_max_lr", type=float, default=0.001)
+    group.add_argument("--warmup_num_steps", type=int, default=1000)
+    return parser

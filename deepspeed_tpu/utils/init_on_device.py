@@ -1,0 +1,85 @@
+"""OnDevice: materialize model params abstractly ("meta") or straight onto a
+device/sharding — the functional analog of the reference's meta-device init
+context (``utils/init_on_device.py:81``: ``with OnDevice(dtype, device="meta")``
+builds a torch module whose tensors have shape but no storage).
+
+In the functional world a "module on meta" is simply an abstract evaluation
+of its initializer: ``jax.eval_shape`` produces the param pytree as
+``ShapeDtypeStruct``s with ZERO memory or compute — what the reference
+emulates with meta tensors, JAX has natively. ``device=...`` instead jits the
+initializer with placed/sharded outputs so params are born where they belong
+(composing with ``zero.init_partitioned``, the ``zero.Init`` analog).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+
+class OnDevice:
+    """Context/helper controlling where ``init`` materializes params.
+
+    Usage (mirroring the reference shape)::
+
+        with OnDevice(dtype=jnp.bfloat16, device="meta") as ctx:
+            abstract = ctx.init(module.init, rng)      # ShapeDtypeStructs
+        with OnDevice(device=jax.devices()[0]) as ctx:
+            params = ctx.init(module.init, rng)        # placed, real
+    """
+
+    def __init__(self, dtype: Optional[Any] = None, device: Any = "meta", enabled: bool = True):
+        self.dtype = dtype
+        self.device = device
+        self.enabled = enabled
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def _cast(self, tree):
+        if self.dtype is None:
+            return tree
+        import jax.numpy as jnp
+
+        def cast(x):
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+                if isinstance(x, jax.ShapeDtypeStruct):
+                    return jax.ShapeDtypeStruct(x.shape, jnp.dtype(self.dtype))
+                return x.astype(self.dtype)
+            return x
+
+        return jax.tree.map(cast, tree)
+
+    def init(self, init_fn, *args):
+        """Run ``init_fn(*args)`` under this context's placement."""
+        if not self.enabled:
+            return init_fn(*args)
+        if self.device == "meta":
+            return self._cast(jax.eval_shape(init_fn, *args))
+        device = self.device
+        if isinstance(device, str):
+            # torch-style platform strings ('cpu', 'tpu') resolve to that
+            # backend's first device; anything unknown fails loudly rather
+            # than silently landing params on the default device
+            try:
+                device = jax.devices(device)[0]
+            except Exception as e:
+                raise ValueError(
+                    f"OnDevice: unknown device {self.device!r} "
+                    "(use 'meta', a platform name, a jax.Device, or a Sharding)"
+                ) from e
+        out_shardings = None
+        if device is not None:
+            out_shardings = (
+                jax.sharding.SingleDeviceSharding(device)
+                if isinstance(device, jax.Device)
+                else device
+            )
+        # cast INSIDE the jitted program: params materialize directly in the
+        # target dtype (no transient full-precision tree on device)
+        fn = jax.jit(lambda *a: self._cast(init_fn(*a)), out_shardings=out_shardings)
+        return fn(*args)
