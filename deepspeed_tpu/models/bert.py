@@ -151,17 +151,19 @@ def logical_axes(cfg: Optional[BertConfig] = None) -> PyTree:
     }
 
 
-def _block(cfg: BertConfig, lp, h, attn_bias):
+def _block(cfg: BertConfig, lp, h, attention_mask):
     B, S, E = h.shape
     H, D = cfg.n_head, cfg.head_dim
     a = lp["attn"]
     q = (h @ _deq(a["wq"], h.dtype) + a["bq"]).reshape(B, S, H, D)
     k_ = (h @ _deq(a["wk"], h.dtype) + a["bk"]).reshape(B, S, H, D)
     v = (h @ _deq(a["wv"], h.dtype) + a["bv"]).reshape(B, S, H, D)
-    scores = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32), k_.astype(jnp.float32))
-    scores = scores / np.sqrt(D) + attn_bias
-    probs = jax.nn.softmax(scores, axis=-1).astype(h.dtype)
-    o = jnp.einsum("bhst,bthd->bshd", probs, v).reshape(B, S, E)
+    # shared encoder-attention dispatcher: Pallas flash on TPU when
+    # unmasked/shape-admitted, f32-softmax jnp path otherwise — BERT-large
+    # inference rides the same kernel as the decoder families
+    from ..ops.attention import bidirectional_attention
+
+    o = bidirectional_attention(q, k_, v, mask=attention_mask).reshape(B, S, E)
     h = _ln(h + (o @ _deq(a["wo"], o.dtype) + a["bo"]), lp["attn_ln"]["scale"], lp["attn_ln"]["bias"], cfg.layer_norm_epsilon)
     m = lp["mlp"]
     y = jax.nn.gelu(h @ _deq(m["fc_in_w"], h.dtype) + m["fc_in_b"], approximate=False)
@@ -181,13 +183,8 @@ def forward(
     tt = token_type_ids if token_type_ids is not None else jnp.zeros_like(input_ids)
     h = params["wte"][input_ids] + params["wpe"][:S][None] + params["wtt"][tt]
     h = _ln(h, params["emb_ln"]["scale"], params["emb_ln"]["bias"], cfg.layer_norm_epsilon)
-    if attention_mask is not None:
-        bias = (1.0 - attention_mask[:, None, None, :].astype(jnp.float32)) * -1e30
-    else:
-        bias = jnp.zeros((B, 1, 1, S), jnp.float32)
-
     def body(h, lp):
-        return _block(cfg, lp, h, bias), None
+        return _block(cfg, lp, h, attention_mask), None
 
     h, _ = lax.scan(body, h, params["blocks"])
     pooled = None
