@@ -258,7 +258,7 @@ def main():
         hbm = 16e9
 
     seq = int(os.environ.get("BENCH_SEQ", "1024" if on_tpu else "128"))
-    micro = int(os.environ.get("BENCH_MICRO", "8" if on_tpu else "2"))
+    micro_env = os.environ.get("BENCH_MICRO", "auto" if on_tpu else "2")
     steps = int(os.environ.get("BENCH_STEPS", "10" if on_tpu else "3"))
     # ZeRO-3 is the BASELINE config; at dp=1 its sharding is the identity so
     # the same program runs, with the config semantics the judge expects
@@ -267,24 +267,38 @@ def main():
     if model_name == "auto":
         model_name = pick_model(hbm, seq)
 
-    # build with OOM fallback: each preset tries its default remat choice,
-    # then remat=True (keeps a larger model at +33% flops instead of
-    # dropping a size), then the next-smaller preset
+    # build with OOM fallback. Ladder order per preset: largest micro batch
+    # first (bigger per-step matmuls = better MFU; BENCH_MICRO=auto tries
+    # 32 -> 16 -> 8 with remat on so activations stay bounded), then the
+    # preset's default remat choice, then remat=True, then the next-smaller
+    # preset. An explicit BENCH_MICRO pins the micro batch.
     tried = []
     cfg = engine = None
+    micro = None
     names = [model_name] + [c for c in CANDIDATES if CANDIDATES.index(c) > (CANDIDATES.index(model_name) if model_name in CANDIDATES else -1)]
+    auto_micro = micro_env == "auto"
+    micro_ladder = (32, 16, 8) if auto_micro else (int(micro_env),)
     ladder = []
     for c in names:
-        ladder.append((c, None))
+        if auto_micro:
+            for mb in micro_ladder:
+                # large micros only make sense with remat (activation memory)
+                ladder.append((c, True if mb > 8 else None, mb))
+        else:
+            # pinned micro: the original two-rung behavior (default remat
+            # choice first, then remat=True) regardless of the pinned size
+            ladder.append((c, None, micro_ladder[0]))
         if c not in ("gpt2-large", "gpt2-xl"):  # default remat already True there
-            ladder.append((c, True))
-    for name, remat in ladder:
+            rung = (c, True, micro_ladder[-1])
+            if rung not in ladder:
+                ladder.append(rung)
+    for name, remat, mb in ladder:
         try:
             # fresh watchdog window per rung: each OOM fallback pays its own
             # (slow, remote) compile; a hang inside any rung still trips it
             disarm_watchdog()
             disarm_watchdog = _arm_inproc_watchdog(attempts)
-            cfg, engine = build_engine(name, seq, micro, n_dev, zero_stage, remat=remat)
+            cfg, engine = build_engine(name, seq, mb, n_dev, zero_stage, remat=remat)
             rs = np.random.RandomState(0)
             batch = {
                 "input_ids": rs.randint(
@@ -293,12 +307,12 @@ def main():
             }
             m = engine.train_batch(batch)  # compile + warmup step 0
             jax.block_until_ready(m["loss"])
-            model_name = name
+            model_name, micro = name, mb
             break
         except Exception as e:  # OOM at compile or run: next ladder rung
-            tried.append(f"{name}(remat={remat}): {type(e).__name__}")
+            tried.append(f"{name}(remat={remat},micro={mb}): {type(e).__name__}")
             cfg = engine = None
-            if (name, remat) == ladder[-1]:
+            if (name, remat, mb) == ladder[-1]:
                 raise
     assert engine is not None, tried
     # a real step completed, but later phases still compile fresh programs
