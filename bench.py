@@ -67,6 +67,26 @@ def pick_model(hbm_bytes: float, seq: int):
     return "gpt2"
 
 
+def fit_micros(name: str, seq: int, hbm_bytes: float, candidates=(32, 16, 8)):
+    """Micro batches predicted to fit ``name`` at ``seq`` (largest first).
+
+    Activation bytes per micro-batch element with remat + chunked CE:
+    ~seq * h * (L + 8) * 2 (bf16 layer-boundary residuals + one block's
+    recompute workspace). Headroom = HBM - the 18 B/param train state. The
+    smallest candidate always stays as the floor (the OOM ladder still
+    protects against estimate error)."""
+    from deepspeed_tpu.models import gpt2
+
+    p = gpt2.PRESETS.get(name)
+    if p is None:
+        return list(candidates)
+    n = param_count(p["n_layer"], p["n_embd"], 50257, seq)
+    headroom = hbm_bytes * 0.92 - n * 18 - 0.5e9
+    per_micro = seq * p["n_embd"] * (p["n_layer"] + 8) * 2.0
+    fitting = [m for m in candidates if m * per_micro <= headroom]
+    return fitting or [min(candidates)]
+
+
 def build_engine(model_name: str, seq: int, micro: int, n_dev: int, zero_stage: int, remat: bool = None):
     from deepspeed_tpu.models import gpt2
     from deepspeed_tpu.parallel.topology import MeshSpec
@@ -267,24 +287,26 @@ def main():
     if model_name == "auto":
         model_name = pick_model(hbm, seq)
 
-    # build with OOM fallback. Ladder order per preset: largest micro batch
-    # first (bigger per-step matmuls = better MFU; BENCH_MICRO=auto tries
-    # 32 -> 16 -> 8 with remat on so activations stay bounded), then the
-    # preset's default remat choice, then remat=True, then the next-smaller
+    # build with OOM fallback. Ladder order per preset: largest PREDICTED-
+    # fitting micro batch first (bigger per-step matmuls = better MFU;
+    # fit_micros prunes rungs the memory model says can't fit so the auto
+    # ladder doesn't burn slow remote compiles on deterministic OOMs; rungs
+    # above micro 8 force remat, the micro-8 rung keeps the preset's default
+    # remat choice), then a remat=True floor rung, then the next-smaller
     # preset. An explicit BENCH_MICRO pins the micro batch.
     tried = []
     cfg = engine = None
     micro = None
     names = [model_name] + [c for c in CANDIDATES if CANDIDATES.index(c) > (CANDIDATES.index(model_name) if model_name in CANDIDATES else -1)]
     auto_micro = micro_env == "auto"
-    micro_ladder = (32, 16, 8) if auto_micro else (int(micro_env),)
     ladder = []
     for c in names:
         if auto_micro:
+            micro_ladder = fit_micros(c, seq, hbm)
             for mb in micro_ladder:
-                # large micros only make sense with remat (activation memory)
                 ladder.append((c, True if mb > 8 else None, mb))
         else:
+            micro_ladder = [int(micro_env)]
             # pinned micro: the original two-rung behavior (default remat
             # choice first, then remat=True) regardless of the pinned size
             ladder.append((c, None, micro_ladder[0]))
