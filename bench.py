@@ -53,35 +53,53 @@ def param_count(L: int, h: int, vocab: int, S: int) -> float:
     return 12.0 * L * h * h + vocab * h + S * h
 
 
-def pick_model(hbm_bytes: float, seq: int):
-    """Largest preset whose train-state footprint fits: fp32 params + Adam
-    m/v (12 B) + transient fp32 grads (4) + bf16 compute copy (2) = 18 B per
-    param, plus ~2 GB activation/workspace headroom (remat on)."""
-    for name in CANDIDATES:
-        from deepspeed_tpu.models import gpt2
+HBM_USABLE_FRACTION = 0.92  # leave room for XLA scratch/fragmentation
 
-        p = gpt2.PRESETS[name]
-        n = param_count(p["n_layer"], p["n_embd"], 50257, seq)
-        if n * 18 + 2e9 < hbm_bytes * 0.92:
+
+def train_state_bytes(name: str, seq: int, n_dev: int = 1, zero_stage: int = 3) -> float:
+    """Per-chip bytes of train state for a preset: fp32 master (4) + Adam
+    m/v (8) + transient fp32 grads (4) + bf16 compute copy (2) = 18 B/param,
+    with the ZeRO stage deciding which slices shard over dp:
+    stage1 shards m/v, stage2 adds grads, stage3 adds params/master."""
+    from deepspeed_tpu.models import gpt2
+
+    p = gpt2.PRESETS.get(name)
+    if p is None:
+        return 0.0
+    n = param_count(p["n_layer"], p["n_embd"], 50257, seq)
+    sharded = {0: 0.0, 1: 8.0, 2: 12.0, 3: 18.0}.get(int(zero_stage), 18.0)
+    replicated = 18.0 - sharded
+    return n * (replicated + sharded / max(1, n_dev))
+
+
+def pick_model(hbm_bytes: float, seq: int, n_dev: int = 1, zero_stage: int = 3):
+    """Largest preset whose per-chip train-state footprint fits, with ~2 GB
+    activation/workspace headroom (remat on)."""
+    for name in CANDIDATES:
+        if train_state_bytes(name, seq, n_dev, zero_stage) + 2e9 < hbm_bytes * HBM_USABLE_FRACTION:
             return name
     return "gpt2"
 
 
-def fit_micros(name: str, seq: int, hbm_bytes: float, candidates=(32, 16, 8)):
+def fit_micros(name: str, seq: int, hbm_bytes: float, n_dev: int = 1,
+               zero_stage: int = 3, candidates=(32, 16, 8)):
     """Micro batches predicted to fit ``name`` at ``seq`` (largest first).
 
     Activation bytes per micro-batch element with remat + chunked CE:
     ~seq * h * (L + 8) * 2 (bf16 layer-boundary residuals + one block's
-    recompute workspace). Headroom = HBM - the 18 B/param train state. The
-    smallest candidate always stays as the floor (the OOM ladder still
-    protects against estimate error)."""
+    recompute workspace). Headroom = usable HBM minus the (ZeRO-sharded)
+    per-chip train state. The smallest candidate always stays as the floor
+    (the OOM ladder still protects against estimate error)."""
     from deepspeed_tpu.models import gpt2
 
     p = gpt2.PRESETS.get(name)
     if p is None:
         return list(candidates)
-    n = param_count(p["n_layer"], p["n_embd"], 50257, seq)
-    headroom = hbm_bytes * 0.92 - n * 18 - 0.5e9
+    headroom = (
+        hbm_bytes * HBM_USABLE_FRACTION
+        - train_state_bytes(name, seq, n_dev, zero_stage)
+        - 0.5e9  # residual workspace slack beyond the activation model
+    )
     per_micro = seq * p["n_embd"] * (p["n_layer"] + 8) * 2.0
     fitting = [m for m in candidates if m * per_micro <= headroom]
     return fitting or [min(candidates)]
@@ -285,7 +303,7 @@ def main():
     zero_stage = int(os.environ.get("BENCH_ZERO", "3"))
     model_name = os.environ.get("BENCH_MODEL", "auto" if on_tpu else "gpt2-tiny")
     if model_name == "auto":
-        model_name = pick_model(hbm, seq)
+        model_name = pick_model(hbm, seq, n_dev, zero_stage)
 
     # build with OOM fallback. Ladder order per preset: largest PREDICTED-
     # fitting micro batch first (bigger per-step matmuls = better MFU;
@@ -302,7 +320,7 @@ def main():
     ladder = []
     for c in names:
         if auto_micro:
-            micro_ladder = fit_micros(c, seq, hbm)
+            micro_ladder = fit_micros(c, seq, hbm, n_dev, zero_stage)
             for mb in micro_ladder:
                 ladder.append((c, True if mb > 8 else None, mb))
         else:
