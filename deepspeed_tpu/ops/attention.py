@@ -37,14 +37,14 @@ def _pallas_ok(q) -> bool:
     B, S, H, D = q.shape
     if jax.default_backend() not in ("tpu",):
         return False
-    # kernel tiling constraints: seq multiple of block, head_dim lane-friendly
-    # (D=64 is lane-padded by Mosaic — still profitable vs materializing [S,S]);
-    # whole-K/V-in-VMEM design bounds the per-device sequence length. The
-    # predicate itself lives in ring_flash_ok so the single-device and ring
-    # dispatchers can never disagree.
-    from .pallas.ring_flash_attention import ring_flash_ok
+    # the shape rule lives in ONE place (pallas/flash_attention.flash_ok) so
+    # this dispatcher can never disagree with the kernel's own checks. Within
+    # the whole-K/V VMEM budget the resident kernels serve; past it,
+    # flash_attention streams K/V through the KV-blocked grid variant. The
+    # ring (sp) dispatcher keeps the stricter per-shard bound (ring_flash_ok).
+    from .pallas.flash_attention import flash_ok
 
-    return ring_flash_ok(S, D, q.dtype.itemsize)
+    return flash_ok(S, D)
 
 
 def cached_attention(q, k_cache, v_cache, pos, impl: str = "auto", sm_scale: Optional[float] = None):

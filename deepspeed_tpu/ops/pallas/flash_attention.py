@@ -39,6 +39,65 @@ def _causal_mask(s, q_block, k_block):
     return jnp.where(row >= col, s, NEG_INF)
 
 
+# ---- shared per-block math (one copy for the resident AND grid kernels) ----
+
+def _online_softmax_step(q, k, v, carry, qi, ki, causal: bool):
+    """One K/V block of the online-softmax forward. q is pre-scaled;
+    carry = (acc [BQ,D], m [BQ,1], l [BQ,1]) in f32."""
+    acc, m_prev, l_prev = carry
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    if causal:
+        s = _causal_mask(s, qi, ki)
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+    acc = acc * alpha + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    return acc, m_new, l_new
+
+
+def _dq_block(q, k, v, do, lse, delta, qi, ki, causal: bool):
+    """One K/V block's contribution to dq. q pre-scaled; lse/delta [BQ,1]."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    if causal:
+        s = _causal_mask(s, qi, ki)
+    p = jnp.exp(s - lse)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = p * (dp - delta)
+    return jax.lax.dot_general(
+        ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+def _dkv_block(q, k, v, do, lse, delta, qi, ki, causal: bool):
+    """One Q block's contributions to (dk, dv). q pre-scaled."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    if causal:
+        s = _causal_mask(s, qi, ki)
+    p = jnp.exp(s - lse)  # [BQ, BK]
+    dv = jax.lax.dot_general(
+        p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = p * (dp - delta)
+    dk = jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    return dk, dv
+
+
 def _causal_hi(qi, num_k_blocks):
     """Number of k blocks a q block attends into (correct for any BQ/BK)."""
     return jnp.minimum(pl.cdiv((qi + 1) * BQ, BK), num_k_blocks)
@@ -67,31 +126,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale: float, causal:
     hi = _causal_hi(qi, num_k_blocks) if causal else num_k_blocks
 
     def body(j, carry):
-        acc, m_prev, l_prev = carry
         k = k_ref[0, pl.ds(j * BK, BK), :].astype(jnp.float32)  # [BK, D]
         v = v_ref[0, pl.ds(j * BK, BK), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [BQ, BK]
-        if causal:
-            s = _causal_mask(s, qi, j)
-        m_cur = jnp.max(s, axis=1)
-        m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m_prev - m_new)
-        l_new = l_prev * alpha + jnp.sum(p, axis=1)
-        acc = acc * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        return acc, m_new, l_new
+        return _online_softmax_step(q, k, v, carry, qi, j, causal)
 
     acc0 = jnp.zeros((BQ, q_ref.shape[-1]), jnp.float32)
-    m0 = jnp.full((BQ,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((BQ,), jnp.float32)
+    m0 = jnp.full((BQ, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((BQ, 1), jnp.float32)
     acc, m, l = jax.lax.fori_loop(0, hi, body, (acc0, m0, l0))
     l = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = jax.lax.broadcast_in_dim(m + jnp.log(l), (BQ, NUM_LANES), (0,))
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0] = jax.lax.broadcast_in_dim((m + jnp.log(l))[:, 0], (BQ, NUM_LANES), (0,))
 
 
 def _fwd(q3, k3, v3, sm_scale: float, causal: bool, interpret: bool = False):
@@ -137,13 +182,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, s
     def body(j, dq):
         k = k_ref[0, pl.ds(j * BK, BK), :].astype(jnp.float32)
         v = v_ref[0, pl.ds(j * BK, BK), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-        if causal:
-            s = _causal_mask(s, qi, j)
-        p = jnp.exp(s - lse)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
-        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        return dq + _dq_block(q, k, v, do, lse, delta, qi, j, causal)
 
     dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((BQ, q_ref.shape[-1]), jnp.float32))
     dq_ref[0] = (dq * sm_scale).astype(dq_ref.dtype)
@@ -163,15 +202,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         do = do_ref[0, pl.ds(i * BQ, BQ), :].astype(jnp.float32)
         lse = lse_ref[0, pl.ds(i * BQ, BQ), 0:1]  # [BQ, 1]
         delta = delta_ref[0, pl.ds(i * BQ, BQ), 0:1]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-        if causal:
-            s = _causal_mask(s, i, ki)
-        p = jnp.exp(s - lse)  # [BQ, BK]
-        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
-        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-        return dk, dv
+        dkc, dvc = _dkv_block(q, k, v, do, lse, delta, i, ki, causal)
+        return dk + dkc, dv + dvc
 
     D = k_ref.shape[-1]
     dk0 = jnp.zeros((BK, D), jnp.float32)
@@ -228,6 +260,261 @@ def _bwd(q3, k3, v3, o3, lse, do3, sm_scale: float, causal: bool, interpret: boo
 
 
 # ---------------------------------------------------------------------------
+# KV-blocked (grid) variant: K/V stream block-by-block through the grid's
+# innermost dimension with the online-softmax state carried in VMEM scratch,
+# so nothing sequence-length-sized is ever VMEM-resident. Removes the
+# whole-K/V budget bound of the kernels above: single-device sequence length
+# is then limited by HBM (q/k/v/o + the [BH,S,128] lse), not VMEM. Same
+# math, same outputs, same custom-VJP structure.
+# ---------------------------------------------------------------------------
+
+# HBM-level ceiling for the grid variant: the broadcast-lane lse residual is
+# [B*H, S, 128] f32 (plus a same-sized delta in backward), so the bookkeeping
+# itself gets large past ~256k tokens per device.
+GRID_KERNEL_MAX_SEQ = 128 * 2048
+
+_GRID_PARAMS = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel", "arbitrary")
+)
+
+
+def _causal_block_live(qi, ki):
+    """True when k block ki intersects the causal triangle of q block qi."""
+    return ki * BK <= qi * BQ + (BQ - 1)
+
+
+def _kv_index_causal(b, i, j):
+    """K/V index map for causal fwd/dq grids: dead steps (past the triangle)
+    clamp to the last live block, so their iteration revisits the resident
+    block instead of DMAing K/V it will never use."""
+    return (b, jnp.minimum(j, (i * BQ + BQ - 1) // BK), 0)
+
+
+def _q_index_causal(b, j, i):
+    """Q-side index map for the causal dkv grid: steps before the first live
+    q block clamp up to it (same DMA-elision trick, from below)."""
+    return (b, jnp.maximum(i, (j * BK) // BQ), 0)
+
+
+def _fwd_grid_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+    *, sm_scale: float, causal: bool, num_k_blocks: int,
+):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32) * sm_scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        carry = (acc_ref[...], m_ref[:, 0:1], l_ref[:, 0:1])
+        acc, m_new, l_new = _online_softmax_step(q, k, v, carry, qi, ki, causal)
+        acc_ref[...] = acc
+        m_ref[...] = jax.lax.broadcast_in_dim(m_new[:, 0], m_ref.shape, (0,))
+        l_ref[...] = jax.lax.broadcast_in_dim(l_new[:, 0], l_ref.shape, (0,))
+
+    if causal:
+        @pl.when(_causal_block_live(qi, ki))
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, 0:1], 1e-30)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[...] + jnp.log(jnp.maximum(l_ref[...], 1e-30))
+
+
+def _fwd_grid(q3, k3, v3, sm_scale: float, causal: bool, interpret: bool = False):
+    BH, S, D = q3.shape
+    nq, nk = S // BQ, S // BK
+    kernel = functools.partial(
+        _fwd_grid_kernel, sm_scale=sm_scale, causal=causal, num_k_blocks=nk
+    )
+    kv_idx = _kv_index_causal if causal else (lambda b, i, j: (b, j, 0))
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(BH, nq, nk),
+        interpret=interpret,
+        in_specs=[
+            pl.BlockSpec((1, BQ, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, BK, D), kv_idx),
+            pl.BlockSpec((1, BK, D), kv_idx),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, BQ, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, BQ, NUM_LANES), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), q3.dtype),
+            jax.ShapeDtypeStruct((BH, S, NUM_LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((BQ, D), jnp.float32),
+            pltpu.VMEM((BQ, NUM_LANES), jnp.float32),
+            pltpu.VMEM((BQ, NUM_LANES), jnp.float32),
+        ],
+        compiler_params=_GRID_PARAMS,
+    )(q3, k3, v3)
+    return o, lse
+
+
+def _bwd_dq_grid_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
+    *, sm_scale: float, causal: bool, num_k_blocks: int,
+):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32) * sm_scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, :, 0:1]
+        delta = delta_ref[0, :, 0:1]
+        dq_acc[...] = dq_acc[...] + _dq_block(q, k, v, do, lse, delta, qi, ki, causal)
+
+    if causal:
+        @pl.when(_causal_block_live(qi, ki))
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finalize():
+        dq_ref[0] = (dq_acc[...] * sm_scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_grid_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc, *, sm_scale: float, causal: bool, num_q_blocks: int,
+):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32) * sm_scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, :, 0:1]
+        delta = delta_ref[0, :, 0:1]
+        dkc, dvc = _dkv_block(q, k, v, do, lse, delta, qi, ki, causal)
+        dk_acc[...] = dk_acc[...] + dkc
+        dv_acc[...] = dv_acc[...] + dvc
+
+    if causal:
+        @pl.when(_causal_block_live(qi, ki))
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)  # sm_scale folded into q
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _bwd_grid(q3, k3, v3, o3, lse, do3, sm_scale: float, causal: bool, interpret: bool = False):
+    BH, S, D = q3.shape
+    nq, nk = S // BQ, S // BK
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (BH, S, NUM_LANES))
+
+    kv_idx = _kv_index_causal if causal else (lambda b, i, j: (b, j, 0))
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_grid_kernel, sm_scale=sm_scale, causal=causal, num_k_blocks=nk
+        ),
+        grid=(BH, nq, nk),
+        interpret=interpret,
+        in_specs=[
+            pl.BlockSpec((1, BQ, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, BK, D), kv_idx),
+            pl.BlockSpec((1, BK, D), kv_idx),
+            pl.BlockSpec((1, BQ, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, BQ, NUM_LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, BQ, NUM_LANES), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, BQ, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), q3.dtype),
+        scratch_shapes=[pltpu.VMEM((BQ, D), jnp.float32)],
+        compiler_params=_GRID_PARAMS,
+    )(q3, k3, v3, do3, lse, delta)
+
+    q_idx = _q_index_causal if causal else (lambda b, j, i: (b, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_grid_kernel, sm_scale=sm_scale, causal=causal, num_q_blocks=nq
+        ),
+        grid=(BH, nk, nq),
+        interpret=interpret,
+        in_specs=[
+            pl.BlockSpec((1, BQ, D), q_idx),
+            pl.BlockSpec((1, BK, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, BK, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, BQ, D), q_idx),
+            pl.BlockSpec((1, BQ, NUM_LANES), q_idx),
+            pl.BlockSpec((1, BQ, NUM_LANES), q_idx),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, BK, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, BK, D), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), q3.dtype),
+            jax.ShapeDtypeStruct((BH, S, D), q3.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((BK, D), jnp.float32),
+            pltpu.VMEM((BK, D), jnp.float32),
+        ],
+        compiler_params=_GRID_PARAMS,
+    )(q3, k3, v3, do3, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_grid(q3, k3, v3, sm_scale: float, causal: bool, interpret: bool):
+    o, _ = _fwd_grid(q3, k3, v3, sm_scale, causal, interpret)
+    return o
+
+
+def _flash_grid_fwd_rule(q3, k3, v3, sm_scale, causal, interpret):
+    o, lse = _fwd_grid(q3, k3, v3, sm_scale, causal, interpret)
+    return o, (q3, k3, v3, o, lse)
+
+
+def _flash_grid_bwd_rule(sm_scale, causal, interpret, res, do3):
+    q3, k3, v3, o3, lse = res
+    dq, dk, dv = _bwd_grid(q3, k3, v3, o3, lse, do3, sm_scale, causal, interpret)
+    return dq, dk, dv
+
+
+_flash_grid.defvjp(_flash_grid_fwd_rule, _flash_grid_bwd_rule)
+
+
+# ---------------------------------------------------------------------------
 # public API with custom VJP
 # ---------------------------------------------------------------------------
 
@@ -251,21 +538,34 @@ def _flash_bwd_rule(sm_scale, causal, interpret, res, do3):
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
+def flash_ok(S: int, D: int) -> bool:
+    """THE shape predicate for single-device flash dispatch: tiling-legal and
+    within the grid kernel's ceiling. One copy, used by the ops dispatchers,
+    so they can never disagree with flash_attention's own checks (the ring
+    path adds its per-shard VMEM bound on top via ring_flash_ok)."""
+    return S % BQ == 0 and S % BK == 0 and D % 64 == 0 and S <= GRID_KERNEL_MAX_SEQ
+
+
 def flash_attention(q, k, v, causal: bool = True, sm_scale: Optional[float] = None, interpret: bool = False):
-    """[B,S,H,D] causal flash attention. S must be a multiple of 128."""
+    """[B,S,H,D] flash attention (causal by default). S must be a multiple of
+    128. Sequences within the whole-K/V VMEM budget use the resident kernels
+    (fewer grid steps, chip-validated first); longer sequences stream K/V
+    block-by-block through the grid variant, whose only length bound is HBM."""
     B, S, H, D = q.shape
     if S % BQ != 0 or S % BK != 0:
         raise ValueError(f"seq {S} must be a multiple of {BQ}/{BK}")
-    if S * D * q.dtype.itemsize > VMEM_RESIDENT_BYTES:
+    if S > GRID_KERNEL_MAX_SEQ:
         raise ValueError(
-            f"seq {S} x head_dim {D} exceeds the whole-K/V-in-VMEM budget of this "
-            f"kernel ({VMEM_RESIDENT_BYTES} B); shard the sequence (sp axis / ring "
-            "attention) or reduce per-device sequence length"
+            f"seq {S} exceeds the grid kernel's bookkeeping ceiling "
+            f"({GRID_KERNEL_MAX_SEQ}): the [B*H, S, 128] f32 lse/delta "
+            "residuals dominate HBM past it — shard the sequence (sp axis / "
+            "ring attention) instead"
         )
     scale = sm_scale if sm_scale is not None else 1.0 / (D**0.5)
 
     def to3(x):
         return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
 
-    o3 = _flash(to3(q), to3(k), to3(v), float(scale), bool(causal), bool(interpret))
+    impl = _flash if S * D * q.dtype.itemsize <= VMEM_RESIDENT_BYTES else _flash_grid
+    o3 = impl(to3(q), to3(k), to3(v), float(scale), bool(causal), bool(interpret))
     return o3.reshape(B, H, S, D).transpose(0, 2, 1, 3)

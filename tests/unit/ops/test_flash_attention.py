@@ -77,10 +77,83 @@ def test_seq_not_multiple_raises():
         flash_attention(q, k, v, interpret=True)
 
 
-def test_vmem_budget_raises():
-    from deepspeed_tpu.ops.pallas.flash_attention import VMEM_RESIDENT_BYTES
+class TestGridVariant:
+    """KV-blocked kernels: K/V stream through the grid with online-softmax
+    state in VMEM scratch — the no-sequence-bound path used past the
+    whole-K/V budget."""
 
-    S = 128 * ((VMEM_RESIDENT_BYTES // (64 * 4)) // 128 + 1)
-    q = jnp.zeros((1, S, 1, 64), jnp.float32)
-    with pytest.raises(ValueError, match="VMEM"):
-        flash_attention(q, q, q, interpret=True)
+    def _grid(self, q, k, v, causal=True, sm_scale=None):
+        from deepspeed_tpu.ops.pallas.flash_attention import _flash_grid
+
+        B, S, H, D = q.shape
+        scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(D)
+
+        def to3(x):
+            return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+
+        o3 = _flash_grid(to3(q), to3(k), to3(v), float(scale), causal, True)
+        return o3.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+
+    @pytest.mark.parametrize("shape", [(1, 256, 2, 64), (1, 384, 1, 128)])
+    def test_forward_parity(self, shape):
+        q, k, v = _qkv(*shape, seed=5)
+        np.testing.assert_allclose(
+            np.asarray(self._grid(q, k, v)),
+            np.asarray(causal_attention_jnp(q, k, v)),
+            atol=2e-5, rtol=2e-5,
+        )
+
+    def test_forward_matches_resident_kernel(self):
+        q, k, v = _qkv(2, 256, 2, 64, seed=6)
+        np.testing.assert_allclose(
+            np.asarray(self._grid(q, k, v)),
+            np.asarray(flash_attention(q, k, v, interpret=True)),
+            atol=1e-6, rtol=1e-6,
+        )
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_backward_parity(self, causal):
+        q, k, v = _qkv(1, 256, 2, 64, seed=7)
+        scale = 1.0 / np.sqrt(64)
+
+        def ref_attn(q, k, v):
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+            if causal:
+                mask = jnp.tril(jnp.ones((256, 256), jnp.bool_))
+                logits = jnp.where(mask[None, None], logits, jnp.float32(-1e30))
+            probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+            return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+        g1 = jax.grad(
+            lambda q, k, v: jnp.sum(self._grid(q, k, v, causal=causal) ** 2),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        g2 = jax.grad(
+            lambda q, k, v: jnp.sum(ref_attn(q, k, v) ** 2), argnums=(0, 1, 2)
+        )(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5, rtol=5e-4)
+
+    def test_past_budget_dispatches_to_grid(self, monkeypatch):
+        """flash_attention no longer raises past the VMEM budget: it streams."""
+        from deepspeed_tpu.ops.pallas import flash_attention as fa
+
+        monkeypatch.setattr(fa, "VMEM_RESIDENT_BYTES", 1)
+        q, k, v = _qkv(1, 256, 1, 64, seed=8)
+        o = fa.flash_attention(q, k, v, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(o), np.asarray(causal_attention_jnp(q, k, v)),
+            atol=2e-5, rtol=2e-5,
+        )
+
+    def test_grid_ceiling_raises_and_predicate_agrees(self, monkeypatch):
+        """Past GRID_KERNEL_MAX_SEQ flash_attention rejects with a clear
+        message, and the shared flash_ok predicate agrees (so 'auto'
+        dispatchers never route a shape the kernel would refuse)."""
+        from deepspeed_tpu.ops.pallas import flash_attention as fa
+
+        monkeypatch.setattr(fa, "GRID_KERNEL_MAX_SEQ", 128)
+        assert fa.flash_ok(128, 64) and not fa.flash_ok(256, 64)
+        q, k, v = _qkv(1, 256, 1, 64, seed=9)
+        with pytest.raises(ValueError, match="ceiling"):
+            fa.flash_attention(q, k, v, interpret=True)
