@@ -494,6 +494,23 @@ def _bwd_grid(q3, k3, v3, o3, lse, do3, sm_scale: float, causal: bool, interpret
     return dq, dk, dv
 
 
+def _fwd_auto(q3, k3, v3, sm_scale: float, causal: bool, interpret: bool = False):
+    """Resident kernels inside the whole-K/V VMEM budget, grid variant past
+    it — the one dispatch point shared by flash_attention AND the ring(sp)
+    per-block compute."""
+    BH, S, D = q3.shape
+    if S * D * q3.dtype.itemsize <= VMEM_RESIDENT_BYTES:
+        return _fwd(q3, k3, v3, sm_scale, causal, interpret)
+    return _fwd_grid(q3, k3, v3, sm_scale, causal, interpret)
+
+
+def _bwd_auto(q3, k3, v3, o3, lse, do3, sm_scale: float, causal: bool, interpret: bool = False):
+    BH, S, D = q3.shape
+    if S * D * q3.dtype.itemsize <= VMEM_RESIDENT_BYTES:
+        return _bwd(q3, k3, v3, o3, lse, do3, sm_scale, causal, interpret)
+    return _bwd_grid(q3, k3, v3, o3, lse, do3, sm_scale, causal, interpret)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _flash_grid(q3, k3, v3, sm_scale: float, causal: bool, interpret: bool):
     o, _ = _fwd_grid(q3, k3, v3, sm_scale, causal, interpret)
@@ -520,18 +537,18 @@ _flash_grid.defvjp(_flash_grid_fwd_rule, _flash_grid_bwd_rule)
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _flash(q3, k3, v3, sm_scale: float, causal: bool, interpret: bool):
-    o, _ = _fwd(q3, k3, v3, sm_scale, causal, interpret)
+    o, _ = _fwd_auto(q3, k3, v3, sm_scale, causal, interpret)
     return o
 
 
 def _flash_fwd_rule(q3, k3, v3, sm_scale, causal, interpret):
-    o, lse = _fwd(q3, k3, v3, sm_scale, causal, interpret)
+    o, lse = _fwd_auto(q3, k3, v3, sm_scale, causal, interpret)
     return o, (q3, k3, v3, o, lse)
 
 
 def _flash_bwd_rule(sm_scale, causal, interpret, res, do3):
     q3, k3, v3, o3, lse = res
-    dq, dk, dv = _bwd(q3, k3, v3, o3, lse, do3, sm_scale, causal, interpret)
+    dq, dk, dv = _bwd_auto(q3, k3, v3, o3, lse, do3, sm_scale, causal, interpret)
     return dq, dk, dv
 
 
@@ -566,6 +583,6 @@ def flash_attention(q, k, v, causal: bool = True, sm_scale: Optional[float] = No
     def to3(x):
         return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
 
-    impl = _flash if S * D * q.dtype.itemsize <= VMEM_RESIDENT_BYTES else _flash_grid
-    o3 = impl(to3(q), to3(k), to3(v), float(scale), bool(causal), bool(interpret))
+    # _flash's VJP rules auto-dispatch resident-vs-grid by shape (_fwd_auto)
+    o3 = _flash(to3(q), to3(k), to3(v), float(scale), bool(causal), bool(interpret))
     return o3.reshape(B, H, S, D).transpose(0, 2, 1, 3)

@@ -33,14 +33,18 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .flash_attention import NUM_LANES, VMEM_RESIDENT_BYTES, _bwd, _fwd
+from .flash_attention import NUM_LANES, _bwd_auto as _bwd, _fwd_auto as _fwd, flash_ok
 
 NEG_BIG = -1e30
 
 
 def ring_flash_ok(s_loc: int, d: int, itemsize: int) -> bool:
-    """Same constraints as the single-device kernel, per sequence shard."""
-    return s_loc % 128 == 0 and d % 64 == 0 and s_loc * d * itemsize <= VMEM_RESIDENT_BYTES
+    """Same constraints as the single-device dispatch, per sequence shard:
+    each ring step runs the auto-dispatched flash compute (resident kernels
+    inside the whole-K/V VMEM budget, KV-blocked grid past it), so a shard
+    is admitted up to the grid kernel's ceiling. ``itemsize`` is kept for
+    callers' signatures; the budget split happens inside _fwd_auto."""
+    return flash_ok(s_loc, d)
 
 
 def _merge(u, m, l, o_j, lse_j):
@@ -179,8 +183,9 @@ def ring_flash_attention(
     B, S, H, D = q.shape
     if not ring_flash_ok(S, D, q.dtype.itemsize):
         raise ValueError(
-            f"ring flash needs S_loc % 128 == 0, D % 64 == 0 and a VMEM-"
-            f"resident block (got S_loc={S}, D={D})"
+            f"ring flash needs S_loc % 128 == 0, D % 64 == 0 and S_loc within "
+            f"the grid kernel's bookkeeping ceiling (got S_loc={S}, D={D}); "
+            "raise sp_size to shrink the per-device shard"
         )
     scale = float(sm_scale) if sm_scale is not None else 1.0 / (D**0.5)
 

@@ -217,8 +217,9 @@ def sequence_parallel_attention(
         impl = "ring"
     if impl == "ring":
         # auto-upgrade the ring's inner blockwise compute to the flash
-        # kernels when each device's received K/V block satisfies the
-        # kernel's constraints (VMEM-resident, MXU-tile-aligned)
+        # kernels when each device's shard is tile-aligned and within the
+        # grid kernel's ceiling (past the whole-K/V VMEM budget the inner
+        # compute streams K/V through the KV-blocked grid variant)
         from ..ops.pallas.ring_flash_attention import ring_flash_ok
 
         s_loc = q.shape[1] // sp_size
