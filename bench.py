@@ -138,7 +138,8 @@ def build_engine(model_name: str, seq: int, micro: int, n_dev: int, zero_stage: 
 
 
 def attn_impl_used(cfg, micro: int, seq: int) -> str:
-    """Which attention path the model's 'auto' dispatch takes at bench shapes."""
+    """Which attention path the model's 'auto' dispatch takes at bench shapes
+    (and which flash variant: VMEM-resident kernels vs the KV-blocked grid)."""
     import jax
     import jax.numpy as jnp
 
@@ -147,7 +148,12 @@ def attn_impl_used(cfg, micro: int, seq: int) -> str:
     if cfg.attn_impl not in ("auto", "pallas"):
         return cfg.attn_impl
     q = jax.ShapeDtypeStruct((micro, seq, cfg.n_head, cfg.head_dim), jnp.bfloat16)
-    return "pallas" if (cfg.attn_impl == "pallas" or _pallas_ok(q)) else "jnp"
+    if cfg.attn_impl == "pallas" or _pallas_ok(q):
+        from deepspeed_tpu.ops.pallas.flash_attention import VMEM_RESIDENT_BYTES
+
+        resident = seq * cfg.head_dim * 2 <= VMEM_RESIDENT_BYTES  # bf16
+        return "pallas" if resident else "pallas-grid"
+    return "jnp"
 
 
 def _probe_backend(timeout_s: float) -> tuple[bool, str]:
