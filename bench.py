@@ -149,10 +149,11 @@ def attn_impl_used(cfg, micro: int, seq: int) -> str:
         return cfg.attn_impl
     q = jax.ShapeDtypeStruct((micro, seq, cfg.n_head, cfg.head_dim), jnp.bfloat16)
     if cfg.attn_impl == "pallas" or _pallas_ok(q):
-        from deepspeed_tpu.ops.pallas.flash_attention import VMEM_RESIDENT_BYTES
+        from deepspeed_tpu.ops.pallas.flash_attention import resident_ok
 
-        resident = seq * cfg.head_dim * 2 <= VMEM_RESIDENT_BYTES  # bf16
-        return "pallas" if resident else "pallas-grid"
+        if resident_ok(seq, cfg.head_dim, q.dtype.itemsize):
+            return "pallas"
+        return "pallas-grid"
     return "jnp"
 
 

@@ -494,19 +494,26 @@ def _bwd_grid(q3, k3, v3, o3, lse, do3, sm_scale: float, causal: bool, interpret
     return dq, dk, dv
 
 
+def resident_ok(S: int, D: int, itemsize: int) -> bool:
+    """THE resident-vs-grid split: whether one (batch, head)'s K or V slab
+    fits the whole-K/V VMEM budget. Shared by the auto dispatchers and any
+    telemetry that reports which variant served a shape."""
+    return S * D * itemsize <= VMEM_RESIDENT_BYTES
+
+
 def _fwd_auto(q3, k3, v3, sm_scale: float, causal: bool, interpret: bool = False):
     """Resident kernels inside the whole-K/V VMEM budget, grid variant past
     it — the one dispatch point shared by flash_attention AND the ring(sp)
     per-block compute."""
     BH, S, D = q3.shape
-    if S * D * q3.dtype.itemsize <= VMEM_RESIDENT_BYTES:
+    if resident_ok(S, D, q3.dtype.itemsize):
         return _fwd(q3, k3, v3, sm_scale, causal, interpret)
     return _fwd_grid(q3, k3, v3, sm_scale, causal, interpret)
 
 
 def _bwd_auto(q3, k3, v3, o3, lse, do3, sm_scale: float, causal: bool, interpret: bool = False):
     BH, S, D = q3.shape
-    if S * D * q3.dtype.itemsize <= VMEM_RESIDENT_BYTES:
+    if resident_ok(S, D, q3.dtype.itemsize):
         return _bwd(q3, k3, v3, o3, lse, do3, sm_scale, causal, interpret)
     return _bwd_grid(q3, k3, v3, o3, lse, do3, sm_scale, causal, interpret)
 
