@@ -170,6 +170,12 @@ def _attention(cfg: DecoderConfig, lp, h, k_cache, v_cache, pos, layer_window):
         out = out + b if b is not None else out
         return out.reshape(B, S, nh, D)
 
+    def out_proj(o):
+        out = o @ _deq(lp["wo"], o.dtype)
+        if lp.get("bo") is not None:
+            out = out + lp["bo"]
+        return out
+
     q = proj(lp["wq"], lp.get("bq"), H)
     k_ = proj(lp["wk"], lp.get("bk"), KV)
     v = proj(lp["wv"], lp.get("bv"), KV)
@@ -185,6 +191,26 @@ def _attention(cfg: DecoderConfig, lp, h, k_cache, v_cache, pos, layer_window):
     Smax = k_cache.shape[1]
     scale = cfg.attn_scale if cfg.attn_scale is not None else 1.0 / np.sqrt(D)
 
+    if (
+        isinstance(pos, int)
+        and pos == 0
+        and S == Smax
+        and KV == H
+        and cfg.pos_emb != "alibi"
+        and not any(cfg.local_windows)
+    ):
+        # training/eval full-sequence path (hidden() passes pos=0 as a
+        # STATIC int): plain causal attention with no score biasing —
+        # dispatch through the shared op so MHA decoders (LLaMA-7B-class,
+        # OPT, GPT-J, NeoX, GPT-2-style) ride the Pallas flash kernels on
+        # TPU instead of materializing [S,S] scores. GQA models (KV < H:
+        # Mistral/Mixtral/LLaMA-70B) keep the grouped-einsum path — the
+        # flash kernels are MHA-only for now.
+        from ..ops.attention import causal_attention
+
+        o = causal_attention(q, k_, v, sm_scale=scale).reshape(B, S, E).astype(h.dtype)
+        return out_proj(o), k_cache, v_cache
+
     if S == 1 and KV == H and cfg.pos_emb != "alibi" and not any(cfg.local_windows):
         # single-token decode without score biasing: route through the
         # decode-attention dispatch (Pallas online-softmax kernel on TPU,
@@ -194,10 +220,7 @@ def _attention(cfg: DecoderConfig, lp, h, k_cache, v_cache, pos, layer_window):
 
         o1 = cached_attention(q[:, 0], k_cache, v_cache, pos, sm_scale=scale)
         o = o1.reshape(B, 1, E).astype(h.dtype)
-        out = o @ _deq(lp["wo"], o.dtype)
-        if lp.get("bo") is not None:
-            out = out + lp["bo"]
-        return out, k_cache, v_cache
+        return out_proj(o), k_cache, v_cache
 
     if KV != H:
         # grouped-query scores without materializing a repeated cache: the
@@ -233,10 +256,7 @@ def _attention(cfg: DecoderConfig, lp, h, k_cache, v_cache, pos, layer_window):
         o = jnp.einsum("bgrst,btgd->bsgrd", pg, v_cache).reshape(B, S, E).astype(h.dtype)
     else:
         o = jnp.einsum("bhst,bthd->bshd", probs, v_cache).reshape(B, S, E).astype(h.dtype)
-    out = o @ _deq(lp["wo"], o.dtype)
-    if lp.get("bo") is not None:
-        out = out + lp["bo"]
-    return out, k_cache, v_cache
+    return out_proj(o), k_cache, v_cache
 
 
 def _mlp(cfg: DecoderConfig, lp, x, train: bool = False, rng=None):
