@@ -191,25 +191,36 @@ def _attention(cfg: DecoderConfig, lp, h, k_cache, v_cache, pos, layer_window):
     Smax = k_cache.shape[1]
     scale = cfg.attn_scale if cfg.attn_scale is not None else 1.0 / np.sqrt(D)
 
-    if (
+    static_full_seq = (
         isinstance(pos, int)
         and pos == 0
         and S == Smax
-        and KV == H
         and cfg.pos_emb != "alibi"
         and not any(cfg.local_windows)
-    ):
+    )
+    if static_full_seq and KV == H:
         # training/eval full-sequence path (hidden() passes pos=0 as a
         # STATIC int): plain causal attention with no score biasing —
         # dispatch through the shared op so MHA decoders (LLaMA-7B-class,
         # OPT, GPT-J, NeoX, GPT-2-style) ride the Pallas flash kernels on
-        # TPU instead of materializing [S,S] scores. GQA models (KV < H:
-        # Mistral/Mixtral/LLaMA-70B) keep the grouped-einsum path — the
-        # flash kernels are MHA-only for now.
+        # TPU instead of materializing [S,S] scores
         from ..ops.attention import causal_attention
 
         o = causal_attention(q, k_, v, sm_scale=scale).reshape(B, S, E).astype(h.dtype)
         return out_proj(o), k_cache, v_cache
+    if static_full_seq and KV != H:
+        # GQA (Mistral/Mixtral/LLaMA-70B class): the flash kernels read each
+        # group's shared K/V block through a divided batch index map — the
+        # repeated cache is never materialized. Routed through the shared
+        # dispatcher (same warn-and-fall-back contract as the MHA branch);
+        # gated on the kernel actually engaging, because the dispatcher's
+        # jnp fallback repeats K/V while the grouped-einsum path below
+        # doesn't — off-TPU the no-repeat path wins
+        from ..ops.attention import causal_attention, pallas_attention_ok
+
+        if pallas_attention_ok(q):
+            o = causal_attention(q, k_, v, sm_scale=scale)
+            return out_proj(o.reshape(B, S, E).astype(h.dtype)), k_cache, v_cache
 
     if S == 1 and KV == H and cfg.pos_emb != "alibi" and not any(cfg.local_windows):
         # single-token decode without score biasing: route through the

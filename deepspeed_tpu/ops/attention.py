@@ -21,8 +21,14 @@ from ..utils.logging import warning_once
 
 
 def causal_attention_jnp(q, k, v, sm_scale: Optional[float] = None):
-    """Reference implementation: [B,S,H,D] → [B,S,H,D], causal, f32 softmax."""
+    """Reference implementation: [B,S,H,D] → [B,S,H,D], causal, f32 softmax.
+    Accepts GQA k/v ([B,S,KV,D], H % KV == 0) by repeating — a fallback
+    path, so the materialized repeat is acceptable."""
     B, S, H, D = q.shape
+    if k.shape[2] != H:
+        rep = H // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     scale = sm_scale if sm_scale is not None else 1.0 / (D**0.5)
     logits = jnp.einsum(
         "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
@@ -45,6 +51,12 @@ def _pallas_ok(q) -> bool:
     from .pallas.flash_attention import flash_ok
 
     return flash_ok(S, D)
+
+
+# public name for model code deciding whether the kernel path will engage
+# (e.g. the decoder zoo's GQA prefill keeps its no-repeat grouped einsum
+# off-TPU instead of the jnp fallback's materialized repeat)
+pallas_attention_ok = _pallas_ok
 
 
 def cached_attention(q, k_cache, v_cache, pos, impl: str = "auto", sm_scale: Optional[float] = None):

@@ -139,8 +139,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale: float, causal:
     lse_ref[0] = jax.lax.broadcast_in_dim((m + jnp.log(l))[:, 0], (BQ, NUM_LANES), (0,))
 
 
-def _fwd(q3, k3, v3, sm_scale: float, causal: bool, interpret: bool = False):
-    """q3/k3/v3: [BH, S, D] → (o [BH,S,D], lse [BH,S])."""
+def _fwd(q3, k3, v3, sm_scale: float, causal: bool, interpret: bool = False, kv_rep: int = 1):
+    """q3: [BH, S, D], k3/v3: [BH // kv_rep, S, D] → (o [BH,S,D], lse).
+
+    ``kv_rep`` > 1 is grouped-query attention: the flattened batch dim packs
+    q heads group-major (bh = (b*KV + g)*rep + r), so the K/V index maps
+    simply divide by rep — every q head in a group reads the SAME K/V block
+    and the repeated cache is never materialized."""
     BH, S, D = q3.shape
     grid = (BH, S // BQ)
     kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal, seq_len=S)
@@ -150,8 +155,8 @@ def _fwd(q3, k3, v3, sm_scale: float, causal: bool, interpret: bool = False):
         interpret=interpret,
         in_specs=[
             pl.BlockSpec((1, BQ, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b // kv_rep, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b // kv_rep, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, BQ, D), lambda b, i: (b, i, 0)),
@@ -213,20 +218,26 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _bwd(q3, k3, v3, o3, lse, do3, sm_scale: float, causal: bool, interpret: bool = False):
+def _bwd(q3, k3, v3, o3, lse, do3, sm_scale: float, causal: bool, interpret: bool = False, kv_rep: int = 1):
+    """Grads for _fwd. With ``kv_rep`` > 1 (GQA) the dk/dv kernels run at
+    per-q-head resolution ([BH,S,D], each reading its group's K/V block via
+    the divided index map); the caller sums the rep axis to get the true
+    [BH//rep, S, D] K/V grads (gradient of a shared tensor accumulates over
+    the q heads sharing it)."""
     BH, S, D = q3.shape
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1)  # [BH,S]
     delta = jnp.broadcast_to(delta[..., None], (BH, S, NUM_LANES))
 
     full = lambda b, i: (b, 0, 0)
+    kv_full = lambda b, i: (b // kv_rep, 0, 0)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal, seq_len=S),
         grid=(BH, S // BQ),
         interpret=interpret,
         in_specs=[
             pl.BlockSpec((1, BQ, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, S, D), full),
-            pl.BlockSpec((1, S, D), full),
+            pl.BlockSpec((1, S, D), kv_full),
+            pl.BlockSpec((1, S, D), kv_full),
             pl.BlockSpec((1, BQ, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, BQ, NUM_LANES), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, BQ, NUM_LANES), lambda b, i: (b, i, 0)),
@@ -241,8 +252,8 @@ def _bwd(q3, k3, v3, o3, lse, do3, sm_scale: float, causal: bool, interpret: boo
         interpret=interpret,
         in_specs=[
             pl.BlockSpec((1, S, D), full),
-            pl.BlockSpec((1, BK, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, BK, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, BK, D), lambda b, i: (b // kv_rep, i, 0)),
+            pl.BlockSpec((1, BK, D), lambda b, i: (b // kv_rep, i, 0)),
             pl.BlockSpec((1, S, D), full),
             pl.BlockSpec((1, S, NUM_LANES), full),
             pl.BlockSpec((1, S, NUM_LANES), full),
@@ -252,10 +263,15 @@ def _bwd(q3, k3, v3, o3, lse, do3, sm_scale: float, causal: bool, interpret: boo
             pl.BlockSpec((1, BK, D), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, S, D), q3.dtype),
-            jax.ShapeDtypeStruct((BH, S, D), q3.dtype),
+            # GQA: per-q-head grads stay f32 so the rep-axis sum below
+            # rounds to the storage dtype exactly once (like the MHA path)
+            jax.ShapeDtypeStruct((BH, S, D), jnp.float32 if kv_rep > 1 else q3.dtype),
+            jax.ShapeDtypeStruct((BH, S, D), jnp.float32 if kv_rep > 1 else q3.dtype),
         ],
     )(q3, k3, v3, do3, lse, delta)
+    if kv_rep > 1:
+        dk = dk.reshape(BH // kv_rep, kv_rep, S, D).sum(axis=1).astype(k3.dtype)
+        dv = dv.reshape(BH // kv_rep, kv_rep, S, D).sum(axis=1).astype(v3.dtype)
     return dq, dk, dv
 
 
@@ -333,13 +349,16 @@ def _fwd_grid_kernel(
         lse_ref[0] = m_ref[...] + jnp.log(jnp.maximum(l_ref[...], 1e-30))
 
 
-def _fwd_grid(q3, k3, v3, sm_scale: float, causal: bool, interpret: bool = False):
+def _fwd_grid(q3, k3, v3, sm_scale: float, causal: bool, interpret: bool = False, kv_rep: int = 1):
     BH, S, D = q3.shape
     nq, nk = S // BQ, S // BK
     kernel = functools.partial(
         _fwd_grid_kernel, sm_scale=sm_scale, causal=causal, num_k_blocks=nk
     )
-    kv_idx = _kv_index_causal if causal else (lambda b, i, j: (b, j, 0))
+    if causal:
+        kv_idx = lambda b, i, j: _kv_index_causal(b // kv_rep, i, j)
+    else:
+        kv_idx = lambda b, i, j: (b // kv_rep, j, 0)
     o, lse = pl.pallas_call(
         kernel,
         grid=(BH, nq, nk),
@@ -435,13 +454,16 @@ def _bwd_dkv_grid_kernel(
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _bwd_grid(q3, k3, v3, o3, lse, do3, sm_scale: float, causal: bool, interpret: bool = False):
+def _bwd_grid(q3, k3, v3, o3, lse, do3, sm_scale: float, causal: bool, interpret: bool = False, kv_rep: int = 1):
     BH, S, D = q3.shape
     nq, nk = S // BQ, S // BK
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta[..., None], (BH, S, NUM_LANES))
 
-    kv_idx = _kv_index_causal if causal else (lambda b, i, j: (b, j, 0))
+    if causal:
+        kv_idx = lambda b, i, j: _kv_index_causal(b // kv_rep, i, j)
+    else:
+        kv_idx = lambda b, i, j: (b // kv_rep, j, 0)
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_grid_kernel, sm_scale=sm_scale, causal=causal, num_k_blocks=nk
@@ -471,8 +493,8 @@ def _bwd_grid(q3, k3, v3, o3, lse, do3, sm_scale: float, causal: bool, interpret
         interpret=interpret,
         in_specs=[
             pl.BlockSpec((1, BQ, D), q_idx),
-            pl.BlockSpec((1, BK, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, BK, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, BK, D), lambda b, j, i: (b // kv_rep, j, 0)),
+            pl.BlockSpec((1, BK, D), lambda b, j, i: (b // kv_rep, j, 0)),
             pl.BlockSpec((1, BQ, D), q_idx),
             pl.BlockSpec((1, BQ, NUM_LANES), q_idx),
             pl.BlockSpec((1, BQ, NUM_LANES), q_idx),
@@ -482,8 +504,9 @@ def _bwd_grid(q3, k3, v3, o3, lse, do3, sm_scale: float, causal: bool, interpret
             pl.BlockSpec((1, BK, D), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, S, D), q3.dtype),
-            jax.ShapeDtypeStruct((BH, S, D), q3.dtype),
+            # GQA: f32 per-q-head grads, one rounding after the rep sum
+            jax.ShapeDtypeStruct((BH, S, D), jnp.float32 if kv_rep > 1 else q3.dtype),
+            jax.ShapeDtypeStruct((BH, S, D), jnp.float32 if kv_rep > 1 else q3.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((BK, D), jnp.float32),
@@ -491,6 +514,9 @@ def _bwd_grid(q3, k3, v3, o3, lse, do3, sm_scale: float, causal: bool, interpret
         ],
         compiler_params=_GRID_PARAMS,
     )(q3, k3, v3, do3, lse, delta)
+    if kv_rep > 1:
+        dk = dk.reshape(BH // kv_rep, kv_rep, S, D).sum(axis=1).astype(k3.dtype)
+        dv = dv.reshape(BH // kv_rep, kv_rep, S, D).sum(axis=1).astype(v3.dtype)
     return dq, dk, dv
 
 
@@ -501,21 +527,21 @@ def resident_ok(S: int, D: int, itemsize: int) -> bool:
     return S * D * itemsize <= VMEM_RESIDENT_BYTES
 
 
-def _fwd_auto(q3, k3, v3, sm_scale: float, causal: bool, interpret: bool = False):
+def _fwd_auto(q3, k3, v3, sm_scale: float, causal: bool, interpret: bool = False, kv_rep: int = 1):
     """Resident kernels inside the whole-K/V VMEM budget, grid variant past
     it — the one dispatch point shared by flash_attention AND the ring(sp)
     per-block compute."""
     BH, S, D = q3.shape
     if resident_ok(S, D, q3.dtype.itemsize):
-        return _fwd(q3, k3, v3, sm_scale, causal, interpret)
-    return _fwd_grid(q3, k3, v3, sm_scale, causal, interpret)
+        return _fwd(q3, k3, v3, sm_scale, causal, interpret, kv_rep)
+    return _fwd_grid(q3, k3, v3, sm_scale, causal, interpret, kv_rep)
 
 
-def _bwd_auto(q3, k3, v3, o3, lse, do3, sm_scale: float, causal: bool, interpret: bool = False):
+def _bwd_auto(q3, k3, v3, o3, lse, do3, sm_scale: float, causal: bool, interpret: bool = False, kv_rep: int = 1):
     BH, S, D = q3.shape
     if resident_ok(S, D, q3.dtype.itemsize):
-        return _bwd(q3, k3, v3, o3, lse, do3, sm_scale, causal, interpret)
-    return _bwd_grid(q3, k3, v3, o3, lse, do3, sm_scale, causal, interpret)
+        return _bwd(q3, k3, v3, o3, lse, do3, sm_scale, causal, interpret, kv_rep)
+    return _bwd_grid(q3, k3, v3, o3, lse, do3, sm_scale, causal, interpret, kv_rep)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -542,20 +568,20 @@ _flash_grid.defvjp(_flash_grid_fwd_rule, _flash_grid_bwd_rule)
 # public API with custom VJP
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(q3, k3, v3, sm_scale: float, causal: bool, interpret: bool):
-    o, _ = _fwd_auto(q3, k3, v3, sm_scale, causal, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q3, k3, v3, sm_scale: float, causal: bool, interpret: bool, kv_rep: int = 1):
+    o, _ = _fwd_auto(q3, k3, v3, sm_scale, causal, interpret, kv_rep)
     return o
 
 
-def _flash_fwd_rule(q3, k3, v3, sm_scale, causal, interpret):
-    o, lse = _fwd_auto(q3, k3, v3, sm_scale, causal, interpret)
+def _flash_fwd_rule(q3, k3, v3, sm_scale, causal, interpret, kv_rep=1):
+    o, lse = _fwd_auto(q3, k3, v3, sm_scale, causal, interpret, kv_rep)
     return o, (q3, k3, v3, o, lse)
 
 
-def _flash_bwd_rule(sm_scale, causal, interpret, res, do3):
+def _flash_bwd_rule(sm_scale, causal, interpret, kv_rep, res, do3):
     q3, k3, v3, o3, lse = res
-    dq, dk, dv = _bwd_auto(q3, k3, v3, o3, lse, do3, sm_scale, causal, interpret)
+    dq, dk, dv = _bwd_auto(q3, k3, v3, o3, lse, do3, sm_scale, causal, interpret, kv_rep)
     return dq, dk, dv
 
 
@@ -574,8 +600,18 @@ def flash_attention(q, k, v, causal: bool = True, sm_scale: Optional[float] = No
     """[B,S,H,D] flash attention (causal by default). S must be a multiple of
     128. Sequences within the whole-K/V VMEM budget use the resident kernels
     (fewer grid steps, chip-validated first); longer sequences stream K/V
-    block-by-block through the grid variant, whose only length bound is HBM."""
+    block-by-block through the grid variant, whose only length bound is HBM.
+
+    Grouped-query attention: ``k``/``v`` may carry fewer heads than ``q``
+    ([B,S,KV,D] with H % KV == 0). The kernels read each group's shared K/V
+    block through a divided batch index map — the repeated cache is never
+    materialized in HBM or VMEM, and dk/dv accumulate over the group."""
     B, S, H, D = q.shape
+    KV = k.shape[2]
+    if v.shape[2] != KV or H % KV != 0:
+        raise ValueError(
+            f"kv heads ({KV}/{v.shape[2]}) must match and divide q heads ({H})"
+        )
     if S % BQ != 0 or S % BK != 0:
         raise ValueError(f"seq {S} must be a multiple of {BQ}/{BK}")
     if S > GRID_KERNEL_MAX_SEQ:
@@ -586,10 +622,13 @@ def flash_attention(q, k, v, causal: bool = True, sm_scale: Optional[float] = No
             "ring attention) instead"
         )
     scale = sm_scale if sm_scale is not None else 1.0 / (D**0.5)
+    rep = H // KV
 
     def to3(x):
-        return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+        nh = x.shape[2]
+        return x.transpose(0, 2, 1, 3).reshape(B * nh, S, D)
 
-    # _flash's VJP rules auto-dispatch resident-vs-grid by shape (_fwd_auto)
-    o3 = _flash(to3(q), to3(k), to3(v), float(scale), bool(causal), bool(interpret))
+    # batch-major flattening makes bh = (b*KV + g)*rep + r for q and
+    # b*KV + g for k/v, so bh // rep recovers the kv row exactly
+    o3 = _flash(to3(q), to3(k), to3(v), float(scale), bool(causal), bool(interpret), rep)
     return o3.reshape(B, H, S, D).transpose(0, 2, 1, 3)
