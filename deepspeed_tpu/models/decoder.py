@@ -222,11 +222,12 @@ def _attention(cfg: DecoderConfig, lp, h, k_cache, v_cache, pos, layer_window):
             o = causal_attention(q, k_, v, sm_scale=scale)
             return out_proj(o.reshape(B, S, E).astype(h.dtype)), k_cache, v_cache
 
-    if S == 1 and KV == H and cfg.pos_emb != "alibi" and not any(cfg.local_windows):
-        # single-token decode without score biasing: route through the
-        # decode-attention dispatch (Pallas online-softmax kernel on TPU,
-        # jnp fallback) — RoPE is already applied pre-cache so the kernel
-        # sees plain dot-product attention
+    if S == 1 and cfg.pos_emb != "alibi" and not any(cfg.local_windows):
+        # single-token decode without score biasing (MHA and GQA): route
+        # through the decode-attention dispatch (Pallas online-softmax
+        # kernel on TPU — GQA reads the KV-headed cache via a divided head
+        # index map, never repeated; grouped-einsum jnp fallback) — RoPE is
+        # already applied pre-cache so the kernel sees plain dot products
         from ..ops.attention import cached_attention
 
         o1 = cached_attention(q[:, 0], k_cache, v_cache, pos, sm_scale=scale)

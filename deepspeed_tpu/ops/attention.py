@@ -61,14 +61,24 @@ pallas_attention_ok = _pallas_ok
 
 def cached_attention(q, k_cache, v_cache, pos, impl: str = "auto", sm_scale: Optional[float] = None):
     """Single-token decode attention against a KV cache: q [B,H,D],
-    caches [B,Smax,H,D], pos = highest valid index → [B,H,D].
+    caches [B,Smax,KV,D] (KV == H, or H % KV == 0 for GQA),
+    pos = highest valid index → [B,H,D].
 
     Dispatch mirrors :func:`causal_attention`: the Pallas online-softmax
     decode kernel on TPU (reference softmax_context fused inference kernel),
-    jnp fallback elsewhere, with the same warn-and-fall-back contract.
+    jnp fallback elsewhere, with the same warn-and-fall-back contract. The
+    jnp GQA fallback is a grouped einsum — the cache is never repeated on
+    either path.
     """
     B, H, D = q.shape
-    S = k_cache.shape[1]
+    S, KV = k_cache.shape[1], k_cache.shape[2]
+    # validate the head ratio HERE: raised inside the kernel, the auto
+    # dispatch would swallow it as a "pallas unavailable" warning and the
+    # fallback would then fail with an unrelated reshape error
+    if v_cache.shape[2] != KV or H % KV != 0:
+        raise ValueError(
+            f"kv heads ({KV}/{v_cache.shape[2]}) must match and divide q heads ({H})"
+        )
     if impl in ("auto", "pallas"):
         from .pallas.decode_attention import decode_attention, decode_attention_ok
 
@@ -82,12 +92,16 @@ def cached_attention(q, k_cache, v_cache, pos, impl: str = "auto", sm_scale: Opt
     elif impl != "jnp":
         raise ValueError(f"unknown attention impl {impl}")
     scale = sm_scale if sm_scale is not None else 1.0 / (D**0.5)
-    scores = jnp.einsum(
-        "bhd,bshd->bhs", q.astype(jnp.float32), k_cache.astype(jnp.float32)
-    ) * scale
     mask = jnp.arange(S)[None, None, :] <= pos
-    probs = jax.nn.softmax(jnp.where(mask, scores, -1e30), axis=-1)
-    return jnp.einsum("bhs,bshd->bhd", probs, v_cache.astype(jnp.float32)).astype(q.dtype)
+    # one grouped form covers MHA too (rep == 1): no duplicated math
+    rep = H // KV
+    qg = q.reshape(B, KV, rep, D)
+    scores = jnp.einsum(
+        "bgrd,bsgd->bgrs", qg.astype(jnp.float32), k_cache.astype(jnp.float32)
+    ) * scale
+    probs = jax.nn.softmax(jnp.where(mask[:, :, None], scores, -1e30), axis=-1)
+    o = jnp.einsum("bgrs,bsgd->bgrd", probs, v_cache.astype(jnp.float32))
+    return o.reshape(B, H, D).astype(q.dtype)
 
 
 def causal_attention(q, k, v, impl: str = "auto", sm_scale: Optional[float] = None):

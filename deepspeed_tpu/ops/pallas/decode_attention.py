@@ -59,15 +59,24 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, sm_scale: float,
 
 def decode_attention(
     q: jnp.ndarray,  # [B, H, D] current-step queries
-    k_cache: jnp.ndarray,  # [B, Smax, H, D]
-    v_cache: jnp.ndarray,  # [B, Smax, H, D]
+    k_cache: jnp.ndarray,  # [B, Smax, KV, D]; KV == H or H % KV == 0 (GQA)
+    v_cache: jnp.ndarray,  # [B, Smax, KV, D]
     pos: jnp.ndarray,  # i32: highest valid cache index (inclusive)
     sm_scale: Optional[float] = None,
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """Single-token cached attention → [B, H, D]."""
+    """Single-token cached attention → [B, H, D].
+
+    GQA (KV < H): each q head's program reads its group's cache column via
+    a divided head index map — the cache stays at KV heads, never repeated
+    (the memory saving that motivates GQA serving)."""
     B, H, D = q.shape
-    S = k_cache.shape[1]
+    S, KV = k_cache.shape[1], k_cache.shape[2]
+    if v_cache.shape[2] != KV or H % KV != 0:
+        raise ValueError(
+            f"kv heads ({KV}/{v_cache.shape[2]}) must match and divide q heads ({H})"
+        )
+    rep = H // KV
     s_block = S if S < S_BLOCK else S_BLOCK
     assert S % s_block == 0, f"cache length {S} not a multiple of {s_block}"
     scale = sm_scale if sm_scale is not None else 1.0 / (D**0.5)
@@ -82,8 +91,8 @@ def decode_attention(
             grid=(B, H),
             in_specs=[
                 pl.BlockSpec((1, 1, D), lambda b, h, pos: (b, h, 0)),
-                pl.BlockSpec((1, S, 1, D), lambda b, h, pos: (b, 0, h, 0)),
-                pl.BlockSpec((1, S, 1, D), lambda b, h, pos: (b, 0, h, 0)),
+                pl.BlockSpec((1, S, 1, D), lambda b, h, pos: (b, 0, h // rep, 0)),
+                pl.BlockSpec((1, S, 1, D), lambda b, h, pos: (b, 0, h // rep, 0)),
             ],
             out_specs=pl.BlockSpec((1, 1, D), lambda b, h, pos: (b, h, 0)),
         ),

@@ -50,3 +50,47 @@ def test_same_compiled_kernel_all_positions():
     np.testing.assert_allclose(np.asarray(o0), np.asarray(_ref(q, k, v, 0)), atol=2e-5)
     np.testing.assert_allclose(np.asarray(o1), np.asarray(_ref(q, k, v, 100)), atol=2e-5)
     assert not np.allclose(np.asarray(o0), np.asarray(o1))
+
+
+class TestGQADecode:
+    """GQA decode: caches at KV heads read via divided head index maps."""
+
+    def _ref_gqa(self, q, k_cache, v_cache, pos):
+        B, H, D = q.shape
+        S, KV = k_cache.shape[1], k_cache.shape[2]
+        rep = H // KV
+        kf = jnp.repeat(k_cache, rep, axis=2)
+        vf = jnp.repeat(v_cache, rep, axis=2)
+        return _ref(q, kf, vf, pos)
+
+    @pytest.mark.parametrize("rep", [2, 4])
+    @pytest.mark.parametrize("pos", [0, 31])
+    def test_kernel_matches_reference(self, rep, pos):
+        B, S, H, D = 2, 64, 4, 64
+        rs = np.random.RandomState(1)
+        q = jnp.asarray(rs.randn(B, H, D), jnp.float32)
+        k = jnp.asarray(rs.randn(B, S, H // rep, D), jnp.float32)
+        v = jnp.asarray(rs.randn(B, S, H // rep, D), jnp.float32)
+        out = decode_attention(q, k, v, jnp.int32(pos), interpret=True)
+        ref = self._ref_gqa(q, k, v, pos)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def test_dispatcher_gqa_fallback_is_grouped(self):
+        """cached_attention's jnp GQA path (no kernel off-TPU) matches the
+        repeat-based reference without materializing the repeat."""
+        from deepspeed_tpu.ops.attention import cached_attention
+
+        B, S, H, D, rep = 2, 64, 4, 64, 2
+        rs = np.random.RandomState(2)
+        q = jnp.asarray(rs.randn(B, H, D), jnp.float32)
+        k = jnp.asarray(rs.randn(B, S, H // rep, D), jnp.float32)
+        v = jnp.asarray(rs.randn(B, S, H // rep, D), jnp.float32)
+        out = cached_attention(q, k, v, jnp.int32(31), impl="jnp")
+        ref = self._ref_gqa(q, k, v, 31)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def test_bad_ratio_raises(self):
+        q = jnp.zeros((1, 4, 64))
+        k = jnp.zeros((1, 64, 3, 64))
+        with pytest.raises(ValueError, match="divide"):
+            decode_attention(q, k, k, jnp.int32(0), interpret=True)

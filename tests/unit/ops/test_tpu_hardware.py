@@ -301,3 +301,17 @@ class TestGQAFlashHardware:
         )(k)
         assert gk.shape == k.shape  # dk at KV heads
         assert np.isfinite(np.asarray(gk, np.float32)).all()
+
+    def test_gqa_decode_kernel_on_chip(self):
+        from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
+
+        B, S, H, D, rep = 2, 1024, 4, 128, 2
+        rs = np.random.RandomState(15)
+        q = jnp.asarray(rs.randn(B, H, D), jnp.bfloat16)
+        k = jnp.asarray(rs.randn(B, S, H // rep, D), jnp.bfloat16)
+        v = jnp.asarray(rs.randn(B, S, H // rep, D), jnp.bfloat16)
+        out = jax.jit(lambda q, k, v, p: decode_attention(q, k, v, p))(
+            q, k, v, jnp.int32(100)
+        )
+        assert out.shape == (B, H, D)
+        assert np.isfinite(np.asarray(out, np.float32)).all()
