@@ -18,7 +18,11 @@ def _qkv(B, S, H, D, seed=0, dtype=jnp.float32):
     return [jnp.asarray(rs.randn(B, S, H, D), dtype) for _ in range(3)]
 
 
-@pytest.mark.parametrize("shape", [(1, 128, 2, 64), (2, 256, 2, 64), (1, 384, 1, 128)])
+@pytest.mark.parametrize(
+    "shape",
+    [(1, 128, 2, 64), (2, 256, 2, 64), (1, 384, 1, 128), (1, 128, 3, 256),
+     (3, 128, 2, 64)],
+)
 def test_forward_parity(shape):
     q, k, v = _qkv(*shape)
     o_ref = causal_attention_jnp(q, k, v)
