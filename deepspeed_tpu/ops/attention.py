@@ -70,15 +70,14 @@ def cached_attention(q, k_cache, v_cache, pos, impl: str = "auto", sm_scale: Opt
     jnp GQA fallback is a grouped einsum — the cache is never repeated on
     either path.
     """
+    from .pallas.flash_attention import validate_kv_heads
+
     B, H, D = q.shape
     S, KV = k_cache.shape[1], k_cache.shape[2]
     # validate the head ratio HERE: raised inside the kernel, the auto
     # dispatch would swallow it as a "pallas unavailable" warning and the
     # fallback would then fail with an unrelated reshape error
-    if v_cache.shape[2] != KV or H % KV != 0:
-        raise ValueError(
-            f"kv heads ({KV}/{v_cache.shape[2]}) must match and divide q heads ({H})"
-        )
+    validate_kv_heads(H, k_cache, v_cache)
     if impl in ("auto", "pallas"):
         from .pallas.decode_attention import decode_attention, decode_attention_ok
 

@@ -70,13 +70,11 @@ def decode_attention(
     GQA (KV < H): each q head's program reads its group's cache column via
     a divided head index map — the cache stays at KV heads, never repeated
     (the memory saving that motivates GQA serving)."""
+    from .flash_attention import validate_kv_heads
+
     B, H, D = q.shape
-    S, KV = k_cache.shape[1], k_cache.shape[2]
-    if v_cache.shape[2] != KV or H % KV != 0:
-        raise ValueError(
-            f"kv heads ({KV}/{v_cache.shape[2]}) must match and divide q heads ({H})"
-        )
-    rep = H // KV
+    S = k_cache.shape[1]
+    rep = validate_kv_heads(H, k_cache, v_cache)
     s_block = S if S < S_BLOCK else S_BLOCK
     assert S % s_block == 0, f"cache length {S} not a multiple of {s_block}"
     scale = sm_scale if sm_scale is not None else 1.0 / (D**0.5)

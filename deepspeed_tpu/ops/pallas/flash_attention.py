@@ -223,7 +223,15 @@ def _bwd(q3, k3, v3, o3, lse, do3, sm_scale: float, causal: bool, interpret: boo
     per-q-head resolution ([BH,S,D], each reading its group's K/V block via
     the divided index map); the caller sums the rep axis to get the true
     [BH//rep, S, D] K/V grads (gradient of a shared tensor accumulates over
-    the q heads sharing it)."""
+    the q heads sharing it).
+
+    Deliberate tradeoff: the per-q-head f32 staging transiently costs
+    rep x 4 bytes over the final dk/dv footprint. It buys exactly-once
+    rounding AND keeps the (batch*head) grid dimension parallel —
+    accumulating the group inside the kernel would force sequential
+    output-block revisiting over that dimension. dk/dv are layer-local
+    transients, so the peak coexists with one layer's backward only;
+    revisit if profiles show it matters at rep >= 8."""
     BH, S, D = q3.shape
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1)  # [BH,S]
     delta = jnp.broadcast_to(delta[..., None], (BH, S, NUM_LANES))
@@ -588,6 +596,17 @@ def _flash_bwd_rule(sm_scale, causal, interpret, kv_rep, res, do3):
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
+def validate_kv_heads(H: int, k, v) -> int:
+    """THE kv-head rule (one copy; decode + dispatch share it): K/V head
+    counts must match and divide the q head count. Returns rep = H // KV."""
+    KV = k.shape[-2]
+    if v.shape[-2] != KV or H % KV != 0:
+        raise ValueError(
+            f"kv heads ({KV}/{v.shape[-2]}) must match and divide q heads ({H})"
+        )
+    return H // KV
+
+
 def flash_ok(S: int, D: int) -> bool:
     """THE shape predicate for single-device flash dispatch: tiling-legal and
     within the grid kernel's ceiling. One copy, used by the ops dispatchers,
@@ -607,11 +626,7 @@ def flash_attention(q, k, v, causal: bool = True, sm_scale: Optional[float] = No
     block through a divided batch index map — the repeated cache is never
     materialized in HBM or VMEM, and dk/dv accumulate over the group."""
     B, S, H, D = q.shape
-    KV = k.shape[2]
-    if v.shape[2] != KV or H % KV != 0:
-        raise ValueError(
-            f"kv heads ({KV}/{v.shape[2]}) must match and divide q heads ({H})"
-        )
+    rep = validate_kv_heads(H, k, v)
     if S % BQ != 0 or S % BK != 0:
         raise ValueError(f"seq {S} must be a multiple of {BQ}/{BK}")
     if S > GRID_KERNEL_MAX_SEQ:
@@ -622,7 +637,6 @@ def flash_attention(q, k, v, causal: bool = True, sm_scale: Optional[float] = No
             "ring attention) instead"
         )
     scale = sm_scale if sm_scale is not None else 1.0 / (D**0.5)
-    rep = H // KV
 
     def to3(x):
         nh = x.shape[2]
