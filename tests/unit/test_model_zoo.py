@@ -359,6 +359,36 @@ class TestLlama:
         )
         np.testing.assert_array_equal(ours, ref[:, ids.shape[1]:])
 
+    def test_gqa_prefill_kernel_branch_matches_einsum(self, monkeypatch):
+        """The decoder's GQA full-seq kernel branch (normally TPU-only)
+        forced on CPU via an interpret-mode kernel: must reproduce the
+        grouped-einsum path exactly — covers the decoder→dispatcher→GQA
+        flash chain that otherwise only runs on a chip."""
+        import functools
+
+        import deepspeed_tpu.ops.attention as attn
+        import deepspeed_tpu.ops.pallas.flash_attention as fa
+        from deepspeed_tpu.models import decoder
+        from deepspeed_tpu.module_inject import replace_transformer_layer
+
+        hf_model = self._tiny(
+            hidden_size=256, intermediate_size=256, max_position_embeddings=128
+        )
+        _, cfg, params = replace_transformer_layer(hf_model, dtype=jnp.float32)
+        assert cfg.kv_heads < cfg.n_head and cfg.head_dim == 64
+        ids = jnp.asarray(
+            np.random.RandomState(3).randint(0, cfg.vocab_size, (1, 128)), jnp.int32
+        )
+        base = decoder.forward(cfg, params, ids)  # grouped-einsum path on CPU
+        flash_interp = functools.partial(fa.flash_attention, interpret=True)
+        monkeypatch.setattr(attn, "_pallas_ok", lambda q: True)
+        monkeypatch.setattr(attn, "pallas_attention_ok", lambda q: True)
+        monkeypatch.setattr(fa, "flash_attention", flash_interp)
+        forced = decoder.forward(cfg, params, ids)
+        np.testing.assert_allclose(
+            np.asarray(forced), np.asarray(base), atol=2e-4, rtol=2e-4
+        )
+
     def test_gqa_cache_is_kv_headed(self):
         from deepspeed_tpu.models import decoder
         from deepspeed_tpu.module_inject import replace_transformer_layer
