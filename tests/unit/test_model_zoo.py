@@ -174,6 +174,36 @@ class TestBert:
         assert np.abs(np.asarray(pooled) - ref_p).max() < 5e-3
 
 
+    def test_unmasked_kernel_branch_matches_jnp(self, monkeypatch):
+        """BERT's bidirectional flash branch (TPU-only) forced on CPU with
+        the interpret kernel: must match the jnp encoder path exactly."""
+        import functools
+
+        import deepspeed_tpu.ops.attention as attn
+        import deepspeed_tpu.ops.pallas.flash_attention as fa
+        from deepspeed_tpu.models import bert as ds_bert
+        from deepspeed_tpu.module_inject import replace_transformer_layer
+
+        m = _hf("BertModel", "BertConfig", dict(
+            hidden_size=256, num_hidden_layers=2, num_attention_heads=4,
+            vocab_size=512, intermediate_size=256, max_position_embeddings=128,
+            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        ))
+        _, cfg, params = replace_transformer_layer(m, dtype=jnp.float32)
+        ids = jnp.asarray(
+            np.random.RandomState(4).randint(0, 512, (2, 128)), jnp.int32
+        )
+        base, _ = ds_bert.forward(cfg, params, ids, None, None)
+        monkeypatch.setattr(attn, "_pallas_ok", lambda q: True)
+        monkeypatch.setattr(
+            fa, "flash_attention", functools.partial(fa.flash_attention, interpret=True)
+        )
+        forced, _ = ds_bert.forward(cfg, params, ids, None, None)
+        np.testing.assert_allclose(
+            np.asarray(forced), np.asarray(base), atol=2e-4, rtol=2e-4
+        )
+
+
 class TestBertPretraining:
     """BERT MLM+NSP pretraining through the engine (the reference's headline
     workload; docs/_pages/training.md:42)."""
