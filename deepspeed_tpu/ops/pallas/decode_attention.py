@@ -36,8 +36,8 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, sm_scale: float,
 
     def body(j, carry):
         m_prev, l_prev, acc = carry
-        k = k_ref[0, pl.dslice(j * s_block, s_block), 0, :].astype(jnp.float32)
-        v = v_ref[0, pl.dslice(j * s_block, s_block), 0, :].astype(jnp.float32)
+        k = k_ref[0, 0, pl.dslice(j * s_block, s_block), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.dslice(j * s_block, s_block), :].astype(jnp.float32)
         s = jnp.dot(k, q.T, preferred_element_type=jnp.float32) * sm_scale  # [S,1]
         idx = jax.lax.broadcasted_iota(jnp.int32, (s_block, 1), 0) + j * s_block
         s = jnp.where(idx <= pos, s, -1e30)
@@ -82,22 +82,29 @@ def decode_attention(
     kernel = functools.partial(
         _decode_kernel, sm_scale=float(scale), s_max=S, s_block=s_block
     )
+    # Mosaic requires every block's trailing two dims to be (8,128)-divisible
+    # or equal to the array's; [B,Smax,KV,D] caches with a (1,S,1,D) block
+    # violate that whenever KV>1, so the kernel consumes a [B,KV,S,D] view
+    # (trailing (S,D) block == array dims) and q/o gain a singleton row.
+    k_t = jnp.swapaxes(k_cache, 1, 2)
+    v_t = jnp.swapaxes(v_cache, 1, 2)
+    q4 = q.reshape(B, H, 1, D)
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(B, H),
             in_specs=[
-                pl.BlockSpec((1, 1, D), lambda b, h, pos: (b, h, 0)),
-                pl.BlockSpec((1, S, 1, D), lambda b, h, pos: (b, 0, h // rep, 0)),
-                pl.BlockSpec((1, S, 1, D), lambda b, h, pos: (b, 0, h // rep, 0)),
+                pl.BlockSpec((1, 1, 1, D), lambda b, h, pos: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, S, D), lambda b, h, pos: (b, h // rep, 0, 0)),
+                pl.BlockSpec((1, 1, S, D), lambda b, h, pos: (b, h // rep, 0, 0)),
             ],
-            out_specs=pl.BlockSpec((1, 1, D), lambda b, h, pos: (b, h, 0)),
+            out_specs=pl.BlockSpec((1, 1, 1, D), lambda b, h, pos: (b, h, 0, 0)),
         ),
-        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, H, 1, D), q.dtype),
         interpret=interpret,
-    )(jnp.asarray(pos, jnp.int32).reshape(1), q, k_cache, v_cache)
-    return out
+    )(jnp.asarray(pos, jnp.int32).reshape(1), q4, k_t, v_t)
+    return out.reshape(B, H, D)
 
 
 def decode_attention_ok(S: int, D: int, itemsize: int = 2) -> bool:
