@@ -82,7 +82,7 @@ def pick_model(hbm_bytes: float, seq: int, n_dev: int = 1, zero_stage: int = 3):
 
 
 def fit_micros(name: str, seq: int, hbm_bytes: float, n_dev: int = 1,
-               zero_stage: int = 3, candidates=(32, 16, 8)):
+               zero_stage: int = 3, candidates=(64, 32, 16, 8)):
     """Micro batches predicted to fit ``name`` at ``seq`` (largest first).
 
     Activation bytes per micro-batch element with remat + chunked CE:
@@ -322,6 +322,10 @@ def main():
     tried = []
     cfg = engine = None
     micro = None
+    # BENCH_REMAT=0/1 pins rematerialization across every ladder rung (perf
+    # experiments: remat-off trades HBM for ~25% fewer executed flops)
+    remat_env = os.environ.get("BENCH_REMAT")
+    remat_pin = None if remat_env is None else bool(int(remat_env))
     names = [model_name] + [c for c in CANDIDATES if CANDIDATES.index(c) > (CANDIDATES.index(model_name) if model_name in CANDIDATES else -1)]
     auto_micro = micro_env == "auto"
     ladder = []
@@ -340,6 +344,8 @@ def main():
             if rung not in ladder:
                 ladder.append(rung)
     for name, remat, mb in ladder:
+        if remat_pin is not None:
+            remat = remat_pin
         try:
             # fresh watchdog window per rung: each OOM fallback pays its own
             # (slow, remote) compile; a hang inside any rung still trips it

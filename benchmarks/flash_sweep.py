@@ -1,0 +1,127 @@
+"""On-chip attention-impl sweep: Pallas flash (resident + grid) vs XLA jnp.
+
+Times the attention core alone at the headline bench shapes (and a long-seq
+shape) so the model dispatchers' "auto" policy is grounded in a measured
+number instead of an assumption. Run on a real TPU:
+
+    python benchmarks/flash_sweep.py            # default shapes
+    BENCH_SHAPES=32x1024x16x64 python benchmarks/flash_sweep.py
+
+Prints one JSON line per (shape, impl) with ms/iter and achieved TFLOP/s,
+then a WINNERS summary line. RESULTS from the last hardware run are recorded
+at the bottom of this file.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import time
+
+import numpy as np
+
+
+def attention_flops(B, S, H, D, causal=True):
+    # QK^T + PV: 2 * 2 * B*H*S*S*D, halved for causal
+    f = 4.0 * B * H * S * S * D
+    return f / 2 if causal else f
+
+
+def time_fn(fn, *args, iters=20):
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.ops.attention import causal_attention_jnp
+    from deepspeed_tpu.ops.pallas.flash_attention import _flash, _flash_grid, flash_attention
+
+    shapes_env = os.environ.get("BENCH_SHAPES")
+    if shapes_env:
+        shapes = [tuple(map(int, s.split("x"))) for s in shapes_env.split(",")]
+    else:
+        # (B, S, H, D): headline bench shape (gpt2-medium micro 32), a
+        # larger-head variant, and a long-seq grid-kernel shape
+        shapes = [(32, 1024, 16, 64), (8, 1024, 16, 128), (1, 8192, 8, 128)]
+
+    fwd_only = os.environ.get("BENCH_FWD_ONLY") == "1"
+    results = []
+    for (B, S, H, D) in shapes:
+        rs = np.random.RandomState(0)
+        q, k, v = (jnp.asarray(rs.randn(B, S, H, D), jnp.bfloat16) for _ in range(3))
+        scale = 1.0 / np.sqrt(D)
+
+        def to3(x):
+            return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+
+        q3, k3, v3 = to3(q), to3(k), to3(v)
+        flops = attention_flops(B, S, H, D)
+
+        impls = {
+            "pallas-auto": jax.jit(lambda q, k, v: flash_attention(q, k, v)),
+            "pallas-resident": jax.jit(
+                lambda q, k, v: _flash(q, k, v, float(scale), True, False, 1)
+            ),
+            "pallas-grid": jax.jit(
+                lambda q, k, v: _flash_grid(q, k, v, float(scale), True, False)
+            ),
+            "xla-jnp": jax.jit(causal_attention_jnp),
+        }
+        args = {
+            "pallas-auto": (q, k, v),
+            "pallas-resident": (q3, k3, v3),
+            "pallas-grid": (q3, k3, v3),
+            "xla-jnp": (q, k, v),
+        }
+        grads = {
+            name: jax.jit(
+                jax.grad(
+                    (lambda f: lambda *a: jnp.sum(f(*a).astype(jnp.float32) ** 2))(f),
+                    argnums=(0, 1, 2),
+                )
+            )
+            for name, f in impls.items()
+        }
+
+        for name in impls:
+            row = {"shape": f"{B}x{S}x{H}x{D}", "impl": name}
+            try:
+                dt = time_fn(impls[name], *args[name])
+                row["fwd_ms"] = round(dt * 1e3, 3)
+                row["fwd_tflops"] = round(flops / dt / 1e12, 1)
+                if not fwd_only:
+                    dtg = time_fn(grads[name], *args[name], iters=10)
+                    row["fwdbwd_ms"] = round(dtg * 1e3, 3)
+                    # bwd ≈ 2.5x fwd attention flops
+                    row["fwdbwd_tflops"] = round(3.5 * flops / dtg / 1e12, 1)
+            except Exception as e:
+                row["error"] = f"{type(e).__name__}: {str(e)[:120]}"
+            results.append(row)
+            print(json.dumps(row), flush=True)
+
+    winners = {}
+    for r in results:
+        key = r["shape"]
+        metric = r.get("fwdbwd_ms") or r.get("fwd_ms")
+        if metric is not None and (key not in winners or metric < winners[key][1]):
+            winners[key] = (r["impl"], metric)
+    print(json.dumps({"WINNERS": {k: v[0] for k, v in winners.items()}}))
+
+
+if __name__ == "__main__":
+    main()
+
+# RESULTS (hardware): not yet captured this round — the sweep is queued on
+# tunnel recovery (.tpu_watch_r4.sh). Until a number lands here, the model
+# dispatchers' pallas-first "auto" policy rests on the r2 chip CI only.
