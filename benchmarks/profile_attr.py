@@ -1,0 +1,97 @@
+"""Wall-clock attribution from a captured xplane trace.
+
+Usage:
+    BENCH_PROFILE=/tmp/prof python bench.py       # capture 3 steady steps
+    python benchmarks/profile_attr.py /tmp/prof   # attribute the time
+
+Walks the TPU plane's XEvents, buckets op self-time by category (matmul /
+pallas kernel / elementwise-fusion / copy-reshape / embedding-gather / infeed
+/ other), and prints a JSON summary plus the top-15 individual ops — the
+"where does the remaining step time go" paragraph, as data.
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import json
+import os
+import sys
+
+
+def categorize(name: str) -> str:
+    n = name.lower()
+    if "custom-call" in n or "pallas" in n or "mosaic" in n or "flash" in n:
+        return "pallas-kernel"
+    if "fusion" in n and ("dot" in n or "conv" in n):
+        return "matmul-fusion"
+    if n.startswith("dot") or "dot_general" in n or "einsum" in n:
+        return "matmul"
+    if "copy" in n or "reshape" in n or "transpose" in n or "bitcast" in n:
+        return "copy/layout"
+    if "gather" in n or "scatter" in n or "dynamic-update" in n or "dynamic_update" in n:
+        return "gather/scatter"
+    if "all-reduce" in n or "all-gather" in n or "reduce-scatter" in n or "collective" in n:
+        return "collective"
+    if "infeed" in n or "outfeed" in n or "host" in n:
+        return "host-transfer"
+    if "fusion" in n:
+        return "fusion-elementwise"
+    return "other"
+
+
+def main(path: str):
+    from jax.profiler import ProfileData
+
+    files = sorted(
+        glob.glob(os.path.join(path, "**", "*.xplane.pb"), recursive=True),
+        key=os.path.getmtime,
+    )
+    if not files:
+        print(json.dumps({"error": f"no xplane.pb under {path}"}))
+        return
+    pd = ProfileData.from_file(files[-1])
+    tpu_planes = [
+        p for p in pd.planes if "TPU" in p.name or "tpu" in p.name.lower()
+    ]
+    if not tpu_planes:
+        # fall back: any device plane that is not host CPU threads
+        tpu_planes = [p for p in pd.planes if "Host" not in p.name]
+    by_cat = collections.Counter()
+    by_op = collections.Counter()
+    total_ps = 0
+    for plane in tpu_planes:
+        for line in plane.lines:
+            lname = (line.name or "").lower()
+            # XLA op lines carry per-op events; step/module lines would
+            # double-count the same wall time
+            if "step" in lname or "module" in lname:
+                continue
+            for ev in line.events:
+                dur = ev.duration_ns
+                name = ev.name
+                if name.startswith("$"):  # host python frames (CPU fallback)
+                    continue
+                by_op[name] += dur
+                by_cat[categorize(name)] += dur
+                total_ps += dur
+    if total_ps == 0:
+        print(json.dumps({"error": "no events parsed", "planes": [p.name for p in pd.planes]}))
+        return
+    summary = {
+        "xplane": os.path.basename(files[-1]),
+        "total_device_ms": round(total_ps / 1e6, 3),
+        "by_category_pct": {
+            k: round(100.0 * v / total_ps, 1)
+            for k, v in by_cat.most_common()
+        },
+        "top_ops": [
+            {"op": k[:80], "ms": round(v / 1e6, 3), "pct": round(100.0 * v / total_ps, 1)}
+            for k, v in by_op.most_common(15)
+        ],
+    }
+    print(json.dumps(summary, indent=1))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else os.environ.get("BENCH_PROFILE", "/tmp/ds_tpu_prof"))
