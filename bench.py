@@ -489,9 +489,13 @@ def main():
         "step_ms": round(step_ms, 2),
         "step_ms_pipelined": round(dt_pipelined / steps * 1e3, 2),
         "step_ms_device": round(dt_device / steps * 1e3, 2) if dt_device else None,
+        # device-only > blocked means the tunnel hiccuped during the device
+        # timing window — the subtraction is then noise, not host overhead
         "host_overhead_ms": (
-            round((dt_blocked - dt_device) / steps * 1e3, 2) if dt_device else None
+            round((dt_blocked - dt_device) / steps * 1e3, 2)
+            if dt_device and dt_device <= dt_blocked else None
         ),
+        "device_timing_suspect": bool(dt_device and dt_device > 1.2 * dt_blocked) or None,
         "mfu": round(mfu, 4),
         "mfu_device": round(mfu_device, 4) if mfu_device else None,
         "flops_per_step": flops_per_step,
