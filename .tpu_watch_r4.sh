@@ -42,7 +42,8 @@ while true; do
     run_step tpu_suite 3600 env DS_TPU_TESTS=1 python -m pytest tests/ -m tpu -q --tb=short || continue
     # perf experiments: bigger micro, remat off, profile capture
     run_step bench_micro64 1800 env BENCH_MICRO=64 python bench.py || continue
-    run_step bench_noremat16 1800 env BENCH_MICRO=16 BENCH_REMAT=0 python bench.py || continue
+    run_step bench_noremat8 1800 env BENCH_MICRO=8 BENCH_REMAT=0 python bench.py || continue
+    run_step bench_dots16 1800 env BENCH_MICRO=16 BENCH_REMAT=1 BENCH_REMAT_POLICY=dots python bench.py || continue
     run_step bench_profile 1800 env BENCH_PROFILE=.prof_r4 python bench.py || continue
     run_step profile_attr 300 python benchmarks/profile_attr.py .prof_r4 || continue
     log "queue complete"

@@ -118,7 +118,10 @@ def build_engine(model_name: str, seq: int, micro: int, n_dev: int, zero_stage: 
     # chunked CE: the [B,S,V] logits are the peak activation at GPT-2 vocab;
     # computing the loss in 256-position chunks (grads exact, logits
     # rematerialized) frees ~GBs of HBM for batch/model size
-    cfg = gpt2.get_config(model_name, n_positions=seq, remat=remat, ce_chunk=256)
+    cfg = gpt2.get_config(
+        model_name, n_positions=seq, remat=remat, ce_chunk=256,
+        remat_policy=os.environ.get("BENCH_REMAT_POLICY", "full"),
+    )
     module = gpt2.make_module(cfg)
     mesh = MeshSpec(dp=n_dev).build_mesh()
     ds = DeepSpeedConfig.load(

@@ -54,6 +54,12 @@ class GPT2Config:
     # and/or offload it to pinned host RAM between forward and backward
     partition_activations: bool = False
     cpu_checkpointing: bool = False
+    # remat granularity: "full" recomputes the whole block in backward
+    # (cheapest memory, +~1/3 executed flops); "dots" saves every matmul
+    # output and recomputes only the cheap elementwise ops (memory between
+    # no-remat and full remat, near-no-remat flops) — jax.checkpoint's
+    # dots_saveable policy
+    remat_policy: str = "full"
     attn_impl: str = "auto"  # auto | pallas | jnp | ring | ring_flash | ulysses | sparse
     # >0: compute the LM cross-entropy in sequence chunks of this many
     # positions, never materializing the full [B,S,V] logits (at GPT-2
@@ -328,11 +334,16 @@ def _partition_boundary(cfg: GPT2Config, h):
 
 def _remat_policy(cfg: GPT2Config):
     """jax.checkpoint policy for the block body: offload-capable when
-    cpu_checkpointing, else full remat (save nothing, recompute)."""
+    cpu_checkpointing; "dots" saves matmul outputs (recompute only the cheap
+    elementwise tail); default full remat (save nothing, recompute)."""
     if cfg.cpu_checkpointing:
         from ..runtime.activation_checkpointing.checkpointing import _offload_policy
 
         return _offload_policy()
+    if cfg.remat_policy == "dots":
+        return jax.checkpoint_policies.dots_saveable
+    if cfg.remat_policy != "full":
+        raise ValueError(f"unknown remat_policy {cfg.remat_policy!r} (full|dots)")
     return None
 
 
