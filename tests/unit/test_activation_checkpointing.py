@@ -83,3 +83,23 @@ def test_configure_surface():
     assert ck.is_configured() and pol.partition_activations
     ck.reset()
     assert not ck.is_configured()
+
+
+def test_dots_remat_policy_parity(batch):
+    """remat_policy="dots" (save matmul outputs, recompute elementwise) must
+    be gradient-identical to full remat — it only changes what is cached."""
+    base = gpt2.get_config("gpt2-tiny", remat=True, dtype=jnp.float32)
+    dots = gpt2.get_config(
+        "gpt2-tiny", remat=True, dtype=jnp.float32, remat_policy="dots"
+    )
+    params = jax.jit(lambda r: gpt2.init_params(base, r))(jax.random.PRNGKey(0))
+    _tree_allclose(_grads(base, params, batch), _grads(dots, params, batch))
+
+
+def test_unknown_remat_policy_rejected(batch):
+    cfg = gpt2.get_config(
+        "gpt2-tiny", remat=True, dtype=jnp.float32, remat_policy="typo"
+    )
+    params = jax.jit(lambda r: gpt2.init_params(cfg, r))(jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="remat_policy"):
+        _grads(cfg, params, batch)
