@@ -178,8 +178,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, s
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * sm_scale
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0, :, 0:1]  # [BQ, 1] (value broadcast across lanes)
-    delta = delta_ref[0, :, 0:1]
+    # load full lanes, slice the VALUE: a width-1 lane slice in the ref
+    # indexer is a Mosaic hazard; the value slice is free (lanes broadcast)
+    lse = lse_ref[0][:, 0:1]  # [BQ, 1]
+    delta = delta_ref[0][:, 0:1]
 
     num_k_blocks = pl.cdiv(seq_len, BK)
     hi = _causal_hi(qi, num_k_blocks) if causal else num_k_blocks
@@ -205,8 +207,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         dk, dv = carry
         q = q_ref[0, pl.ds(i * BQ, BQ), :].astype(jnp.float32) * sm_scale
         do = do_ref[0, pl.ds(i * BQ, BQ), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(i * BQ, BQ), 0:1]  # [BQ, 1]
-        delta = delta_ref[0, pl.ds(i * BQ, BQ), 0:1]
+        # dynamic sublane slice at full lanes, then slice the value (the
+        # combined dynamic-sublane + width-1-lane ref slice is a Mosaic hazard)
+        lse = lse_ref[0, pl.ds(i * BQ, BQ), :][:, 0:1]  # [BQ, 1]
+        delta = delta_ref[0, pl.ds(i * BQ, BQ), :][:, 0:1]
         dkc, dvc = _dkv_block(q, k, v, do, lse, delta, i, ki, causal)
         return dk + dkc, dv + dvc
 
