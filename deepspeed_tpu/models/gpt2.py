@@ -28,6 +28,7 @@ from typing import Any, Dict, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.ad_checkpoint import checkpoint_name
 from jax import lax
 from jax.sharding import PartitionSpec
 
@@ -56,9 +57,12 @@ class GPT2Config:
     cpu_checkpointing: bool = False
     # remat granularity: "full" recomputes the whole block in backward
     # (cheapest memory, +~1/3 executed flops); "dots" saves every matmul
-    # output and recomputes only the cheap elementwise ops (memory between
-    # no-remat and full remat, near-no-remat flops) — jax.checkpoint's
-    # dots_saveable policy
+    # output PLUS the attention-kernel output and recomputes only the cheap
+    # elementwise ops (memory between no-remat and full remat,
+    # near-no-remat flops); "attn" saves ONLY the attention output — one
+    # extra [B,S,E] per layer beyond full remat, but the backward never
+    # re-runs the (flash-kernel) attention forward, the most expensive
+    # recompute in the block
     remat_policy: str = "full"
     attn_impl: str = "auto"  # auto | pallas | jnp | ring | ring_flash | ulysses | sparse
     # >0: compute the LM cross-entropy in sequence chunks of this many
@@ -258,7 +262,10 @@ def _attention(cfg: GPT2Config, lp, h, train: bool, rng=None):
         from ..ops.attention import causal_attention
 
         o = causal_attention(q, k_, v, impl=cfg.attn_impl)  # [B,S,H,D]
-    o = o.reshape(B, S, E)
+    # name the kernel output so remat policies can save it: a Pallas
+    # custom_vjp output is not a dot_general, so even dots_saveable would
+    # otherwise re-run the whole flash forward to rebuild c_proj's input
+    o = checkpoint_name(o.reshape(B, S, E), "attn_out")
     out = o @ _deq(lp["c_proj_w"], o.dtype) + lp["c_proj_b"]
     return out
 
@@ -334,16 +341,25 @@ def _partition_boundary(cfg: GPT2Config, h):
 
 def _remat_policy(cfg: GPT2Config):
     """jax.checkpoint policy for the block body: offload-capable when
-    cpu_checkpointing; "dots" saves matmul outputs (recompute only the cheap
-    elementwise tail); default full remat (save nothing, recompute)."""
+    cpu_checkpointing; "dots" saves matmul + attention-kernel outputs
+    (recompute only the cheap elementwise tail); "attn" saves only the
+    attention output (backward never re-runs the flash forward); default
+    full remat (save nothing, recompute)."""
     if cfg.cpu_checkpointing:
         from ..runtime.activation_checkpointing.checkpointing import _offload_policy
 
         return _offload_policy()
     if cfg.remat_policy == "dots":
-        return jax.checkpoint_policies.dots_saveable
+        return jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.dots_saveable,
+            jax.checkpoint_policies.save_only_these_names("attn_out"),
+        )
+    if cfg.remat_policy == "attn":
+        return jax.checkpoint_policies.save_only_these_names("attn_out")
     if cfg.remat_policy != "full":
-        raise ValueError(f"unknown remat_policy {cfg.remat_policy!r} (full|dots)")
+        raise ValueError(
+            f"unknown remat_policy {cfg.remat_policy!r} (full|dots|attn)"
+        )
     return None
 
 

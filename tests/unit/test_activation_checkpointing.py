@@ -96,6 +96,18 @@ def test_dots_remat_policy_parity(batch):
     _tree_allclose(_grads(base, params, batch), _grads(dots, params, batch))
 
 
+def test_attn_remat_policy_parity(batch):
+    """remat_policy="attn" saves only the named attention-kernel output —
+    the backward rebuilds everything else but never re-runs the attention
+    forward. Gradients must match full remat exactly."""
+    base = gpt2.get_config("gpt2-tiny", remat=True, dtype=jnp.float32)
+    attn = gpt2.get_config(
+        "gpt2-tiny", remat=True, dtype=jnp.float32, remat_policy="attn"
+    )
+    params = jax.jit(lambda r: gpt2.init_params(base, r))(jax.random.PRNGKey(0))
+    _tree_allclose(_grads(base, params, batch), _grads(attn, params, batch))
+
+
 def test_unknown_remat_policy_rejected(batch):
     cfg = gpt2.get_config(
         "gpt2-tiny", remat=True, dtype=jnp.float32, remat_policy="typo"
