@@ -64,15 +64,18 @@ def _fwd_kernel(kidx_ref, kcnt_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
                 sm_scale, causal, blk):
     h = pl.program_id(1)
     qi = pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # [blk, D]
+    # dots take storage-dtype operands with f32 accumulation (bf16 inputs
+    # ride the MXU's native path; products stay exact in the accumulator);
+    # sm_scale applies to the f32 scores, exact for any scale
+    q = q_ref[0, 0]  # [blk, D]
     cnt = kcnt_ref[h, qi]
 
     def body(j, carry):
         acc, m_prev, l_prev = carry
         kj = kidx_ref[h, qi, j]
-        k = k_ref[0, 0, pl.ds(kj * blk, blk), :].astype(jnp.float32)
-        v = v_ref[0, 0, pl.ds(kj * blk, blk), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        k = k_ref[0, 0, pl.ds(kj * blk, blk), :]
+        v = v_ref[0, 0, pl.ds(kj * blk, blk), :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * sm_scale
         s = _block_mask(s, qi * blk, kj * blk, causal)
         m_cur = jnp.max(s, axis=1)
         m_new = jnp.maximum(m_prev, m_cur)
@@ -80,7 +83,7 @@ def _fwd_kernel(kidx_ref, kcnt_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=1)
         acc = acc * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         return acc, m_new, l_new
 
     acc0 = jnp.zeros((blk, q_ref.shape[-1]), jnp.float32)
@@ -102,22 +105,22 @@ def _bwd_dq_kernel(kidx_ref, kcnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                    delta_ref, dq_ref, *, sm_scale, causal, blk):
     h = pl.program_id(1)
     qi = pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32) * sm_scale
-    do = do_ref[0, 0].astype(jnp.float32)
+    q = q_ref[0, 0]
+    do = do_ref[0, 0]
     lse = lse_ref[0, 0, :, 0:1]  # [blk, 1] (value broadcast across lanes)
     delta = delta_ref[0, 0, :, 0:1]
     cnt = kcnt_ref[h, qi]
 
     def body(j, dq):
         kj = kidx_ref[h, qi, j]
-        k = k_ref[0, 0, pl.ds(kj * blk, blk), :].astype(jnp.float32)
-        v = v_ref[0, 0, pl.ds(kj * blk, blk), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        k = k_ref[0, 0, pl.ds(kj * blk, blk), :]
+        v = v_ref[0, 0, pl.ds(kj * blk, blk), :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * sm_scale
         s = _block_mask(s, qi * blk, kj * blk, causal)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
-        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        return dq + jax.lax.dot_general(ds.astype(k.dtype), k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
     dq = jax.lax.fori_loop(0, cnt, body, jnp.zeros((blk, q_ref.shape[-1]), jnp.float32))
     dq_ref[0, 0] = (dq * sm_scale).astype(dq_ref.dtype)
@@ -127,31 +130,31 @@ def _bwd_dkv_kernel(qidx_ref, qcnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                     delta_ref, dk_ref, dv_ref, *, sm_scale, causal, blk):
     h = pl.program_id(1)
     ki = pl.program_id(2)
-    k = k_ref[0, 0].astype(jnp.float32)
-    v = v_ref[0, 0].astype(jnp.float32)
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
     cnt = qcnt_ref[h, ki]
 
     def body(i, carry):
         dk, dv = carry
         qi = qidx_ref[h, ki, i]
-        q = q_ref[0, 0, pl.ds(qi * blk, blk), :].astype(jnp.float32) * sm_scale
-        do = do_ref[0, 0, pl.ds(qi * blk, blk), :].astype(jnp.float32)
+        q = q_ref[0, 0, pl.ds(qi * blk, blk), :]
+        do = do_ref[0, 0, pl.ds(qi * blk, blk), :]
         lse = lse_ref[0, 0, pl.ds(qi * blk, blk), 0:1]  # [blk, 1]
         delta = delta_ref[0, 0, pl.ds(qi * blk, blk), 0:1]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * sm_scale
         s = _block_mask(s, qi * blk, ki * blk, causal)
         p = jnp.exp(s - lse)
-        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dv = dv + jax.lax.dot_general(p.astype(do.dtype), do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
-        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dk = dk + jax.lax.dot_general(ds.astype(q.dtype), q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         return dk, dv
 
     D = k_ref.shape[-1]
     dk, dv = jax.lax.fori_loop(
         0, cnt, body, (jnp.zeros((blk, D), jnp.float32), jnp.zeros((blk, D), jnp.float32))
     )
-    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dk_ref[0, 0] = (dk * sm_scale).astype(dk_ref.dtype)
     dv_ref[0, 0] = dv.astype(dv_ref.dtype)
 
 

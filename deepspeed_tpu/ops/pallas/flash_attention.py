@@ -40,14 +40,23 @@ def _causal_mask(s, q_block, k_block):
 
 
 # ---- shared per-block math (one copy for the resident AND grid kernels) ----
+#
+# Dots take q/k/v/do in their STORAGE dtype with an f32 accumulator: bf16
+# inputs then ride the MXU's native bf16 path (4x the f32 matmul rate on
+# v4/v5) and the products are still exact in the f32 accumulator, so QK^T
+# and dp are bit-identical to an upcast-first formulation. sm_scale is
+# applied to the f32 scores AFTER the dot (matches ops.attention's jnp
+# reference; exact for any scale, where pre-scaling a bf16 q would round).
+# The second GEMM of each pass casts its f32 left operand (p / ds) down to
+# the storage dtype — the standard flash-kernel precision contract.
 
-def _online_softmax_step(q, k, v, carry, qi, ki, causal: bool):
-    """One K/V block of the online-softmax forward. q is pre-scaled;
+def _online_softmax_step(q, k, v, carry, qi, ki, causal: bool, sm_scale):
+    """One K/V block of the online-softmax forward.
     carry = (acc [BQ,D], m [BQ,1], l [BQ,1]) in f32."""
     acc, m_prev, l_prev = carry
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )
+    ) * sm_scale
     if causal:
         s = _causal_mask(s, qi, ki)
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -55,16 +64,18 @@ def _online_softmax_step(q, k, v, carry, qi, ki, causal: bool):
     alpha = jnp.exp(m_prev - m_new)
     l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
     acc = acc * alpha + jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
     )
     return acc, m_new, l_new
 
 
-def _dq_block(q, k, v, do, lse, delta, qi, ki, causal: bool):
-    """One K/V block's contribution to dq. q pre-scaled; lse/delta [BQ,1]."""
+def _dq_block(q, k, v, do, lse, delta, qi, ki, causal: bool, sm_scale):
+    """One K/V block's contribution to dq (unscaled: caller multiplies the
+    accumulated dq by sm_scale once). lse/delta [BQ,1] f32."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )
+    ) * sm_scale
     if causal:
         s = _causal_mask(s, qi, ki)
     p = jnp.exp(s - lse)
@@ -73,27 +84,31 @@ def _dq_block(q, k, v, do, lse, delta, qi, ki, causal: bool):
     )
     ds = p * (dp - delta)
     return jax.lax.dot_general(
-        ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
     )
 
 
-def _dkv_block(q, k, v, do, lse, delta, qi, ki, causal: bool):
-    """One Q block's contributions to (dk, dv). q pre-scaled."""
+def _dkv_block(q, k, v, do, lse, delta, qi, ki, causal: bool, sm_scale):
+    """One Q block's contributions to (dk, dv); dk unscaled (caller applies
+    sm_scale once at finalize)."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )
+    ) * sm_scale
     if causal:
         s = _causal_mask(s, qi, ki)
-    p = jnp.exp(s - lse)  # [BQ, BK]
+    p = jnp.exp(s - lse)  # [BQ, BK] f32
     dv = jax.lax.dot_general(
-        p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
     )
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )
     ds = p * (dp - delta)
     dk = jax.lax.dot_general(
-        ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
     )
     return dk, dv
 
@@ -120,15 +135,15 @@ VMEM_RESIDENT_BYTES = 4 * 1024 * 1024
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale: float, causal: bool, seq_len: int):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * sm_scale  # [BQ, D]
+    q = q_ref[0]  # [BQ, D], storage dtype (bf16 dots ride the native MXU path)
 
     num_k_blocks = pl.cdiv(seq_len, BK)
     hi = _causal_hi(qi, num_k_blocks) if causal else num_k_blocks
 
     def body(j, carry):
-        k = k_ref[0, pl.ds(j * BK, BK), :].astype(jnp.float32)  # [BK, D]
-        v = v_ref[0, pl.ds(j * BK, BK), :].astype(jnp.float32)
-        return _online_softmax_step(q, k, v, carry, qi, j, causal)
+        k = k_ref[0, pl.ds(j * BK, BK), :]  # [BK, D]
+        v = v_ref[0, pl.ds(j * BK, BK), :]
+        return _online_softmax_step(q, k, v, carry, qi, j, causal, sm_scale)
 
     acc0 = jnp.zeros((BQ, q_ref.shape[-1]), jnp.float32)
     m0 = jnp.full((BQ, 1), NEG_INF, jnp.float32)
@@ -176,8 +191,8 @@ def _fwd(q3, k3, v3, sm_scale: float, causal: bool, interpret: bool = False, kv_
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, sm_scale, causal, seq_len):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * sm_scale
-    do = do_ref[0].astype(jnp.float32)
+    q = q_ref[0]
+    do = do_ref[0]
     # load full lanes, slice the VALUE: a width-1 lane slice in the ref
     # indexer is a Mosaic hazard; the value slice is free (lanes broadcast)
     lse = lse_ref[0][:, 0:1]  # [BQ, 1]
@@ -187,9 +202,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, s
     hi = _causal_hi(qi, num_k_blocks) if causal else num_k_blocks
 
     def body(j, dq):
-        k = k_ref[0, pl.ds(j * BK, BK), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * BK, BK), :].astype(jnp.float32)
-        return dq + _dq_block(q, k, v, do, lse, delta, qi, j, causal)
+        k = k_ref[0, pl.ds(j * BK, BK), :]
+        v = v_ref[0, pl.ds(j * BK, BK), :]
+        return dq + _dq_block(q, k, v, do, lse, delta, qi, j, causal, sm_scale)
 
     dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((BQ, q_ref.shape[-1]), jnp.float32))
     dq_ref[0] = (dq * sm_scale).astype(dq_ref.dtype)
@@ -197,28 +212,28 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, s
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, sm_scale, causal, seq_len):
     ki = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)  # [BK, D]
-    v = v_ref[0].astype(jnp.float32)
+    k = k_ref[0]  # [BK, D]
+    v = v_ref[0]
 
     num_q_blocks = pl.cdiv(seq_len, BQ)
     lo = _causal_lo(ki) if causal else 0
 
     def body(i, carry):
         dk, dv = carry
-        q = q_ref[0, pl.ds(i * BQ, BQ), :].astype(jnp.float32) * sm_scale
-        do = do_ref[0, pl.ds(i * BQ, BQ), :].astype(jnp.float32)
+        q = q_ref[0, pl.ds(i * BQ, BQ), :]
+        do = do_ref[0, pl.ds(i * BQ, BQ), :]
         # dynamic sublane slice at full lanes, then slice the value (the
         # combined dynamic-sublane + width-1-lane ref slice is a Mosaic hazard)
         lse = lse_ref[0, pl.ds(i * BQ, BQ), :][:, 0:1]  # [BQ, 1]
         delta = delta_ref[0, pl.ds(i * BQ, BQ), :][:, 0:1]
-        dkc, dvc = _dkv_block(q, k, v, do, lse, delta, i, ki, causal)
+        dkc, dvc = _dkv_block(q, k, v, do, lse, delta, i, ki, causal, sm_scale)
         return dk + dkc, dv + dvc
 
     D = k_ref.shape[-1]
     dk0 = jnp.zeros((BK, D), jnp.float32)
     dv0 = jnp.zeros((BK, D), jnp.float32)
     dk, dv = jax.lax.fori_loop(lo, num_q_blocks, body, (dk0, dv0))
-    dk_ref[0] = dk.astype(dk_ref.dtype)  # sm_scale already folded into q
+    dk_ref[0] = (dk * sm_scale).astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
@@ -338,11 +353,11 @@ def _fwd_grid_kernel(
         l_ref[...] = jnp.zeros_like(l_ref)
 
     def compute():
-        q = q_ref[0].astype(jnp.float32) * sm_scale
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
         carry = (acc_ref[...], m_ref[:, 0:1], l_ref[:, 0:1])
-        acc, m_new, l_new = _online_softmax_step(q, k, v, carry, qi, ki, causal)
+        acc, m_new, l_new = _online_softmax_step(q, k, v, carry, qi, ki, causal, sm_scale)
         acc_ref[...] = acc
         m_ref[...] = jax.lax.broadcast_in_dim(m_new[:, 0], m_ref.shape, (0,))
         l_ref[...] = jax.lax.broadcast_in_dim(l_new[:, 0], l_ref.shape, (0,))
@@ -410,13 +425,13 @@ def _bwd_dq_grid_kernel(
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
     def compute():
-        q = q_ref[0].astype(jnp.float32) * sm_scale
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0, :, 0:1]
         delta = delta_ref[0, :, 0:1]
-        dq_acc[...] = dq_acc[...] + _dq_block(q, k, v, do, lse, delta, qi, ki, causal)
+        dq_acc[...] = dq_acc[...] + _dq_block(q, k, v, do, lse, delta, qi, ki, causal, sm_scale)
 
     if causal:
         @pl.when(_causal_block_live(qi, ki))
@@ -443,13 +458,13 @@ def _bwd_dkv_grid_kernel(
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
     def compute():
-        q = q_ref[0].astype(jnp.float32) * sm_scale
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0, :, 0:1]
         delta = delta_ref[0, :, 0:1]
-        dkc, dvc = _dkv_block(q, k, v, do, lse, delta, qi, ki, causal)
+        dkc, dvc = _dkv_block(q, k, v, do, lse, delta, qi, ki, causal, sm_scale)
         dk_acc[...] = dk_acc[...] + dkc
         dv_acc[...] = dv_acc[...] + dvc
 
@@ -462,7 +477,7 @@ def _bwd_dkv_grid_kernel(
 
     @pl.when(qi == num_q_blocks - 1)
     def _finalize():
-        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)  # sm_scale folded into q
+        dk_ref[0] = (dk_acc[...] * sm_scale).astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
