@@ -48,6 +48,7 @@ while true; do
     run_step bench_noremat8 1800 env BENCH_MICRO=8 BENCH_REMAT=0 python bench.py || continue
     run_step bench_attn32 1800 env BENCH_MICRO=32 BENCH_REMAT=1 BENCH_REMAT_POLICY=attn python bench.py || continue
     run_step bench_dots8 1800 env BENCH_MICRO=8 BENCH_REMAT=1 BENCH_REMAT_POLICY=dots python bench.py || continue
+    run_step bench_ce0_8 1800 env BENCH_MICRO=8 BENCH_REMAT=0 BENCH_CE_CHUNK=0 python bench.py || continue
     run_step bench_profile 1800 env BENCH_PROFILE=.prof_r4 python bench.py || continue
     run_step profile_attr 300 python benchmarks/profile_attr.py .prof_r4 || continue
     run_step flash_sweep 1800 python benchmarks/flash_sweep.py || continue
@@ -58,7 +59,10 @@ while true; do
       "tests/unit/ops/test_tpu_hardware.py::TestDecodeAttentionHardware" \
       "tests/unit/ops/test_tpu_hardware.py::TestGQAFlashHardware" -q --tb=long || continue
     run_step fused_adam_bench 1200 python benchmarks/fused_adam_bench.py || continue
-    run_step offload_bench 1800 python benchmarks/offload_bench.py || continue
+    run_step inf_decode 1800 python benchmarks/inference_bench.py decode || continue
+    run_step inf_bert 1800 python benchmarks/inference_bench.py bert || continue
+    run_step offload_bench 1800 python benchmarks/offload_bench.py offload || continue
+    run_step infinity_bench 2400 python benchmarks/offload_bench.py infinity || continue
     run_step tpu_suite 3600 env DS_TPU_TESTS=1 python -m pytest tests/ -m tpu -q --tb=short || continue
     run_step bench_micro64 1800 env BENCH_MICRO=64 python bench.py || continue
     log "queue complete"

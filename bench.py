@@ -120,7 +120,10 @@ def build_engine(model_name: str, seq: int, micro: int, n_dev: int, zero_stage: 
     # computing the loss in 256-position chunks (grads exact, logits
     # rematerialized) frees ~GBs of HBM for batch/model size
     cfg = gpt2.get_config(
-        model_name, n_positions=seq, remat=remat, ce_chunk=256,
+        model_name, n_positions=seq, remat=remat,
+        # 0 = classic full-logits CE (no backward logits recompute; only
+        # fits small micro batches), default 256-position chunks
+        ce_chunk=int(os.environ.get("BENCH_CE_CHUNK", "256")),
         remat_policy=remat_policy or os.environ.get("BENCH_REMAT_POLICY", "full"),
     )
     module = gpt2.make_module(cfg)
@@ -345,18 +348,32 @@ def main():
             with open(tuned_path) as f:
                 t = json.load(f)
             # validate inside the guard: a malformed file falls back to the
-            # auto ladder instead of aborting the benchmark
-            tuned = (str(t["model"]), bool(t.get("remat", True)),
-                     int(t["micro_batch"]), str(t.get("remat_policy", "full")))
+            # auto ladder instead of aborting the benchmark. The tuned config
+            # only applies at the seq it was measured at.
+            if int(t.get("seq", seq)) == seq:
+                tuned = (str(t["model"]), bool(t.get("remat", True)),
+                         int(t["micro_batch"]), str(t.get("remat_policy", "full")))
         except Exception:
             tuned = None
     if tuned:
         ladder.append(tuned)
+    def _eff(r):
+        # effective (model, remat, micro, policy) of a rung: None remat means
+        # the preset default; a missing policy means "full"
+        remat = r[1] if r[1] is not None else r[0] in ("gpt2-large", "gpt2-xl")
+        return (r[0], bool(remat), r[2], r[3] if len(r) > 3 else "full")
+
+    def _push(rung):
+        # a failed tuned rung must not make the auto ladder recompile the
+        # exact same effective config
+        if not any(_eff(r) == _eff(rung) for r in ladder):
+            ladder.append(rung)
+
     for c in names:
         if auto_micro:
             micro_ladder = fit_micros(c, seq, hbm, n_dev, zero_stage)
             for mb in micro_ladder:
-                ladder.append((c, True if mb > 8 else None, mb))
+                _push((c, True if mb > 8 else None, mb))
         else:
             micro_ladder = [int(micro_env)]
             # pinned micro: the original two-rung behavior (default remat
@@ -364,8 +381,10 @@ def main():
             ladder.append((c, None, micro_ladder[0]))
         if c not in ("gpt2-large", "gpt2-xl"):  # default remat already True there
             rung = (c, True, micro_ladder[-1])
-            if rung not in ladder:
+            if not auto_micro and rung not in ladder:
                 ladder.append(rung)
+            elif auto_micro:
+                _push(rung)
     for rung in ladder:
         name, remat, mb = rung[:3]
         policy = rung[3] if len(rung) > 3 else None

@@ -1,0 +1,123 @@
+"""Inference benchmark: GPT-2 prefill+decode and BERT-large encoder on TPU.
+
+BASELINE.md's inference row ("BERT-large inference, kernel injection →
+Pallas: parity outputs, fused decode path") has the parity tests in
+tests/unit/test_inference.py / test_model_zoo.py; this script adds the
+measured numbers. One JSON line per mode:
+
+    python benchmarks/inference_bench.py decode   # gpt2-medium KV-cache decode
+    python benchmarks/inference_bench.py bert     # bert-large encoder fwd
+
+- "decode": batch 8, prompt 128, 128 greedy tokens through the compiled
+  prefill + lax.scan single-token decode path (Pallas decode-attention
+  kernel on TPU). Reports prefill ms and sustained decode tokens/sec.
+- "bert": batch 8, seq 384 (S % 128 == 0 so the unmasked encoder rides the
+  Pallas bidirectional flash dispatcher), forward() sequences/sec and
+  ms/sequence.
+
+Weights are random-init (throughput does not depend on values); shapes are
+the published model shapes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepspeed_tpu.utils.jax_env import honor_jax_platforms
+
+honor_jax_platforms()
+
+import numpy as np
+
+
+def _decode_bench():
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt2
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    name = os.environ.get("BENCH_INF_MODEL", "gpt2-medium" if on_tpu else "gpt2-tiny")
+    B = int(os.environ.get("BENCH_INF_BATCH", "8"))
+    prompt = int(os.environ.get("BENCH_INF_PROMPT", "128"))
+    new = int(os.environ.get("BENCH_INF_NEW", "128" if on_tpu else "8"))
+
+    cfg = gpt2.get_config(name, n_positions=max(1024, prompt + new))
+    eng = deepspeed_tpu.init_inference(model=gpt2.make_module(cfg))
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, cfg.vocab_size, size=(B, prompt)).astype(np.int32)
+
+    out = eng.generate(ids, max_new_tokens=new)  # compile + warm
+    assert out.shape == (B, prompt + new)
+    t0 = time.perf_counter()
+    iters = 3
+    for _ in range(iters):
+        out = eng.generate(ids, max_new_tokens=new)
+    dt = (time.perf_counter() - t0) / iters
+
+    # prefill-only timing: 1 new token isolates prompt processing
+    eng.generate(ids, max_new_tokens=1)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        eng.generate(ids, max_new_tokens=1)
+    dt_prefill = (time.perf_counter() - t0) / iters
+
+    decode_tok_s = B * new / max(dt - dt_prefill, 1e-9)
+    print(json.dumps({
+        "metric": f"kv-decode tokens/sec {name} b{B} prompt{prompt} new{new}",
+        "value": round(decode_tok_s, 1),
+        "unit": "tokens/sec",
+        "prefill_ms": round(dt_prefill * 1e3, 2),
+        "e2e_ms": round(dt * 1e3, 2),
+        "ms_per_token": round((dt - dt_prefill) * 1e3 / new, 3),
+        "batch": B,
+    }))
+
+
+def _bert_bench():
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import bert
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    name = os.environ.get("BENCH_INF_MODEL", "bert-large" if on_tpu else "bert-tiny")
+    B = int(os.environ.get("BENCH_INF_BATCH", "8"))
+    S = int(os.environ.get("BENCH_INF_SEQ", "384" if on_tpu else "128"))
+
+    cfg = bert.get_config(name, n_positions=max(512, S))
+    eng = deepspeed_tpu.init_inference(model=bert.make_module(cfg))
+    rs = np.random.RandomState(0)
+    batch = {"input_ids": rs.randint(0, cfg.vocab_size, size=(B, S)).astype(np.int32)}
+
+    out = eng.forward(batch)  # compile + warm
+    jax.block_until_ready(out)
+    iters = 20 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = eng.forward(batch)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+
+    print(json.dumps({
+        "metric": f"encoder seq/sec {name} b{B} seq{S}",
+        "value": round(B / dt, 1),
+        "unit": "sequences/sec",
+        "ms_per_batch": round(dt * 1e3, 2),
+        "ms_per_seq": round(dt * 1e3 / B, 3),
+        "batch": B,
+        "seq": S,
+    }))
+
+
+if __name__ == "__main__":
+    mode = sys.argv[1] if len(sys.argv) > 1 else "decode"
+    {"decode": _decode_bench, "bert": _bert_bench}[mode]()

@@ -107,8 +107,10 @@ def _bwd_dq_kernel(kidx_ref, kcnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     qi = pl.program_id(2)
     q = q_ref[0, 0]
     do = do_ref[0, 0]
-    lse = lse_ref[0, 0, :, 0:1]  # [blk, 1] (value broadcast across lanes)
-    delta = delta_ref[0, 0, :, 0:1]
+    # load full lanes, slice the value (width-1 lane ref slices are fragile
+    # in Mosaic; the value slice is free — lanes hold broadcast copies)
+    lse = lse_ref[0, 0][:, 0:1]  # [blk, 1]
+    delta = delta_ref[0, 0][:, 0:1]
     cnt = kcnt_ref[h, qi]
 
     def body(j, dq):
@@ -139,8 +141,11 @@ def _bwd_dkv_kernel(qidx_ref, qcnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         qi = qidx_ref[h, ki, i]
         q = q_ref[0, 0, pl.ds(qi * blk, blk), :]
         do = do_ref[0, 0, pl.ds(qi * blk, blk), :]
-        lse = lse_ref[0, 0, pl.ds(qi * blk, blk), 0:1]  # [blk, 1]
-        delta = delta_ref[0, 0, pl.ds(qi * blk, blk), 0:1]
+        # dynamic sublane slice at full lanes, then slice the value — the
+        # combined dynamic-sublane + width-1-lane ref slice is a Mosaic
+        # hazard (same fix as flash_attention._bwd_dkv_kernel)
+        lse = lse_ref[0, 0, pl.ds(qi * blk, blk), :][:, 0:1]  # [blk, 1]
+        delta = delta_ref[0, 0, pl.ds(qi * blk, blk), :][:, 0:1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * sm_scale
         s = _block_mask(s, qi * blk, ki * blk, causal)
         p = jnp.exp(s - lse)

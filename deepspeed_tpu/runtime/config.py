@@ -309,8 +309,9 @@ class TPUConfig(DSConfigModel):
     # fp32 unless a precision section opts in (DeepSpeed default semantics);
     # set "bfloat16" (or bf16.enabled) for the TPU fast path
     compute_dtype: str = "float32"
-    use_pallas_attention: bool = True
-    remat_policy: str = "none"  # none | minimal | full | dots_with_no_batch_dims
+    # attention impl + remat policy are MODEL config (models/gpt2.py
+    # attn_impl / remat_policy): the engine takes an already-built module
+    # and cannot retrofit its internals, so no engine-level knobs for them
     donate_state: bool = True
 
 
