@@ -90,6 +90,35 @@ class TestBlockSparseHardware:
             atol=3e-2, rtol=3e-2,
         )
 
+    def test_backward_compiles_and_matches(self):
+        """dq/dkv kernels carry the dynamic-sublane lse/delta loads — the
+        Mosaic-hazard class that only a chip compile can catch."""
+        from deepspeed_tpu.ops.sparse_attention import FixedSparsityConfig
+        from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import (
+            sparse_attention,
+        )
+
+        H, S, D, block = 2, 1024, 64, 128
+        cfg = FixedSparsityConfig(num_heads=H, block=block)
+        rs = np.random.RandomState(4)
+        q, k, v = (
+            jnp.asarray(rs.randn(1, S, H, D), jnp.bfloat16) for _ in range(3)
+        )
+
+        def loss(impl):
+            def f(q, k, v):
+                o = sparse_attention(q, k, v, cfg, causal=True, impl=impl)
+                return jnp.sum(o.astype(jnp.float32) ** 2)
+            return f
+
+        g = jax.jit(jax.grad(loss("pallas"), argnums=(0, 1, 2)))(q, k, v)
+        g_ref = jax.grad(loss("jnp"), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g, g_ref):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                atol=5e-2, rtol=5e-2,
+            )
+
 
 class TestFusedAdamHardware:
     def test_kernel_compiles_and_matches_optax(self):
