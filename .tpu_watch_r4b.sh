@@ -40,8 +40,8 @@ run_step() { # name, timeout, cmd...
 while true; do
   if bash .tpu_probe.sh 90; then
     log "tunnel alive — capturing queue (v2 order)"
-    run_step bench1 900 python bench.py || continue
-    run_step tb_flashbwd 1200 env DS_TPU_TESTS=1 python -m pytest \
+    run_step bench1 1800 python bench.py || continue
+    run_step tb_flashbwd 2400 env DS_TPU_TESTS=1 python -m pytest \
       "tests/unit/ops/test_tpu_hardware.py::TestFlashAttentionHardware::test_backward_compiles_and_matches" -q --tb=long || continue
     # perf experiments first: these decide the headline config
     run_step bench_dots16 1800 env BENCH_MICRO=16 BENCH_REMAT=1 BENCH_REMAT_POLICY=dots python bench.py || continue
