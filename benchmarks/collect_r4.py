@@ -1,0 +1,104 @@
+"""Collect round-4 hardware artifacts into committed files.
+
+Reads the watcher's per-step logs (.tpu_r4_*.log, gitignored), extracts the
+final JSON line of each, writes:
+
+- BENCH_R4_EXPERIMENTS.json — one entry per captured artifact (committed
+  evidence; the raw logs do not survive container restarts)
+- BENCH_TUNED.json — the best headline-bench config by vs_baseline (only
+  from rungs that ran the headline metric at the default seq), consumed by
+  bench.py as its first ladder rung
+
+Idempotent; run after any recovery pass:  python benchmarks/collect_r4.py
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# experiment rungs whose JSON is a headline-bench line (candidates for tuning)
+HEADLINE_STEPS = {
+    "bench1", "bench_micro64", "bench_noremat8", "bench_dots16",
+    "bench_attn32", "bench_dots8", "bench_ce0_8", "bench_profile",
+}
+
+
+def last_json_line(path: str):
+    out = None
+    with open(path, errors="replace") as f:
+        for line in f:
+            line = line.strip()
+            if line.startswith("{") and line.endswith("}"):
+                try:
+                    out = json.loads(line)
+                except ValueError:
+                    continue
+    return out
+
+
+def main():
+    results = {}
+    for path in sorted(glob.glob(os.path.join(ROOT, ".tpu_r4_*.log"))):
+        step = os.path.basename(path)[len(".tpu_r4_"):-len(".log")]
+        if not os.path.getsize(path):
+            continue
+        wedged = "WEDGE" in open(path, errors="replace").read()
+        j = last_json_line(path)
+        if j is not None:
+            results[step] = j
+        elif wedged:
+            results[step] = {"error": "wedge", "artifact": os.path.basename(path)}
+
+    if not results:
+        print("no artifacts found")
+        return 1
+
+    out_path = os.path.join(ROOT, "BENCH_R4_EXPERIMENTS.json")
+    existing = {}
+    if os.path.exists(out_path):
+        try:
+            with open(out_path) as f:
+                existing = json.load(f)
+        except ValueError:
+            existing = {}
+    # merge: a fresh capture overwrites; never drop a previously committed one
+    existing.update(results)
+    with open(out_path, "w") as f:
+        json.dump(existing, f, indent=1, sort_keys=True)
+    print(f"wrote {out_path} ({len(existing)} entries)")
+
+    best = None
+    for step, j in existing.items():
+        if step not in HEADLINE_STEPS or j.get("error"):
+            continue
+        if "vs_baseline" not in j or j.get("value", 0) <= 0:
+            continue
+        if best is None or j["vs_baseline"] > best[1]["vs_baseline"]:
+            best = (step, j)
+    if best:
+        step, j = best
+        tuned = {
+            "model": j["model"],
+            "micro_batch": j["micro_batch"],
+            "remat": j.get("remat", True),
+            "remat_policy": j.get("remat_policy") or "full",
+            "seq": int(j["metric"].split("seq")[1].split()[0]),
+            "source": step,
+            "vs_baseline": j["vs_baseline"],
+            "mfu": j.get("mfu"),
+        }
+        with open(os.path.join(ROOT, "BENCH_TUNED.json"), "w") as f:
+            json.dump(tuned, f, indent=1)
+        print(f"BENCH_TUNED.json <- {step}: vs_baseline={j['vs_baseline']} "
+              f"model={j['model']} micro={j['micro_batch']} "
+              f"policy={tuned['remat_policy']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
