@@ -31,21 +31,26 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, sm_scale: float,
                    s_max: int, s_block: int):
     pos = pos_ref[0]
     D = q_ref.shape[-1]
-    q = q_ref[...].reshape(1, D).astype(jnp.float32)
+    # dots take the cache's storage dtype with f32 accumulation (bf16
+    # products are exact in the accumulator; skips two full-block VPU
+    # upcast passes per tile); scores/softmax state stay f32
+    q = q_ref[...].reshape(1, D)
     n_blocks = s_max // s_block
 
     def body(j, carry):
         m_prev, l_prev, acc = carry
-        k = k_ref[0, 0, pl.dslice(j * s_block, s_block), :].astype(jnp.float32)
-        v = v_ref[0, 0, pl.dslice(j * s_block, s_block), :].astype(jnp.float32)
+        k = k_ref[0, 0, pl.dslice(j * s_block, s_block), :]
+        v = v_ref[0, 0, pl.dslice(j * s_block, s_block), :]
         s = jnp.dot(k, q.T, preferred_element_type=jnp.float32) * sm_scale  # [S,1]
         idx = jax.lax.broadcasted_iota(jnp.int32, (s_block, 1), 0) + j * s_block
         s = jnp.where(idx <= pos, s, -1e30)
         m_cur = jnp.maximum(m_prev, jnp.max(s))
         corr = jnp.exp(m_prev - m_cur)
-        p = jnp.exp(s - m_cur)  # [S,1]
+        p = jnp.exp(s - m_cur)  # [S,1] f32
         l_cur = l_prev * corr + jnp.sum(p)
-        acc = acc * corr + jnp.dot(p.T, v, preferred_element_type=jnp.float32)
+        acc = acc * corr + jnp.dot(
+            p.astype(v.dtype).T, v, preferred_element_type=jnp.float32
+        )
         return m_cur, l_cur, acc
 
     init = (
