@@ -51,6 +51,10 @@ while true; do
     run_step bench_ce0_8 1800 env BENCH_MICRO=8 BENCH_REMAT=0 BENCH_CE_CHUNK=0 python bench.py || continue
     run_step bench_profile 1800 env BENCH_PROFILE=.prof_r4 python bench.py || continue
     run_step profile_attr 300 python benchmarks/profile_attr.py .prof_r4 || continue
+    # fold what's captured so far into the committed evidence files (the
+    # driver commits uncommitted work at round end even if this session
+    # never sees the recovery); re-run at queue end below for the rest
+    timeout 300 python benchmarks/collect_r4.py >> .tpu_watch_r4.log 2>&1
     run_step flash_sweep 1800 python benchmarks/flash_sweep.py || continue
     # hardware kernel CI + the two open measurements
     run_step tb_hostoffload 1200 env DS_TPU_TESTS=1 python -m pytest \
@@ -65,6 +69,7 @@ while true; do
     run_step infinity_bench 2400 python benchmarks/offload_bench.py infinity || continue
     run_step tpu_suite 3600 env DS_TPU_TESTS=1 python -m pytest tests/ -m tpu -q --tb=short || continue
     run_step bench_micro64 1800 env BENCH_MICRO=64 python bench.py || continue
+    timeout 300 python benchmarks/collect_r4.py >> .tpu_watch_r4.log 2>&1
     log "queue complete"
     break
   fi
