@@ -62,6 +62,9 @@ while true; do
     run_step tb_decode 1200 env DS_TPU_TESTS=1 python -m pytest \
       "tests/unit/ops/test_tpu_hardware.py::TestDecodeAttentionHardware" \
       "tests/unit/ops/test_tpu_hardware.py::TestGQAFlashHardware" -q --tb=long || continue
+    run_step tb_windowed 1800 env DS_TPU_TESTS=1 python -m pytest \
+      "tests/unit/ops/test_tpu_hardware.py::TestWindowedFlashHardware" \
+      "tests/unit/ops/test_tpu_hardware.py::TestBlockSparseHardware" -q --tb=long || continue
     run_step fused_adam_bench 1200 python benchmarks/fused_adam_bench.py || continue
     run_step inf_decode 1800 python benchmarks/inference_bench.py decode || continue
     run_step inf_bert 1800 python benchmarks/inference_bench.py bert || continue

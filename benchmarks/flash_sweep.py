@@ -71,7 +71,7 @@ def main():
         impls = {
             "pallas-auto": jax.jit(lambda q, k, v: flash_attention(q, k, v)),
             "pallas-resident": jax.jit(
-                lambda q, k, v: _flash(q, k, v, float(scale), True, False, 1)
+                lambda q, k, v: _flash(q, k, v, None, float(scale), True, False, 1)
             ),
             "pallas-grid": jax.jit(
                 lambda q, k, v: _flash_grid(q, k, v, float(scale), True, False)

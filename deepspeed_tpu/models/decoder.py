@@ -157,6 +157,16 @@ def _apply_rope(cfg: DecoderConfig, x: jnp.ndarray, sin, cos) -> jnp.ndarray:
     return jnp.concatenate([rotated, xp], axis=-1).astype(x.dtype)
 
 
+def _windows_inert(cfg: DecoderConfig, span: int) -> bool:
+    """True when every layer's local window cannot mask anything within
+    ``span`` positions (w == 0 means global; w >= span is a no-op mask).
+    Mistral-class models declare window 4096: at train/serve lengths inside
+    it, the fast unwindowed kernels are exact."""
+    return not cfg.local_windows or all(
+        w == 0 or w >= span for w in cfg.local_windows
+    )
+
+
 def _attention(cfg: DecoderConfig, lp, h, k_cache, v_cache, pos, layer_window):
     """Causal (optionally local-windowed / alibi-biased) attention with cache.
     GQA (kv_heads < n_head): K/V project and cache at kv_heads and broadcast
@@ -191,13 +201,26 @@ def _attention(cfg: DecoderConfig, lp, h, k_cache, v_cache, pos, layer_window):
     Smax = k_cache.shape[1]
     scale = cfg.attn_scale if cfg.attn_scale is not None else 1.0 / np.sqrt(D)
 
-    static_full_seq = (
+    full_seq_no_bias = (
         isinstance(pos, int)
         and pos == 0
         and S == Smax
         and cfg.pos_emb != "alibi"
-        and not any(cfg.local_windows)
     )
+    static_full_seq = full_seq_no_bias and _windows_inert(cfg, S)
+    if full_seq_no_bias and not static_full_seq:
+        # real sliding windows (Mistral past its window, GPT-Neo local
+        # layers): the windowed flash kernels take the per-layer window as
+        # a traced scalar-prefetch operand, so ONE compiled kernel serves
+        # every layer of the scan and the loop bounds skip blocks wholly
+        # outside the band (FLOPs ~ S*window). Gated on the kernel actually
+        # engaging: the jnp fallback would repeat GQA K/V, while the
+        # grouped-einsum path below never materializes the repeat.
+        from ..ops.attention import causal_attention, windowed_attention_ok
+
+        if windowed_attention_ok(q):
+            o = causal_attention(q, k_, v, sm_scale=scale, window=layer_window)
+            return out_proj(o.reshape(B, S, E).astype(h.dtype)), k_cache, v_cache
     if static_full_seq and KV == H:
         # training/eval full-sequence path (hidden() passes pos=0 as a
         # STATIC int): plain causal attention with no score biasing —
@@ -222,7 +245,7 @@ def _attention(cfg: DecoderConfig, lp, h, k_cache, v_cache, pos, layer_window):
             o = causal_attention(q, k_, v, sm_scale=scale)
             return out_proj(o.reshape(B, S, E).astype(h.dtype)), k_cache, v_cache
 
-    if S == 1 and cfg.pos_emb != "alibi" and not any(cfg.local_windows):
+    if S == 1 and cfg.pos_emb != "alibi" and _windows_inert(cfg, Smax):
         # single-token decode without score biasing (MHA and GQA): route
         # through the decode-attention dispatch (Pallas online-softmax
         # kernel on TPU — GQA reads the KV-headed cache via a divided head

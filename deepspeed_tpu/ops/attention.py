@@ -23,20 +23,10 @@ from ..utils.logging import warning_once
 def causal_attention_jnp(q, k, v, sm_scale: Optional[float] = None):
     """Reference implementation: [B,S,H,D] → [B,S,H,D], causal, f32 softmax.
     Accepts GQA k/v ([B,S,KV,D], H % KV == 0) by repeating — a fallback
-    path, so the materialized repeat is acceptable."""
-    B, S, H, D = q.shape
-    if k.shape[2] != H:
-        rep = H // k.shape[2]
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-    scale = sm_scale if sm_scale is not None else 1.0 / (D**0.5)
-    logits = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
-    ) * scale
-    mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
-    logits = jnp.where(mask[None, None], logits, jnp.float32(-1e30))
-    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    path, so the materialized repeat is acceptable. Exactly the window<=0
+    case of :func:`causal_attention_windowed_jnp` (one masked-softmax
+    reference to keep in sync, not two)."""
+    return causal_attention_windowed_jnp(q, k, v, 0, sm_scale)
 
 
 def _pallas_ok(q) -> bool:
@@ -103,19 +93,60 @@ def cached_attention(q, k_cache, v_cache, pos, impl: str = "auto", sm_scale: Opt
     return o.reshape(B, H, D).astype(q.dtype)
 
 
-def causal_attention(q, k, v, impl: str = "auto", sm_scale: Optional[float] = None):
+def windowed_attention_ok(q) -> bool:
+    """Whether sliding-window causal attention will ride the Pallas kernels
+    for this shape: the ordinary dispatch gate plus the resident-kernel
+    bound (windows are not implemented in the grid variant)."""
+    B, S, H, D = q.shape
+    from .pallas.flash_attention import resident_ok
+
+    return _pallas_ok(q) and resident_ok(S, D, q.dtype.itemsize)
+
+
+def causal_attention_windowed_jnp(q, k, v, window, sm_scale: Optional[float] = None):
+    """Sliding-window reference path: key j visible to query i iff
+    i - window < j <= i; ``window`` may be a traced i32 scalar (<=0 =
+    global). GQA k/v accepted by repeating (fallback path).
+    The unwindowed :func:`causal_attention_jnp` is the window<=0 case."""
+    B, S, H, D = q.shape
+    if k.shape[2] != H:
+        rep = H // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = sm_scale if sm_scale is not None else 1.0 / (D**0.5)
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    i = jnp.arange(S)[:, None]
+    j = jnp.arange(S)[None, :]
+    win = jnp.asarray(window, jnp.int32)
+    keep = (j <= i) & ((win <= 0) | (j > i - win))
+    logits = jnp.where(keep[None, None], logits, jnp.float32(-1e30))
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def causal_attention(q, k, v, impl: str = "auto", sm_scale: Optional[float] = None,
+                     window=None):
     if impl == "jnp":
+        if window is not None:
+            return causal_attention_windowed_jnp(q, k, v, window, sm_scale)
         return causal_attention_jnp(q, k, v, sm_scale)
     if impl in ("auto", "pallas"):
-        if impl == "pallas" or _pallas_ok(q):
+        ok = windowed_attention_ok(q) if window is not None else _pallas_ok(q)
+        if impl == "pallas" or ok:
             try:
                 from .pallas.flash_attention import flash_attention
 
-                return flash_attention(q, k, v, causal=True, sm_scale=sm_scale)
+                return flash_attention(
+                    q, k, v, causal=True, sm_scale=sm_scale, window=window
+                )
             except Exception as e:  # pragma: no cover
                 if impl == "pallas":
                     raise
                 warning_once(f"pallas flash attention unavailable ({e}); using jnp path")
+        if window is not None:
+            return causal_attention_windowed_jnp(q, k, v, window, sm_scale)
         return causal_attention_jnp(q, k, v, sm_scale)
     raise ValueError(f"unknown attention impl {impl}")
 

@@ -21,6 +21,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -32,11 +33,17 @@ NUM_LANES = 128  # lse/delta carry a broadcast 128-lane trailing dim (Mosaic
 NEG_INF = -1e30
 
 
-def _causal_mask(s, q_block, k_block):
-    """Mask scores where key position > query position (shared by all kernels)."""
+def _causal_mask(s, q_block, k_block, window=None):
+    """Mask scores where key position > query position (shared by all
+    kernels). ``window`` (traced i32 scalar; 0 = global) additionally masks
+    keys older than ``window`` positions: kept iff row - window < col <= row
+    (GPT-Neo local attention / Mistral sliding window semantics)."""
     row = q_block * BQ + jax.lax.broadcasted_iota(jnp.int32, (BQ, BK), 0)
     col = k_block * BK + jax.lax.broadcasted_iota(jnp.int32, (BQ, BK), 1)
-    return jnp.where(row >= col, s, NEG_INF)
+    keep = row >= col
+    if window is not None:
+        keep = keep & ((window <= 0) | (col > row - window))
+    return jnp.where(keep, s, NEG_INF)
 
 
 # ---- shared per-block math (one copy for the resident AND grid kernels) ----
@@ -50,7 +57,7 @@ def _causal_mask(s, q_block, k_block):
 # The second GEMM of each pass casts its f32 left operand (p / ds) down to
 # the storage dtype — the standard flash-kernel precision contract.
 
-def _online_softmax_step(q, k, v, carry, qi, ki, causal: bool, sm_scale):
+def _online_softmax_step(q, k, v, carry, qi, ki, causal: bool, sm_scale, window=None):
     """One K/V block of the online-softmax forward.
     carry = (acc [BQ,D], m [BQ,1], l [BQ,1]) in f32."""
     acc, m_prev, l_prev = carry
@@ -58,7 +65,7 @@ def _online_softmax_step(q, k, v, carry, qi, ki, causal: bool, sm_scale):
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * sm_scale
     if causal:
-        s = _causal_mask(s, qi, ki)
+        s = _causal_mask(s, qi, ki, window)
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
     p = jnp.exp(s - m_new)
     alpha = jnp.exp(m_prev - m_new)
@@ -70,14 +77,14 @@ def _online_softmax_step(q, k, v, carry, qi, ki, causal: bool, sm_scale):
     return acc, m_new, l_new
 
 
-def _dq_block(q, k, v, do, lse, delta, qi, ki, causal: bool, sm_scale):
+def _dq_block(q, k, v, do, lse, delta, qi, ki, causal: bool, sm_scale, window=None):
     """One K/V block's contribution to dq (unscaled: caller multiplies the
     accumulated dq by sm_scale once). lse/delta [BQ,1] f32."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * sm_scale
     if causal:
-        s = _causal_mask(s, qi, ki)
+        s = _causal_mask(s, qi, ki, window)
     p = jnp.exp(s - lse)
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -89,14 +96,14 @@ def _dq_block(q, k, v, do, lse, delta, qi, ki, causal: bool, sm_scale):
     )
 
 
-def _dkv_block(q, k, v, do, lse, delta, qi, ki, causal: bool, sm_scale):
+def _dkv_block(q, k, v, do, lse, delta, qi, ki, causal: bool, sm_scale, window=None):
     """One Q block's contributions to (dk, dv); dk unscaled (caller applies
     sm_scale once at finalize)."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * sm_scale
     if causal:
-        s = _causal_mask(s, qi, ki)
+        s = _causal_mask(s, qi, ki, window)
     p = jnp.exp(s - lse)  # [BQ, BK] f32
     dv = jax.lax.dot_general(
         p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -123,6 +130,26 @@ def _causal_lo(ki):
     return (ki * BK) // BQ
 
 
+def _window_lo(qi, window):
+    """First k block a windowed q block can see (window 0 = global). The
+    oldest visible key for q row i is i - window + 1; the block's oldest
+    row is qi*BQ."""
+    return jnp.where(
+        window > 0, jnp.maximum(0, (qi * BQ - window + 1) // BK), 0
+    )
+
+
+def _window_hi_q(ki, num_q_blocks, window):
+    """One-past-last q block that can see k block ki under a window: the
+    newest key of the block (ki*BK + BK - 1) is visible to q rows up to
+    key + window - 1."""
+    return jnp.where(
+        window > 0,
+        jnp.minimum(num_q_blocks, (ki * BK + BK + window - 2) // BQ + 1),
+        num_q_blocks,
+    )
+
+
 # This kernel keeps the full per-(batch,head) K/V (fwd, dq) or Q/dO (dkv) block
 # resident in VMEM (~16 MB/core). Budget for the largest such array; beyond it
 # callers must shard the sequence (ring attention over the sp axis).
@@ -133,55 +160,70 @@ VMEM_RESIDENT_BYTES = 4 * 1024 * 1024
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale: float, causal: bool, seq_len: int):
+def _fwd_kernel(win_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale: float, causal: bool, seq_len: int):
     qi = pl.program_id(1)
+    win = win_ref[0]  # i32 scalar; 0 = global (pure causal)
     q = q_ref[0]  # [BQ, D], storage dtype (bf16 dots ride the native MXU path)
 
     num_k_blocks = pl.cdiv(seq_len, BK)
     hi = _causal_hi(qi, num_k_blocks) if causal else num_k_blocks
+    lo = _window_lo(qi, win) if causal else 0
 
     def body(j, carry):
         k = k_ref[0, pl.ds(j * BK, BK), :]  # [BK, D]
         v = v_ref[0, pl.ds(j * BK, BK), :]
-        return _online_softmax_step(q, k, v, carry, qi, j, causal, sm_scale)
+        return _online_softmax_step(q, k, v, carry, qi, j, causal, sm_scale, win)
 
     acc0 = jnp.zeros((BQ, q_ref.shape[-1]), jnp.float32)
     m0 = jnp.full((BQ, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((BQ, 1), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, hi, body, (acc0, m0, l0))
+    acc, m, l = jax.lax.fori_loop(lo, hi, body, (acc0, m0, l0))
     l = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l).astype(o_ref.dtype)
     lse_ref[0] = jax.lax.broadcast_in_dim((m + jnp.log(l))[:, 0], (BQ, NUM_LANES), (0,))
 
 
-def _fwd(q3, k3, v3, sm_scale: float, causal: bool, interpret: bool = False, kv_rep: int = 1):
+def _win_arr(window) -> jnp.ndarray:
+    """Scalar-prefetch operand for the resident kernels (i32[1]; 0=global)."""
+    return jnp.asarray(0 if window is None else window, jnp.int32).reshape(1)
+
+
+def _fwd(q3, k3, v3, sm_scale: float, causal: bool, interpret: bool = False, kv_rep: int = 1, window=None):
     """q3: [BH, S, D], k3/v3: [BH // kv_rep, S, D] → (o [BH,S,D], lse).
 
     ``kv_rep`` > 1 is grouped-query attention: the flattened batch dim packs
     q heads group-major (bh = (b*KV + g)*rep + r), so the K/V index maps
     simply divide by rep — every q head in a group reads the SAME K/V block
-    and the repeated cache is never materialized."""
+    and the repeated cache is never materialized.
+
+    ``window`` (i32 scalar, traced OK; None/0 = global): sliding-window
+    causal attention — key j visible to query i iff i-window < j <= i. Rides
+    a scalar-prefetch operand so one compiled kernel serves every per-layer
+    window (GPT-Neo alternating local/global layers under one lax.scan)."""
     BH, S, D = q3.shape
     grid = (BH, S // BQ)
     kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal, seq_len=S)
     o, lse = pl.pallas_call(
         kernel,
-        grid=grid,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, BQ, D), lambda b, i, w: (b, i, 0)),
+                pl.BlockSpec((1, S, D), lambda b, i, w: (b // kv_rep, 0, 0)),
+                pl.BlockSpec((1, S, D), lambda b, i, w: (b // kv_rep, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, BQ, D), lambda b, i, w: (b, i, 0)),
+                pl.BlockSpec((1, BQ, NUM_LANES), lambda b, i, w: (b, i, 0)),
+            ],
+        ),
         interpret=interpret,
-        in_specs=[
-            pl.BlockSpec((1, BQ, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, S, D), lambda b, i: (b // kv_rep, 0, 0)),
-            pl.BlockSpec((1, S, D), lambda b, i: (b // kv_rep, 0, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, BQ, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, BQ, NUM_LANES), lambda b, i: (b, i, 0)),
-        ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, S, D), q3.dtype),
             jax.ShapeDtypeStruct((BH, S, NUM_LANES), jnp.float32),
         ],
-    )(q3, k3, v3)
+    )(_win_arr(window), q3, k3, v3)
     return o, lse
 
 
@@ -189,8 +231,9 @@ def _fwd(q3, k3, v3, sm_scale: float, causal: bool, interpret: bool = False, kv_
 # backward
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, sm_scale, causal, seq_len):
+def _bwd_dq_kernel(win_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, sm_scale, causal, seq_len):
     qi = pl.program_id(1)
+    win = win_ref[0]
     q = q_ref[0]
     do = do_ref[0]
     # load full lanes, slice the VALUE: a width-1 lane slice in the ref
@@ -200,23 +243,26 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, s
 
     num_k_blocks = pl.cdiv(seq_len, BK)
     hi = _causal_hi(qi, num_k_blocks) if causal else num_k_blocks
+    lo = _window_lo(qi, win) if causal else 0
 
     def body(j, dq):
         k = k_ref[0, pl.ds(j * BK, BK), :]
         v = v_ref[0, pl.ds(j * BK, BK), :]
-        return dq + _dq_block(q, k, v, do, lse, delta, qi, j, causal, sm_scale)
+        return dq + _dq_block(q, k, v, do, lse, delta, qi, j, causal, sm_scale, win)
 
-    dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((BQ, q_ref.shape[-1]), jnp.float32))
+    dq = jax.lax.fori_loop(lo, hi, body, jnp.zeros((BQ, q_ref.shape[-1]), jnp.float32))
     dq_ref[0] = (dq * sm_scale).astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, sm_scale, causal, seq_len):
+def _bwd_dkv_kernel(win_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, sm_scale, causal, seq_len):
     ki = pl.program_id(1)
+    win = win_ref[0]
     k = k_ref[0]  # [BK, D]
     v = v_ref[0]
 
     num_q_blocks = pl.cdiv(seq_len, BQ)
     lo = _causal_lo(ki) if causal else 0
+    hi = _window_hi_q(ki, num_q_blocks, win) if causal else num_q_blocks
 
     def body(i, carry):
         dk, dv = carry
@@ -226,18 +272,18 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         # combined dynamic-sublane + width-1-lane ref slice is a Mosaic hazard)
         lse = lse_ref[0, pl.ds(i * BQ, BQ), :][:, 0:1]  # [BQ, 1]
         delta = delta_ref[0, pl.ds(i * BQ, BQ), :][:, 0:1]
-        dkc, dvc = _dkv_block(q, k, v, do, lse, delta, i, ki, causal, sm_scale)
+        dkc, dvc = _dkv_block(q, k, v, do, lse, delta, i, ki, causal, sm_scale, win)
         return dk + dkc, dv + dvc
 
     D = k_ref.shape[-1]
     dk0 = jnp.zeros((BK, D), jnp.float32)
     dv0 = jnp.zeros((BK, D), jnp.float32)
-    dk, dv = jax.lax.fori_loop(lo, num_q_blocks, body, (dk0, dv0))
+    dk, dv = jax.lax.fori_loop(lo, hi, body, (dk0, dv0))
     dk_ref[0] = (dk * sm_scale).astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _bwd(q3, k3, v3, o3, lse, do3, sm_scale: float, causal: bool, interpret: bool = False, kv_rep: int = 1):
+def _bwd(q3, k3, v3, o3, lse, do3, sm_scale: float, causal: bool, interpret: bool = False, kv_rep: int = 1, window=None):
     """Grads for _fwd. With ``kv_rep`` > 1 (GQA) the dk/dv kernels run at
     per-q-head resolution ([BH,S,D], each reading its group's K/V block via
     the divided index map); the caller sums the rep axis to get the true
@@ -255,47 +301,54 @@ def _bwd(q3, k3, v3, o3, lse, do3, sm_scale: float, causal: bool, interpret: boo
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1)  # [BH,S]
     delta = jnp.broadcast_to(delta[..., None], (BH, S, NUM_LANES))
 
-    full = lambda b, i: (b, 0, 0)
-    kv_full = lambda b, i: (b // kv_rep, 0, 0)
+    full = lambda b, i, w: (b, 0, 0)
+    kv_full = lambda b, i, w: (b // kv_rep, 0, 0)
+    win = _win_arr(window)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal, seq_len=S),
-        grid=(BH, S // BQ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(BH, S // BQ),
+            in_specs=[
+                pl.BlockSpec((1, BQ, D), lambda b, i, w: (b, i, 0)),
+                pl.BlockSpec((1, S, D), kv_full),
+                pl.BlockSpec((1, S, D), kv_full),
+                pl.BlockSpec((1, BQ, D), lambda b, i, w: (b, i, 0)),
+                pl.BlockSpec((1, BQ, NUM_LANES), lambda b, i, w: (b, i, 0)),
+                pl.BlockSpec((1, BQ, NUM_LANES), lambda b, i, w: (b, i, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, BQ, D), lambda b, i, w: (b, i, 0)),
+        ),
         interpret=interpret,
-        in_specs=[
-            pl.BlockSpec((1, BQ, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, S, D), kv_full),
-            pl.BlockSpec((1, S, D), kv_full),
-            pl.BlockSpec((1, BQ, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, BQ, NUM_LANES), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, BQ, NUM_LANES), lambda b, i: (b, i, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, BQ, D), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, S, D), q3.dtype),
-    )(q3, k3, v3, do3, lse, delta)
+    )(win, q3, k3, v3, do3, lse, delta)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal, seq_len=S),
-        grid=(BH, S // BK),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(BH, S // BK),
+            in_specs=[
+                pl.BlockSpec((1, S, D), full),
+                pl.BlockSpec((1, BK, D), lambda b, i, w: (b // kv_rep, i, 0)),
+                pl.BlockSpec((1, BK, D), lambda b, i, w: (b // kv_rep, i, 0)),
+                pl.BlockSpec((1, S, D), full),
+                pl.BlockSpec((1, S, NUM_LANES), full),
+                pl.BlockSpec((1, S, NUM_LANES), full),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, BK, D), lambda b, i, w: (b, i, 0)),
+                pl.BlockSpec((1, BK, D), lambda b, i, w: (b, i, 0)),
+            ],
+        ),
         interpret=interpret,
-        in_specs=[
-            pl.BlockSpec((1, S, D), full),
-            pl.BlockSpec((1, BK, D), lambda b, i: (b // kv_rep, i, 0)),
-            pl.BlockSpec((1, BK, D), lambda b, i: (b // kv_rep, i, 0)),
-            pl.BlockSpec((1, S, D), full),
-            pl.BlockSpec((1, S, NUM_LANES), full),
-            pl.BlockSpec((1, S, NUM_LANES), full),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, BK, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, BK, D), lambda b, i: (b, i, 0)),
-        ],
         out_shape=[
             # GQA: per-q-head grads stay f32 so the rep-axis sum below
             # rounds to the storage dtype exactly once (like the MHA path)
             jax.ShapeDtypeStruct((BH, S, D), jnp.float32 if kv_rep > 1 else q3.dtype),
             jax.ShapeDtypeStruct((BH, S, D), jnp.float32 if kv_rep > 1 else q3.dtype),
         ],
-    )(q3, k3, v3, do3, lse, delta)
+    )(win, q3, k3, v3, do3, lse, delta)
     if kv_rep > 1:
         dk = dk.reshape(BH // kv_rep, kv_rep, S, D).sum(axis=1).astype(k3.dtype)
         dv = dv.reshape(BH // kv_rep, kv_rep, S, D).sum(axis=1).astype(v3.dtype)
@@ -554,20 +607,23 @@ def resident_ok(S: int, D: int, itemsize: int) -> bool:
     return S * D * itemsize <= VMEM_RESIDENT_BYTES
 
 
-def _fwd_auto(q3, k3, v3, sm_scale: float, causal: bool, interpret: bool = False, kv_rep: int = 1):
+def _fwd_auto(q3, k3, v3, sm_scale: float, causal: bool, interpret: bool = False, kv_rep: int = 1, window=None):
     """Resident kernels inside the whole-K/V VMEM budget, grid variant past
     it — the one dispatch point shared by flash_attention AND the ring(sp)
-    per-block compute."""
+    per-block compute. Sliding windows ride the resident kernels only
+    (callers gate via windowed_flash_ok)."""
     BH, S, D = q3.shape
     if resident_ok(S, D, q3.dtype.itemsize):
-        return _fwd(q3, k3, v3, sm_scale, causal, interpret, kv_rep)
+        return _fwd(q3, k3, v3, sm_scale, causal, interpret, kv_rep, window)
+    assert window is None, "windowed attention requires the resident kernels"
     return _fwd_grid(q3, k3, v3, sm_scale, causal, interpret, kv_rep)
 
 
-def _bwd_auto(q3, k3, v3, o3, lse, do3, sm_scale: float, causal: bool, interpret: bool = False, kv_rep: int = 1):
+def _bwd_auto(q3, k3, v3, o3, lse, do3, sm_scale: float, causal: bool, interpret: bool = False, kv_rep: int = 1, window=None):
     BH, S, D = q3.shape
     if resident_ok(S, D, q3.dtype.itemsize):
-        return _bwd(q3, k3, v3, o3, lse, do3, sm_scale, causal, interpret, kv_rep)
+        return _bwd(q3, k3, v3, o3, lse, do3, sm_scale, causal, interpret, kv_rep, window)
+    assert window is None, "windowed attention requires the resident kernels"
     return _bwd_grid(q3, k3, v3, o3, lse, do3, sm_scale, causal, interpret, kv_rep)
 
 
@@ -595,21 +651,26 @@ _flash_grid.defvjp(_flash_grid_fwd_rule, _flash_grid_bwd_rule)
 # public API with custom VJP
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q3, k3, v3, sm_scale: float, causal: bool, interpret: bool, kv_rep: int = 1):
-    o, _ = _fwd_auto(q3, k3, v3, sm_scale, causal, interpret, kv_rep)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q3, k3, v3, window, sm_scale: float, causal: bool, interpret: bool, kv_rep: int = 1):
+    """``window``: i32[1] (may be traced; [0] = global). Rides the primal
+    argument list because a traced value cannot be a nondiff argnum; its
+    cotangent is float0 (integer dtype)."""
+    o, _ = _fwd_auto(q3, k3, v3, sm_scale, causal, interpret, kv_rep, window)
     return o
 
 
-def _flash_fwd_rule(q3, k3, v3, sm_scale, causal, interpret, kv_rep=1):
-    o, lse = _fwd_auto(q3, k3, v3, sm_scale, causal, interpret, kv_rep)
-    return o, (q3, k3, v3, o, lse)
+def _flash_fwd_rule(q3, k3, v3, window, sm_scale, causal, interpret, kv_rep=1):
+    o, lse = _fwd_auto(q3, k3, v3, sm_scale, causal, interpret, kv_rep, window)
+    return o, (q3, k3, v3, o, lse, window)
 
 
 def _flash_bwd_rule(sm_scale, causal, interpret, kv_rep, res, do3):
-    q3, k3, v3, o3, lse = res
-    dq, dk, dv = _bwd_auto(q3, k3, v3, o3, lse, do3, sm_scale, causal, interpret, kv_rep)
-    return dq, dk, dv
+    q3, k3, v3, o3, lse, window = res
+    dq, dk, dv = _bwd_auto(q3, k3, v3, o3, lse, do3, sm_scale, causal, interpret, kv_rep, window)
+    # integer-dtype primal → float0 cotangent (None when no window was passed)
+    win_ct = None if window is None else np.zeros((1,), jax.dtypes.float0)
+    return dq, dk, dv, win_ct
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -634,7 +695,15 @@ def flash_ok(S: int, D: int) -> bool:
     return S % BQ == 0 and S % BK == 0 and D % 64 == 0 and S <= GRID_KERNEL_MAX_SEQ
 
 
-def flash_attention(q, k, v, causal: bool = True, sm_scale: Optional[float] = None, interpret: bool = False):
+def windowed_flash_ok(S: int, D: int, itemsize: int = 2) -> bool:
+    """Whether a sliding-window sequence can ride the kernels: windows are
+    implemented in the resident variant only (the grid variant's static
+    index maps cannot elide a traced window's dead blocks)."""
+    return flash_ok(S, D) and resident_ok(S, D, itemsize)
+
+
+def flash_attention(q, k, v, causal: bool = True, sm_scale: Optional[float] = None,
+                    interpret: bool = False, window=None):
     """[B,S,H,D] flash attention (causal by default). S must be a multiple of
     128. Sequences within the whole-K/V VMEM budget use the resident kernels
     (fewer grid steps, chip-validated first); longer sequences stream K/V
@@ -643,7 +712,14 @@ def flash_attention(q, k, v, causal: bool = True, sm_scale: Optional[float] = No
     Grouped-query attention: ``k``/``v`` may carry fewer heads than ``q``
     ([B,S,KV,D] with H % KV == 0). The kernels read each group's shared K/V
     block through a divided batch index map — the repeated cache is never
-    materialized in HBM or VMEM, and dk/dv accumulate over the group."""
+    materialized in HBM or VMEM, and dk/dv accumulate over the group.
+
+    ``window`` (int or traced i32 scalar; None/0 = global): sliding-window
+    causal attention — key j visible to query i iff i-window < j <= i
+    (Mistral sliding_window / GPT-Neo local-layer semantics). The loop
+    bounds skip blocks wholly outside the band, so FLOPs scale with
+    S*window, not S^2; requires ``causal`` and the resident kernels
+    (gate with windowed_flash_ok)."""
     B, S, H, D = q.shape
     rep = validate_kv_heads(H, k, v)
     if S % BQ != 0 or S % BK != 0:
@@ -655,6 +731,14 @@ def flash_attention(q, k, v, causal: bool = True, sm_scale: Optional[float] = No
             "residuals dominate HBM past it — shard the sequence (sp axis / "
             "ring attention) instead"
         )
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal attention")
+        if not resident_ok(S, D, q.dtype.itemsize):
+            raise ValueError(
+                f"windowed attention needs the resident kernels "
+                f"(S*D*itemsize <= {VMEM_RESIDENT_BYTES}); got S={S} D={D}"
+            )
     scale = sm_scale if sm_scale is not None else 1.0 / (D**0.5)
 
     def to3(x):
@@ -663,5 +747,7 @@ def flash_attention(q, k, v, causal: bool = True, sm_scale: Optional[float] = No
 
     # batch-major flattening makes bh = (b*KV + g)*rep + r for q and
     # b*KV + g for k/v, so bh // rep recovers the kv row exactly
-    o3 = _flash(to3(q), to3(k), to3(v), float(scale), bool(causal), bool(interpret), rep)
+    win = None if window is None else _win_arr(window)
+    o3 = _flash(to3(q), to3(k), to3(v), win, float(scale),
+                bool(causal), bool(interpret), rep)
     return o3.reshape(B, H, S, D).transpose(0, 2, 1, 3)

@@ -236,3 +236,100 @@ class TestGridVariant:
         q, k, v = _qkv(1, 256, 1, 64, seed=9)
         with pytest.raises(ValueError, match="ceiling"):
             fa.flash_attention(q, k, v, interpret=True)
+
+
+def _windowed_ref(q, k, v, window, sm_scale=None):
+    """jnp reference for sliding-window causal attention: key j visible to
+    query i iff i - window < j <= i (window 0 = global)."""
+    B, S, H, D = q.shape
+    scale = sm_scale if sm_scale is not None else 1.0 / (D**0.5)
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    i = jnp.arange(S)[:, None]
+    j = jnp.arange(S)[None, :]
+    keep = j <= i
+    if window > 0:
+        keep = keep & (j > i - window)
+    logits = jnp.where(keep[None, None], logits, jnp.float32(-1e30))
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class TestSlidingWindow:
+    """Sliding-window flash (Mistral sliding_window / GPT-Neo local layers):
+    the kernel's loop bounds skip blocks wholly outside the band and the
+    in-block mask trims the rest."""
+
+    @pytest.mark.parametrize("window", [1, 37, 128, 200, 256, 1000])
+    def test_forward_parity(self, window):
+        q, k, v = _qkv(1, 256, 2, 64, seed=11)
+        o = flash_attention(q, k, v, interpret=True, window=window)
+        np.testing.assert_allclose(
+            np.asarray(o), np.asarray(_windowed_ref(q, k, v, window)),
+            atol=2e-5, rtol=2e-5,
+        )
+
+    def test_window_geq_seq_equals_global(self):
+        q, k, v = _qkv(1, 128, 2, 64, seed=12)
+        o = flash_attention(q, k, v, interpret=True, window=128)
+        o_ref = flash_attention(q, k, v, interpret=True)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref), atol=0, rtol=0)
+
+    @pytest.mark.parametrize("window", [64, 130])
+    def test_backward_parity(self, window):
+        q, k, v = _qkv(1, 256, 2, 64, seed=13)
+
+        def loss_k(q, k, v):
+            return jnp.sum(
+                flash_attention(q, k, v, interpret=True, window=window) ** 2
+            )
+
+        def loss_r(q, k, v):
+            return jnp.sum(_windowed_ref(q, k, v, window) ** 2)
+
+        g1 = jax.grad(loss_k, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5, rtol=5e-4)
+
+    def test_traced_window_one_compile_serves_all(self):
+        """The window rides a scalar-prefetch operand, so a traced per-layer
+        window works under jit/scan (GPT-Neo alternating local/global)."""
+        q, k, v = _qkv(1, 256, 2, 64, seed=14)
+
+        @jax.jit
+        def f(w):
+            return flash_attention(q, k, v, interpret=True, window=w)
+
+        for w in (0, 64, 256):
+            np.testing.assert_allclose(
+                np.asarray(f(jnp.int32(w))),
+                np.asarray(_windowed_ref(q, k, v, w)),
+                atol=2e-5, rtol=2e-5,
+            )
+
+    def test_gqa_windowed(self):
+        q, _, _ = _qkv(1, 256, 4, 64, seed=15)
+        _, k, v = _qkv(1, 256, 2, 64, seed=16)
+        o = flash_attention(q, k, v, interpret=True, window=100)
+        kr = jnp.repeat(k, 2, axis=2)
+        vr = jnp.repeat(v, 2, axis=2)
+        np.testing.assert_allclose(
+            np.asarray(o), np.asarray(_windowed_ref(q, kr, vr, 100)),
+            atol=2e-5, rtol=2e-5,
+        )
+
+    def test_noncausal_window_rejected(self):
+        q, k, v = _qkv(1, 128, 1, 64)
+        with pytest.raises(ValueError, match="causal"):
+            flash_attention(q, k, v, causal=False, interpret=True, window=8)
+
+    def test_window_needs_resident(self, monkeypatch):
+        from deepspeed_tpu.ops.pallas import flash_attention as fa
+
+        monkeypatch.setattr(fa, "VMEM_RESIDENT_BYTES", 1)
+        q, k, v = _qkv(1, 128, 1, 64)
+        assert not fa.windowed_flash_ok(128, 64, 4)
+        with pytest.raises(ValueError, match="resident"):
+            fa.flash_attention(q, k, v, interpret=True, window=8)

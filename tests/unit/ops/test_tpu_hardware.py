@@ -307,12 +307,53 @@ class TestGridFlashHardware:
             jnp.asarray(rs.randn(2, 1024, 64), jnp.bfloat16) for _ in range(3)
         ]
         scale = 1.0 / np.sqrt(64)
-        o_res = jax.jit(lambda a, b, c: _flash(a, b, c, scale, True, False))(q3, k3, v3)
+        o_res = jax.jit(lambda a, b, c: _flash(a, b, c, None, scale, True, False))(q3, k3, v3)
         o_grid = jax.jit(lambda a, b, c: _flash_grid(a, b, c, scale, True, False))(q3, k3, v3)
         np.testing.assert_allclose(
             np.asarray(o_res, np.float32), np.asarray(o_grid, np.float32),
             atol=2e-2, rtol=2e-2,
         )
+
+
+class TestWindowedFlashHardware:
+    """Sliding-window flash on a chip: the traced scalar-prefetch window and
+    the dynamic fori_loop lower bound are the new Mosaic surface here."""
+
+    def test_windowed_forward_and_backward(self):
+        from deepspeed_tpu.ops.attention import causal_attention_windowed_jnp
+        from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+        rs = np.random.RandomState(21)
+        q, k, v = (
+            jnp.asarray(rs.randn(1, 1024, 2, 64), jnp.bfloat16) for _ in range(3)
+        )
+        for w in (256, 0):  # one compiled kernel serves both (traced window)
+            o = jax.jit(
+                lambda q, k, v, w: flash_attention(q, k, v, window=w)
+            )(q, k, v, jnp.int32(w))
+            o_ref = causal_attention_windowed_jnp(q, k, v, w)
+            np.testing.assert_allclose(
+                np.asarray(o, np.float32), np.asarray(o_ref, np.float32),
+                atol=2e-2, rtol=2e-2,
+            )
+
+        def loss(q, k, v):
+            return jnp.sum(
+                flash_attention(q, k, v, window=256).astype(jnp.float32) ** 2
+            )
+
+        def loss_ref(q, k, v):
+            return jnp.sum(
+                causal_attention_windowed_jnp(q, k, v, 256).astype(jnp.float32) ** 2
+            )
+
+        g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g, g_ref):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                atol=5e-2, rtol=5e-2,
+            )
 
 
 class TestGQAFlashHardware:

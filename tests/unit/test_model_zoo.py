@@ -419,6 +419,81 @@ class TestLlama:
             np.asarray(forced), np.asarray(base), atol=2e-4, rtol=2e-4
         )
 
+    def test_inert_sliding_window_rides_kernel_branch(self, monkeypatch):
+        """Mistral declares sliding_window=4096; at train lengths inside the
+        window the mask is a no-op, so the decoder must take the flash
+        kernel branch (forced on CPU via interpret) and match the windowed
+        einsum path exactly."""
+        import functools
+
+        import deepspeed_tpu.ops.attention as attn
+        import deepspeed_tpu.ops.pallas.flash_attention as fa
+        from deepspeed_tpu.models import decoder
+        from deepspeed_tpu.module_inject import replace_transformer_layer
+
+        S = 128
+        hf_model = _hf("MistralForCausalLM", "MistralConfig", dict(
+            hidden_size=256, num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, intermediate_size=256, vocab_size=128,
+            max_position_embeddings=S, sliding_window=S,  # window == seq: inert
+        ))
+        _, cfg, params = replace_transformer_layer(hf_model, dtype=jnp.float32)
+        assert cfg.local_windows and all(w == S for w in cfg.local_windows)
+        assert decoder._windows_inert(cfg, S) and not decoder._windows_inert(cfg, S + 1)
+        ids = jnp.asarray(
+            np.random.RandomState(5).randint(0, cfg.vocab_size, (1, S)), jnp.int32
+        )
+        base = decoder.forward(cfg, params, ids)  # windowed einsum path on CPU
+        flash_interp = functools.partial(fa.flash_attention, interpret=True)
+        monkeypatch.setattr(attn, "_pallas_ok", lambda q: True)
+        monkeypatch.setattr(attn, "pallas_attention_ok", lambda q: True)
+        monkeypatch.setattr(fa, "flash_attention", flash_interp)
+        forced = decoder.forward(cfg, params, ids)
+        np.testing.assert_allclose(
+            np.asarray(forced), np.asarray(base), atol=2e-4, rtol=2e-4
+        )
+
+    def test_local_windows_ride_windowed_kernel_branch(self, monkeypatch):
+        """GPT-Neo-style alternating local/global layers (window < seq, NOT
+        inert): the per-layer traced window flows into the windowed flash
+        kernel (forced on CPU via interpret) and must reproduce the masked
+        einsum path exactly — one compiled kernel serves both layer kinds."""
+        import functools
+
+        import deepspeed_tpu.ops.attention as attn
+        import deepspeed_tpu.ops.pallas.flash_attention as fa
+        from deepspeed_tpu.models import decoder
+
+        S = 128
+        cfg = decoder.DecoderConfig(
+            vocab_size=128, n_positions=S, n_embd=128, n_layer=2, n_head=2,
+            ffn_dim=128, pos_emb="rope", local_windows=(8, 0),
+        )
+        rs = np.random.RandomState(7)
+        L, E, F = cfg.n_layer, cfg.n_embd, cfg.ffn_dim
+        nrm = lambda *sh: jnp.asarray(rs.randn(*sh) * 0.05, jnp.float32)
+        ln = lambda: {"scale": jnp.ones((L, E)), "bias": jnp.zeros((L, E))}
+        params = {
+            "wte": nrm(cfg.vocab_size, E),
+            "blocks": {
+                "ln_1": ln(), "ln_2": ln(),
+                "attn": {"wq": nrm(L, E, E), "wk": nrm(L, E, E),
+                         "wv": nrm(L, E, E), "wo": nrm(L, E, E)},
+                "mlp": {"fc_in_w": nrm(L, E, F), "fc_out_w": nrm(L, F, E)},
+            },
+            "ln_f": {"scale": jnp.ones((E,)), "bias": jnp.zeros((E,))},
+        }
+        assert not decoder._windows_inert(cfg, S)
+        ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (1, S)), jnp.int32)
+        base = decoder.forward(cfg, params, ids)  # masked einsum path on CPU
+        flash_interp = functools.partial(fa.flash_attention, interpret=True)
+        monkeypatch.setattr(attn, "windowed_attention_ok", lambda q: True)
+        monkeypatch.setattr(fa, "flash_attention", flash_interp)
+        forced = decoder.forward(cfg, params, ids)
+        np.testing.assert_allclose(
+            np.asarray(forced), np.asarray(base), atol=2e-4, rtol=2e-4
+        )
+
     def test_gqa_cache_is_kv_headed(self):
         from deepspeed_tpu.models import decoder
         from deepspeed_tpu.module_inject import replace_transformer_layer
