@@ -96,11 +96,15 @@ def cached_attention(q, k_cache, v_cache, pos, impl: str = "auto", sm_scale: Opt
 def windowed_attention_ok(q) -> bool:
     """Whether sliding-window causal attention will ride the Pallas kernels
     for this shape: the ordinary dispatch gate plus the resident-kernel
-    bound (windows are not implemented in the grid variant)."""
+    bound (windows are not implemented in the grid variant). The shape rule
+    is windowed_flash_ok — shared with the kernel's own checks so the two
+    gates can never disagree."""
     B, S, H, D = q.shape
-    from .pallas.flash_attention import resident_ok
+    from .pallas.flash_attention import windowed_flash_ok
 
-    return _pallas_ok(q) and resident_ok(S, D, q.dtype.itemsize)
+    if jax.default_backend() not in ("tpu",):
+        return False
+    return windowed_flash_ok(S, D, q.dtype.itemsize)
 
 
 def causal_attention_windowed_jnp(q, k, v, window, sm_scale: Optional[float] = None):

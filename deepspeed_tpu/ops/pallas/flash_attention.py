@@ -615,7 +615,12 @@ def _fwd_auto(q3, k3, v3, sm_scale: float, causal: bool, interpret: bool = False
     BH, S, D = q3.shape
     if resident_ok(S, D, q3.dtype.itemsize):
         return _fwd(q3, k3, v3, sm_scale, causal, interpret, kv_rep, window)
-    assert window is None, "windowed attention requires the resident kernels"
+    if window is not None:
+        raise NotImplementedError(
+            "windowed attention requires the resident kernels (shape past "
+            "the VMEM budget); silently dropping the window would compute "
+            "global attention"
+        )
     return _fwd_grid(q3, k3, v3, sm_scale, causal, interpret, kv_rep)
 
 
@@ -623,7 +628,12 @@ def _bwd_auto(q3, k3, v3, o3, lse, do3, sm_scale: float, causal: bool, interpret
     BH, S, D = q3.shape
     if resident_ok(S, D, q3.dtype.itemsize):
         return _bwd(q3, k3, v3, o3, lse, do3, sm_scale, causal, interpret, kv_rep, window)
-    assert window is None, "windowed attention requires the resident kernels"
+    if window is not None:
+        raise NotImplementedError(
+            "windowed attention requires the resident kernels (shape past "
+            "the VMEM budget); silently dropping the window would compute "
+            "global attention"
+        )
     return _bwd_grid(q3, k3, v3, o3, lse, do3, sm_scale, causal, interpret, kv_rep)
 
 

@@ -25,6 +25,41 @@ def _qkv(B, S, H, D, seed=0, dtype=jnp.bfloat16):
     return [jnp.asarray(rs.randn(B, S, H, D), dtype) for _ in range(3)]
 
 
+def _grad_triple(fn, q, k, v):
+    loss = lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2)
+    return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+
+
+def _truth_grads(fn, q, k, v):
+    """f32 inputs + highest MXU precision: the ground truth both bf16
+    implementations are measured against. On TPU an f32 ``dot`` runs as a
+    single truncated-bf16 MXU pass by default, so even the jnp reference
+    carries bf16-level noise on hardware — comparing two noisy
+    implementations against EACH OTHER (the round-4 session-2 test shape)
+    double-counts that noise and fails on exactly-zero rows; each must be
+    compared against this truth instead."""
+    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+    with jax.default_matmul_precision("highest"):
+        return _grad_triple(fn, qf, kf, vf)
+
+
+def _assert_grads_within_reference_noise(g_pallas, g_ref, g_truth, floor=2e-2):
+    """The kernel's gradient error (vs f32-highest truth) may not exceed
+    2x the jnp reference's own bf16 error at the same shape (plus a small
+    absolute floor for exact-cancellation rows where the reference error
+    is ~0). Normalized per-array by max|truth| so tolerances are
+    shape/scale-robust."""
+    for name, a, b, t in zip(("dq", "dk", "dv"), g_pallas, g_ref, g_truth):
+        a, b, t = (np.asarray(x, np.float32) for x in (a, b, t))
+        scale = np.abs(t).max() + 1e-6
+        err_pal = np.abs(a - t).max() / scale
+        err_ref = np.abs(b - t).max() / scale
+        assert err_pal <= max(2.0 * err_ref, floor), (
+            f"{name}: pallas err {err_pal:.4f} vs reference err {err_ref:.4f} "
+            f"(scale {scale:.3f})"
+        )
+
+
 class TestFlashAttentionHardware:
     def test_forward_compiles_and_matches(self):
         from deepspeed_tpu.ops.attention import causal_attention_jnp
@@ -43,17 +78,10 @@ class TestFlashAttentionHardware:
         from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 
         q, k, v = _qkv(1, 512, 2, 64, seed=1)
-
-        def loss_k(f):
-            return lambda q, k, v: jnp.sum(f(q, k, v).astype(jnp.float32) ** 2)
-
-        g = jax.jit(jax.grad(loss_k(flash_attention), argnums=(0, 1, 2)))(q, k, v)
-        g_ref = jax.jit(jax.grad(loss_k(causal_attention_jnp), argnums=(0, 1, 2)))(q, k, v)
-        for a, b in zip(g, g_ref):
-            np.testing.assert_allclose(
-                np.asarray(a, np.float32), np.asarray(b, np.float32),
-                atol=5e-2, rtol=5e-2,
-            )
+        g = _grad_triple(flash_attention, q, k, v)
+        g_ref = _grad_triple(causal_attention_jnp, q, k, v)
+        g_truth = _truth_grads(causal_attention_jnp, q, k, v)
+        _assert_grads_within_reference_noise(g, g_ref, g_truth)
 
     def test_head_dim_128(self):
         from deepspeed_tpu.ops.attention import causal_attention_jnp
@@ -105,19 +133,13 @@ class TestBlockSparseHardware:
             jnp.asarray(rs.randn(1, S, H, D), jnp.bfloat16) for _ in range(3)
         )
 
-        def loss(impl):
-            def f(q, k, v):
-                o = sparse_attention(q, k, v, cfg, causal=True, impl=impl)
-                return jnp.sum(o.astype(jnp.float32) ** 2)
-            return f
+        def f(impl):
+            return lambda q, k, v: sparse_attention(q, k, v, cfg, causal=True, impl=impl)
 
-        g = jax.jit(jax.grad(loss("pallas"), argnums=(0, 1, 2)))(q, k, v)
-        g_ref = jax.grad(loss("jnp"), argnums=(0, 1, 2))(q, k, v)
-        for a, b in zip(g, g_ref):
-            np.testing.assert_allclose(
-                np.asarray(a, np.float32), np.asarray(b, np.float32),
-                atol=5e-2, rtol=5e-2,
-            )
+        g = _grad_triple(f("pallas"), q, k, v)
+        g_ref = _grad_triple(f("jnp"), q, k, v)
+        g_truth = _truth_grads(f("jnp"), q, k, v)
+        _assert_grads_within_reference_noise(g, g_ref, g_truth)
 
 
 class TestFusedAdamHardware:
@@ -327,33 +349,21 @@ class TestWindowedFlashHardware:
         q, k, v = (
             jnp.asarray(rs.randn(1, 1024, 2, 64), jnp.bfloat16) for _ in range(3)
         )
+        f = jax.jit(lambda q, k, v, w: flash_attention(q, k, v, window=w))
         for w in (256, 0):  # one compiled kernel serves both (traced window)
-            o = jax.jit(
-                lambda q, k, v, w: flash_attention(q, k, v, window=w)
-            )(q, k, v, jnp.int32(w))
+            o = f(q, k, v, jnp.int32(w))
             o_ref = causal_attention_windowed_jnp(q, k, v, w)
             np.testing.assert_allclose(
                 np.asarray(o, np.float32), np.asarray(o_ref, np.float32),
                 atol=2e-2, rtol=2e-2,
             )
 
-        def loss(q, k, v):
-            return jnp.sum(
-                flash_attention(q, k, v, window=256).astype(jnp.float32) ** 2
-            )
-
-        def loss_ref(q, k, v):
-            return jnp.sum(
-                causal_attention_windowed_jnp(q, k, v, 256).astype(jnp.float32) ** 2
-            )
-
-        g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
-        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
-        for a, b in zip(g, g_ref):
-            np.testing.assert_allclose(
-                np.asarray(a, np.float32), np.asarray(b, np.float32),
-                atol=5e-2, rtol=5e-2,
-            )
+        fk = lambda q, k, v: flash_attention(q, k, v, window=256)
+        fr = lambda q, k, v: causal_attention_windowed_jnp(q, k, v, 256)
+        g = _grad_triple(fk, q, k, v)
+        g_ref = _grad_triple(fr, q, k, v)
+        g_truth = _truth_grads(fr, q, k, v)
+        _assert_grads_within_reference_noise(g, g_ref, g_truth)
 
 
 class TestGQAFlashHardware:
