@@ -1,0 +1,76 @@
+#!/bin/bash
+# Round-4 capture queue, phase 2 (session 3): perf rungs around the measured
+# dots16 winner, re-capture of the benches whose phase-1 timing was untrust-
+# worthy (block_until_ready is not a barrier under the relay — see
+# benchmarks/device_timing.py), the restructured flash-bwd hardware test,
+# and a final tuned-config headline run. Kill .tpu_watch_r4b.sh before
+# starting this; an in-flight TPU child is waited out below.
+cd /root/repo || exit 1
+log() { echo "[$(date +%H:%M:%S)] $*" >> .tpu_watch_r4.log; }
+
+while pgrep -f "^python (bench\.py|benchmarks/|-m pytest tests/unit/ops/test_tpu_hardware|-m pytest tests/ -m tpu)" >/dev/null; do
+  log "phase2: waiting for in-flight TPU job"
+  sleep 60
+done
+
+run_step() { # name, timeout, cmd...
+  local name="$1" t="$2"; shift 2
+  local out=".tpu_r4_${name}.log"
+  if [ -s "$out" ] && ! grep -q "WEDGE" "$out"; then
+    log "skip $name (artifact exists)"; return 0
+  fi
+  log "run $name"
+  timeout "$t" "$@" > "$out" 2>&1
+  local rc=$?
+  log "done $name rc=$rc"
+  if [ $rc -eq 124 ]; then
+    echo "WEDGE rc=124" >> "$out"
+    sleep 300
+    return 1
+  fi
+  # a transient relay/transport failure is retryable — mark it WEDGE so the
+  # skip-check re-runs this step next pass instead of recording the loss of
+  # the measurement as "complete" (genuine failures — test asserts, OOMs —
+  # stay final)
+  if [ $rc -ne 0 ] && grep -qE "backend_unavailable|UNAVAILABLE|DEADLINE_EXCEEDED|failed to connect|Socket closed|Connection reset" "$out"; then
+    echo "WEDGE transient rc=$rc" >> "$out"
+    sleep 120
+    return 1
+  fi
+  return 0
+}
+
+# a phase-1 infinity success needs no re-run (same code path)
+grep -q '"metric"' .tpu_r4_infinity_bench.log 2>/dev/null && cp .tpu_r4_infinity_bench.log .tpu_r4_infinity2.log
+
+while true; do
+  if bash .tpu_probe.sh 90; then
+    log "phase2: tunnel alive"
+    # perf rungs first (cheap, warm cache; decide the tuned headline config)
+    run_step bench_dots32 1800 env BENCH_MICRO=32 BENCH_REMAT=1 BENCH_REMAT_POLICY=dots python bench.py || continue
+    run_step bench_attn16 1800 env BENCH_MICRO=16 BENCH_REMAT=1 BENCH_REMAT_POLICY=attn python bench.py || continue
+    run_step bench_dots16_ce512 1800 env BENCH_MICRO=16 BENCH_REMAT=1 BENCH_REMAT_POLICY=dots BENCH_CE_CHUNK=512 python bench.py || continue
+    run_step bench_dots16_ce1024 1800 env BENCH_MICRO=16 BENCH_REMAT=1 BENCH_REMAT_POLICY=dots BENCH_CE_CHUNK=1024 python bench.py || continue
+    timeout 300 python benchmarks/collect_r4.py >> .tpu_watch_r4.log 2>&1
+    # confirm the collector's pick at lower variance (20 steps, tuned rung)
+    run_step bench_dots16_s20 2400 env BENCH_STEPS=20 python bench.py || continue
+    # fixed measurements
+    run_step tb_flashbwd2 2400 env DS_TPU_TESTS=1 python -m pytest \
+      "tests/unit/ops/test_tpu_hardware.py::TestFlashAttentionHardware" -q --tb=long || continue
+    run_step fused_adam2 1800 python benchmarks/fused_adam_bench.py || continue
+    run_step flash_sweep2 2400 python benchmarks/flash_sweep.py || continue
+    run_step inf_bert2 1800 python benchmarks/inference_bench.py bert || continue
+    run_step offload2 2400 python benchmarks/offload_bench.py offload || continue
+    run_step infinity2 2400 python benchmarks/offload_bench.py infinity || continue
+    # full hardware suite with the restructured tests (phase-1's tpu_suite
+    # name is not reused: the tests changed since)
+    run_step tpu_suite2 3600 env DS_TPU_TESTS=1 python -m pytest tests/ -m tpu -q --tb=short || continue
+    run_step bench_micro64 1800 env BENCH_MICRO=64 python bench.py || continue
+    # headline with the measured-best tuned config (what the driver will run)
+    run_step bench_final 2400 python bench.py || continue
+    timeout 300 python benchmarks/collect_r4.py >> .tpu_watch_r4.log 2>&1
+    log "phase2 queue complete"
+    break
+  fi
+  sleep 240
+done

@@ -106,7 +106,8 @@ def fit_micros(name: str, seq: int, hbm_bytes: float, n_dev: int = 1,
 
 
 def build_engine(model_name: str, seq: int, micro: int, n_dev: int, zero_stage: int,
-                 remat: bool = None, remat_policy: str = None, attn_impl: str = None):
+                 remat: bool = None, remat_policy: str = None, attn_impl: str = None,
+                 ce_chunk: int = None):
     from deepspeed_tpu.models import gpt2
     from deepspeed_tpu.parallel.topology import MeshSpec
     from deepspeed_tpu.runtime.config import DeepSpeedConfig
@@ -123,7 +124,7 @@ def build_engine(model_name: str, seq: int, micro: int, n_dev: int, zero_stage: 
         model_name, n_positions=seq, remat=remat,
         # 0 = classic full-logits CE (no backward logits recompute; only
         # fits small micro batches), default 256-position chunks
-        ce_chunk=int(os.environ.get("BENCH_CE_CHUNK", "256")),
+        ce_chunk=int(os.environ.get("BENCH_CE_CHUNK", "256")) if ce_chunk is None else int(ce_chunk),
         remat_policy=remat_policy or os.environ.get("BENCH_REMAT_POLICY", "full"),
         attn_impl=attn_impl or os.environ.get("BENCH_ATTN", "auto"),
     )
@@ -344,7 +345,8 @@ def main():
     tuned_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_TUNED.json")
     if (on_tpu and auto_micro and remat_env is None
             and "BENCH_MODEL" not in os.environ
-            and "BENCH_REMAT_POLICY" not in os.environ):
+            and "BENCH_REMAT_POLICY" not in os.environ
+            and "BENCH_CE_CHUNK" not in os.environ):
         try:
             with open(tuned_path) as f:
                 t = json.load(f)
@@ -352,17 +354,26 @@ def main():
             # auto ladder instead of aborting the benchmark. The tuned config
             # only applies at the seq it was measured at.
             if int(t.get("seq", seq)) == seq:
+                # rung layout: (model, remat, micro, policy, attn, ce_chunk).
+                # ce_chunk rides the RUNG, not the environment: a tuned
+                # non-default chunking must not leak into the OOM-fallback
+                # ladder (a tuned ce_chunk=0 would make every fallback rung
+                # full-logits too — the most OOM-prone setting)
                 tuned = (str(t["model"]), bool(t.get("remat", True)),
-                         int(t["micro_batch"]), str(t.get("remat_policy", "full")))
+                         int(t["micro_batch"]), str(t.get("remat_policy", "full")),
+                         None, int(t["ce_chunk"]) if "ce_chunk" in t else None)
         except Exception:
             tuned = None
     if tuned:
         ladder.append(tuned)
     def _eff(r):
-        # effective (model, remat, micro, policy) of a rung: None remat means
-        # the preset default; a missing policy means "full"
+        # effective (model, remat, micro, policy, ce_chunk) of a rung: None
+        # remat means the preset default; a missing policy means "full"; a
+        # missing ce_chunk means the env/256 default
         remat = r[1] if r[1] is not None else r[0] in ("gpt2-large", "gpt2-xl")
-        return (r[0], bool(remat), r[2], r[3] if len(r) > 3 else "full")
+        policy = (r[3] if len(r) > 3 else None) or "full"
+        ce = r[5] if len(r) > 5 and r[5] is not None else int(os.environ.get("BENCH_CE_CHUNK", "256"))
+        return (r[0], bool(remat), r[2], policy, ce)
 
     def _push(rung):
         # a failed tuned rung must not make the auto ladder recompile the
@@ -400,6 +411,7 @@ def main():
         name, remat, mb = rung[:3]
         policy = rung[3] if len(rung) > 3 else None
         attn = rung[4] if len(rung) > 4 else None
+        rung_ce = rung[5] if len(rung) > 5 else None
         if remat_pin is not None:
             remat = remat_pin
         try:
@@ -409,7 +421,7 @@ def main():
             disarm_watchdog = _arm_inproc_watchdog(attempts)
             cfg, engine = build_engine(name, seq, mb, n_dev, zero_stage,
                                        remat=remat, remat_policy=policy,
-                                       attn_impl=attn)
+                                       attn_impl=attn, ce_chunk=rung_ce)
             rs = np.random.RandomState(0)
             batch = {
                 "input_ids": rs.randint(
@@ -566,6 +578,7 @@ def main():
         "attn_impl_used": attn_impl_used(cfg, micro, seq),
         "remat": bool(cfg.remat),
         "remat_policy": cfg.remat_policy if cfg.remat else None,
+        "ce_chunk": int(cfg.ce_chunk),
         "micro_batch": micro,
         "xl_equiv_tokens_per_sec_chip": round(xl_equiv_tok_per_sec_chip, 1),
         "loss_first_to_last": [round(first_loss, 4), round(last_loss, 4)],

@@ -25,6 +25,9 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 HEADLINE_STEPS = {
     "bench1", "bench_micro64", "bench_noremat8", "bench_dots16",
     "bench_attn32", "bench_dots8", "bench_ce0_8", "bench_profile",
+    # phase-2 rungs (.tpu_watch_r4c.sh)
+    "bench_dots32", "bench_attn16", "bench_dots16_ce512",
+    "bench_dots16_ce1024", "bench_dots16_s20", "bench_final",
     # seeded session-1 captures: keep them in the max so a weaker later rung
     # can never downgrade BENCH_TUNED below the best committed number
     "bench_capture_session1_micro32", "bench1_oldkernels_f32dots",
@@ -94,6 +97,8 @@ def main():
             "vs_baseline": j["vs_baseline"],
             "mfu": j.get("mfu"),
         }
+        if "ce_chunk" in j:
+            tuned["ce_chunk"] = int(j["ce_chunk"])
         with open(os.path.join(ROOT, "BENCH_TUNED.json"), "w") as f:
             json.dump(tuned, f, indent=1)
         print(f"BENCH_TUNED.json <- {step}: vs_baseline={j['vs_baseline']} "

@@ -14,12 +14,18 @@ at the bottom of this file.
 
 from __future__ import annotations
 
-import functools
 import json
 import os
-import time
+import sys
 
 import numpy as np
+
+# runnable as a standalone script from anywhere in the repo
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepspeed_tpu.utils.jax_env import honor_jax_platforms
+
+honor_jax_platforms()
 
 
 def attention_flops(B, S, H, D, causal=True):
@@ -28,16 +34,20 @@ def attention_flops(B, S, H, D, causal=True):
     return f / 2 if causal else f
 
 
-def time_fn(fn, *args, iters=20):
-    import jax
+def time_fwd(fn, q, k, v, iters=20):
+    """Chained-scan timing (see device_timing.py): q rides the carry so the
+    attention call is neither loop-invariant nor un-barriered."""
+    from benchmarks.device_timing import chained_ms
 
-    out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters
+    return chained_ms(lambda c: (fn(*c), c[1], c[2]), (q, k, v), iters) / 1e3
+
+
+def time_fwdbwd(grad_fn, q, k, v, iters=10):
+    """(dq,dk,dv) feed the next iteration's (q,k,v): every grad output is
+    live, so neither XLA DCE nor loop hoisting can skip work."""
+    from benchmarks.device_timing import chained_ms
+
+    return chained_ms(lambda c: grad_fn(*c), (q, k, v), iters) / 1e3
 
 
 def main():
@@ -97,11 +107,11 @@ def main():
         for name in impls:
             row = {"shape": f"{B}x{S}x{H}x{D}", "impl": name}
             try:
-                dt = time_fn(impls[name], *args[name])
+                dt = time_fwd(impls[name], *args[name])
                 row["fwd_ms"] = round(dt * 1e3, 3)
                 row["fwd_tflops"] = round(flops / dt / 1e12, 1)
                 if not fwd_only:
-                    dtg = time_fn(grads[name], *args[name], iters=10)
+                    dtg = time_fwdbwd(grads[name], *args[name])
                     row["fwdbwd_ms"] = round(dtg * 1e3, 3)
                     # bwd ≈ 2.5x fwd attention flops
                     row["fwdbwd_tflops"] = round(3.5 * flops / dtg / 1e12, 1)

@@ -11,12 +11,11 @@ shows achieved GB/s against the chip's peak. Result is printed as one JSON
 line; paste the winner + number into RESULTS below when re-run on new
 hardware.
 
-RESULTS: not yet captured on hardware — every TPU window since round 2 was
-lost to the wedged tunnel (see ROUND3_NOTES.md / .tpu_probe.log). Both paths
-are bandwidth-bound in theory; optax remains the default
-(optimizers.py build_optimizer) until a chip run shows the Pallas kernel a
-material edge. When the backend is reachable, run this script and replace
-this paragraph with the JSON line it prints.
+RESULTS: the first round-4 capture (independent repeated calls timed with
+``block_until_ready``) reported ~270 TB/s — the relay does not honor the
+block as an execution barrier, so those numbers were discarded and the
+timing switched to the chained-scan pattern (benchmarks/device_timing.py).
+Re-run on hardware to fill this line with trustworthy ms/GB-s numbers.
 """
 
 from __future__ import annotations
@@ -24,7 +23,6 @@ from __future__ import annotations
 import json
 import os
 import sys
-import time
 
 import jax
 
@@ -39,16 +37,6 @@ import jax.numpy as jnp
 import optax
 
 from deepspeed_tpu.ops.fused_adam import fused_adamw_flat
-
-
-def bench(fn, args, iters=20):
-    out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters
 
 
 def main():
@@ -66,20 +54,23 @@ def main():
     tx = optax.adamw(1e-3, weight_decay=0.01)
     state = tx.init(p)
 
-    @jax.jit
-    def optax_step(p, g, state):
+    from benchmarks.device_timing import chained_ms
+
+    def optax_step(c):
+        p, state = c
         u, s2 = tx.update(g, state, p)
         return optax.apply_updates(p, u), s2
 
-    @jax.jit
-    def pallas_step(p, g, m, v):
+    def pallas_step(c):
+        p, m, v = c
         return fused_adamw_flat(
             p, g, m, v, jnp.int32(1), 1e-3, weight_decay=0.01,
             interpret=not on_tpu,
         )
 
-    t_optax = bench(optax_step, (p, g, state))
-    t_pallas = bench(pallas_step, (p, g, m, v))
+    iters = 20 if on_tpu else 2
+    t_optax = chained_ms(optax_step, (p, state), iters) / 1e3
+    t_pallas = chained_ms(pallas_step, (p, m, v), iters) / 1e3
     traffic = 28.0 * n  # r(p,g,m,v fp32) + w(p,m,v fp32)
     result = {
         "metric": "fused_adam ms @ %dM params" % (n // 1e6),

@@ -98,14 +98,29 @@ def _bert_bench():
     rs = np.random.RandomState(0)
     batch = {"input_ids": rs.randint(0, cfg.vocab_size, size=(B, S)).astype(np.int32)}
 
+    import jax.numpy as jnp
+
+    from benchmarks.device_timing import chained_ms
+
     out = eng.forward(batch)  # compile + warm
     jax.block_until_ready(out)
     iters = 20 if on_tpu else 3
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = eng.forward(batch)
-    jax.block_until_ready(out)
-    dt = (time.perf_counter() - t0) / iters
+
+    # chained-scan timing: independent repeat calls under the axon relay can
+    # report sub-ms "batches" (see device_timing.py). The ids ride the carry
+    # through a runtime-dependent no-op roll so the forward is neither
+    # loop-invariant (hoistable) nor dead — every iteration must execute.
+    def step(c):
+        ids, acc = c
+        s = sum(
+            jnp.sum(l).astype(jnp.float32)
+            for l in jax.tree.leaves(eng.forward({"input_ids": ids}))
+        )
+        shift = (s > jnp.float32(3e38)).astype(jnp.int32)  # always 0 at runtime
+        return jnp.roll(ids, shift, axis=0), acc + s
+
+    ids0 = jnp.asarray(batch["input_ids"])
+    dt = chained_ms(step, (ids0, jnp.float32(0.0)), iters) / 1e3
 
     print(json.dumps({
         "metric": f"encoder seq/sec {name} b{B} seq{S}",

@@ -318,8 +318,19 @@ class DeepSpeedEngine:
         else:
             params = jax.tree.map(jax.device_put, params, self.param_shardings)
 
+        # the offload tier never holds optimizer state on device — initializing
+        # Adam moments here just to discard them would OOM the chip for
+        # exactly the models offload exists for (fp32 m+v alone exceed HBM on
+        # gpt2-xl; seen as a ResourceExhausted in the r4 offload bench).
+        # offload_enabled is decided HERE, once, and reused by the tier setup
+        # below.
+        self.offload_enabled = (
+            zcfg.offload_optimizer.device in ("cpu", "nvme") and not self.onebit
+        )
         if self.onebit:
             opt_state, self.opt_shardings = self._init_onebit_opt_state(params)
+        elif self.offload_enabled:
+            opt_state, self.opt_shardings = (), ()
         else:
             abstract_opt = jax.eval_shape(self.optimizer.init, abstract_params)
             self.opt_shardings = self.policy.opt_state_shardings(abstract_opt, abstract_params, model.logical_axes)
@@ -350,8 +361,8 @@ class DeepSpeedEngine:
         self.train_batch_size_value = config.train_batch_size
 
         # --- ZeRO-Offload / Infinity host optimizer tier
+        # (offload_enabled was decided above, before the opt-state init)
         off = zcfg.offload_optimizer
-        self.offload_enabled = off.device in ("cpu", "nvme") and not self.onebit
         self._offload = None
         if self.offload_enabled:
             from .offload.offload_engine import HostOffloadOptimizer
@@ -370,12 +381,11 @@ class DeepSpeedEngine:
                 aio_config=config.aio,
             )
             # device keeps only the compute-dtype copy; the fp32 master +
-            # moments live host-side (HBM cost drops from 16 to 2 B/param)
+            # moments live host-side (HBM cost drops from 16 to 2 B/param;
+            # opt_state is already () — never initialized on this tier)
             self.state = self.state._replace(
                 params=_cast_params(self.state.params, self.compute_dtype),
-                opt_state=(),
             )
-            self.state_shardings = self.state_shardings._replace(opt_state=())
 
         # --- compiled steps
         donate = (0,) if config.tpu.donate_state else ()
