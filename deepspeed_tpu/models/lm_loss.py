@@ -41,30 +41,56 @@ def chunked_token_loss(project, h, batch, ce_chunk: int):
     positions: per chunk, ``project`` maps [..., E] hidden states to
     [..., V] logits (tied-embedding matmul or a separate lm head) and the
     chunk reduces to a scalar nll sum. Peak logits memory drops from
-    [B,S,V] to [B,C,V]. Numerically identical to :func:`token_loss`."""
-    labels_all, mask = shift_labels_mask(batch)
-    h = h[:, :-1]
-    B, S1, E = h.shape
+    [B,S,V] to [B,C,V]. Numerically identical to :func:`token_loss`.
+
+    Data-movement design (r4 xplane profile: the old transpose-then-scan
+    shape put ~44% of device time into copy/layout ops): ``h`` is consumed
+    UNSLICED — the final position is excluded by a zero mask column rather
+    than an ``h[:, :-1]`` slice (a full [B,S-1,E] copy on TPU) — and chunks
+    are taken as static S-slices XLA can fuse into the projection matmul's
+    operand read, instead of transposing all hiddens to [nc,B,C,E] and
+    paying the scan's per-iteration gathers. Sequences longer than 32
+    chunks fall back to a dynamic-slice scan (bounded program size), still
+    layout-preserving."""
+    labels_all, mask = shift_labels_mask(batch)  # [B,S-1]
+    S = h.shape[1]
+    # pad labels/mask back to S columns (mask 0 at the final position) so h
+    # itself never needs the [:, :-1] slice; the masked position's logits
+    # cost one extra row of matmul and contribute exactly 0 to the nll
+    labels_all = jnp.pad(labels_all, ((0, 0), (0, 1)))
+    mask = jnp.pad(mask, ((0, 0), (0, 1)))
     C = int(ce_chunk)
-    pad = (-S1) % C
-    if pad:
-        h = jnp.pad(h, ((0, 0), (0, pad), (0, 0)))
-        labels_all = jnp.pad(labels_all, ((0, 0), (0, pad)))
-        mask = jnp.pad(mask, ((0, 0), (0, pad)))
-    n_chunks = h.shape[1] // C
-    h_c = h.reshape(B, n_chunks, C, E).transpose(1, 0, 2, 3)  # [nc,B,C,E]
-    lab_c = labels_all.reshape(B, n_chunks, C).transpose(1, 0, 2)
-    mask_c = mask.reshape(B, n_chunks, C).transpose(1, 0, 2)
 
     @jax.checkpoint
-    def chunk_nll(carry, xs):
-        hc, lc, mc = xs
+    def chunk_nll(hc, lc, mc):
         logits = project(hc).astype(jnp.float32)  # [B,C,V]
         logz = jax.nn.logsumexp(logits, axis=-1)
         gold = jnp.take_along_axis(logits, lc[..., None], axis=-1)[..., 0]
-        return carry + jnp.sum((logz - gold) * mc), None
+        return jnp.sum((logz - gold) * mc)
 
-    total, _ = lax.scan(chunk_nll, jnp.float32(0.0), (h_c, lab_c, mask_c))
+    n_chunks = -(-S // C)
+    if n_chunks <= 32:
+        total = jnp.float32(0.0)
+        for i in range(n_chunks):
+            sl = slice(i * C, min((i + 1) * C, S))
+            total = total + chunk_nll(h[:, sl], labels_all[:, sl], mask[:, sl])
+    else:
+        pad = (-S) % C
+        hp, lp, mp = h, labels_all, mask
+        if pad:
+            hp = jnp.pad(hp, ((0, 0), (0, pad), (0, 0)))
+            lp = jnp.pad(lp, ((0, 0), (0, pad)))
+            mp = jnp.pad(mp, ((0, 0), (0, pad)))
+
+        def body(carry, i):
+            hc = lax.dynamic_slice_in_dim(hp, i * C, C, axis=1)
+            lc = lax.dynamic_slice_in_dim(lp, i * C, C, axis=1)
+            mc = lax.dynamic_slice_in_dim(mp, i * C, C, axis=1)
+            return carry + chunk_nll(hc, lc, mc), None
+
+        total, _ = lax.scan(
+            body, jnp.float32(0.0), jnp.arange(hp.shape[1] // C)
+        )
     ntokens = jnp.sum(mask)
     return total / jnp.maximum(ntokens, 1.0), ntokens
 

@@ -127,6 +127,25 @@ class TestChunkedCE:
         for gf, gc in zip(jax.tree.leaves(g_full), jax.tree.leaves(g_chunk)):
             np.testing.assert_allclose(np.asarray(gf), np.asarray(gc), atol=1e-5, rtol=1e-4)
 
+    def test_long_sequence_scan_path_matches(self):
+        """> 32 chunks takes the dynamic-slice lax.scan branch (bounded
+        program size for long sequences); loss + grads stay exact."""
+        from deepspeed_tpu.models import lm_loss
+
+        rs = np.random.RandomState(1)
+        B, S, E, V = 2, 71, 8, 33  # 36 chunks, pad=1: scan branch + its pad path
+        h = jnp.asarray(rs.randn(B, S, E), jnp.float32)
+        W = jnp.asarray(rs.randn(V, E), jnp.float32) * 0.1
+        batch = {"input_ids": jnp.asarray(rs.randint(0, V, (B, S)), jnp.int32)}
+        proj = lambda x: x @ W.T
+        l_full, nt = lm_loss.token_loss(proj(h), batch)
+        l_scan, nt2 = lm_loss.chunked_token_loss(proj, h, batch, 2)  # 35 chunks
+        np.testing.assert_allclose(float(l_full), float(l_scan), rtol=1e-6)
+        assert float(nt) == float(nt2)
+        g1 = jax.grad(lambda h: lm_loss.token_loss(proj(h), batch)[0])(h)
+        g2 = jax.grad(lambda h: lm_loss.chunked_token_loss(proj, h, batch, 2)[0])(h)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=1e-5, rtol=1e-4)
+
     def test_trains_under_engine(self, mesh_dp8):
         from deepspeed_tpu.models import gpt2
         from deepspeed_tpu.runtime.config import DeepSpeedConfig
