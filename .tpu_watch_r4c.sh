@@ -68,6 +68,11 @@ while true; do
     run_step bench_micro64 1800 env BENCH_MICRO=64 python bench.py || continue
     # headline with the measured-best tuned config (what the driver will run)
     run_step bench_final 2400 python bench.py || continue
+    # alignment probe: decides whether a padded-vocab feature is worth it
+    run_step vocab_probe 1200 python benchmarks/vocab_pad_probe.py || continue
+    # fresh profile of the TUNED config with the restructured chunked CE
+    run_step bench_profile2 2400 env BENCH_PROFILE=.prof_r4b python bench.py || continue
+    run_step profile_attr2 300 python benchmarks/profile_attr.py .prof_r4b || continue
     timeout 300 python benchmarks/collect_r4.py >> .tpu_watch_r4.log 2>&1
     log "phase2 queue complete"
     break
