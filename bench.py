@@ -122,6 +122,9 @@ def build_engine(model_name: str, seq: int, micro: int, n_dev: int, zero_stage: 
     # rematerialized) frees ~GBs of HBM for batch/model size
     cfg = gpt2.get_config(
         model_name, n_positions=seq, remat=remat,
+        # Megatron-style vocab padding: BENCH_PAD_VOCAB=128 aligns the head
+        # matmul's vocab dim to MXU lanes (logical vocab unchanged)
+        pad_vocab_multiple=int(os.environ.get("BENCH_PAD_VOCAB", "1")),
         # 0 = classic full-logits CE (no backward logits recompute; only
         # fits small micro batches), default 256-position chunks
         ce_chunk=int(os.environ.get("BENCH_CE_CHUNK", "256")) if ce_chunk is None else int(ce_chunk),

@@ -91,6 +91,20 @@ class GPT2Config:
     moe_use_rts: bool = True  # Random Token Selection on capacity overflow
     moe_second_policy: str = "random"  # top-2 second expert: random | argmax
 
+    # Megatron-style vocab padding (make-vocab-size-divisible-by): pad the
+    # embedding table to a multiple of this so every head matmul runs on an
+    # MXU-lane-aligned vocab dim (GPT-2's 50257 is not 128-divisible).
+    # vocab_size stays the LOGICAL vocab everywhere — ids, labels, analytic
+    # FLOPs; only the wte array and logits carry padded_vocab_size columns,
+    # which the loss and sampling paths mask to -inf (pad rows are
+    # zero-initialized and receive exactly zero gradient). 1 = off.
+    pad_vocab_multiple: int = 1
+
+    @property
+    def padded_vocab_size(self) -> int:
+        m = max(1, int(self.pad_vocab_multiple))
+        return -(-self.vocab_size // m) * m
+
     @property
     def head_dim(self) -> int:
         return self.n_embd // self.n_head
@@ -144,8 +158,12 @@ def init_params(cfg: GPT2Config, rng) -> PyTree:
     def normal(key, shape, s):
         return (jax.random.normal(key, shape) * s).astype(dt)
 
+    Vp = cfg.padded_vocab_size
+    wte = normal(next(k), (Vp, E), std)
+    if Vp > V:  # pad rows exactly zero: masked out of loss/sampling, zero grad
+        wte = wte.at[V:].set(0)
     params = {
-        "wte": normal(next(k), (V, E), std),
+        "wte": wte,
         "wpe": normal(next(k), (P, E), std),
         "ln_f": {"scale": jnp.ones((E,), dt), "bias": jnp.zeros((E,), dt)},
         "blocks": {
@@ -456,7 +474,10 @@ def forward_with_aux(
     h, aux_total = hidden_with_aux(
         cfg, params, input_ids, train=train, rng=rng, pld_theta=pld_theta
     )
-    logits = h @ params["wte"].T  # tied embeddings
+    # tied embeddings; the public contract is [B,S,V] LOGICAL vocab — slice
+    # off padded head columns (pad_vocab_multiple) rather than masking, so
+    # shape-checking consumers (one_hot sizing, tokenizer tables) stay right
+    logits = (h @ params["wte"].T)[..., : cfg.vocab_size]
     return logits, aux_total
 
 
@@ -493,7 +514,9 @@ def _head_token_loss(cfg: GPT2Config, wte, h, batch):
     the knob works everywhere). Math lives in models/lm_loss.py."""
     from .lm_loss import head_token_loss
 
-    return head_token_loss(lambda x: x @ wte.T, h, batch, cfg.ce_chunk)
+    return head_token_loss(
+        lambda x: x @ wte.T, h, batch, cfg.ce_chunk, logical_vocab=cfg.vocab_size
+    )
 
 
 def pipeline_lm_loss(cfg: GPT2Config, params: PyTree, batch_micro, rng, train: bool, mesh):
@@ -642,7 +665,8 @@ def forward_cached(
 
     h, (new_k, new_v) = lax.scan(body, h, (params["blocks"], cache.k, cache.v))
     h = _layer_norm(h[:, -1], params["ln_f"]["scale"], params["ln_f"]["bias"], eps)
-    logits = h @ params["wte"].T  # [B, V]
+    # [B, V] logical vocab: padded head columns sliced off (see forward_with_aux)
+    logits = (h @ params["wte"].T)[..., : cfg.vocab_size]
     return logits, KVCache(k=new_k, v=new_v, pos=pos + S)
 
 
@@ -716,8 +740,11 @@ def make_block_api(cfg: GPT2Config):
 
     def init_persistent(rng):
         k1, k2 = jax.random.split(rng)
+        wte = (jax.random.normal(k1, (cfg.padded_vocab_size, E)) * std).astype(dt)
+        if cfg.padded_vocab_size > V:
+            wte = wte.at[V:].set(0)
         return {
-            "wte": (jax.random.normal(k1, (V, E)) * std).astype(dt),
+            "wte": wte,
             "wpe": (jax.random.normal(k2, (P, E)) * std).astype(dt),
             "ln_f": {"scale": jnp.ones((E,), dt), "bias": jnp.zeros((E,), dt)},
         }

@@ -26,17 +26,30 @@ def shift_labels_mask(batch):
     return jnp.maximum(labels, 0), mask
 
 
-def token_loss(logits_full, batch):
+def mask_pad_vocab(logits, logical_vocab):
+    """-inf the padded vocab columns (cols >= ``logical_vocab``) so a
+    Megatron-style padded embedding (models/gpt2.py pad_vocab_multiple)
+    contributes nothing to softmax/sampling and its rows get zero grad.
+    No-op when the logits are unpadded or ``logical_vocab`` is None."""
+    V = logits.shape[-1]
+    if logical_vocab is None or V == int(logical_vocab):
+        return logits
+    col = lax.broadcasted_iota(jnp.int32, logits.shape, logits.ndim - 1)
+    return jnp.where(col < int(logical_vocab), logits, jnp.asarray(-1e30, logits.dtype))
+
+
+def token_loss(logits_full, batch, logical_vocab=None):
     """Shifted CE given full logits [B,S,V]. Returns (mean nll, ntokens)."""
     logits = logits_full[:, :-1]
     labels, mask = shift_labels_mask(batch)
-    logz = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
-    gold = jnp.take_along_axis(logits.astype(jnp.float32), labels[..., None], axis=-1)[..., 0]
+    lf = mask_pad_vocab(logits.astype(jnp.float32), logical_vocab)
+    logz = jax.nn.logsumexp(lf, axis=-1)
+    gold = jnp.take_along_axis(lf, labels[..., None], axis=-1)[..., 0]
     nll = (logz - gold) * mask
     return jnp.sum(nll) / jnp.maximum(jnp.sum(mask), 1.0), jnp.sum(mask)
 
 
-def chunked_token_loss(project, h, batch, ce_chunk: int):
+def chunked_token_loss(project, h, batch, ce_chunk: int, logical_vocab=None):
     """Shifted CE from final hidden states in sequence chunks of ``ce_chunk``
     positions: per chunk, ``project`` maps [..., E] hidden states to
     [..., V] logits (tied-embedding matmul or a separate lm head) and the
@@ -63,7 +76,7 @@ def chunked_token_loss(project, h, batch, ce_chunk: int):
 
     @jax.checkpoint
     def chunk_nll(hc, lc, mc):
-        logits = project(hc).astype(jnp.float32)  # [B,C,V]
+        logits = mask_pad_vocab(project(hc).astype(jnp.float32), logical_vocab)
         logz = jax.nn.logsumexp(logits, axis=-1)
         gold = jnp.take_along_axis(logits, lc[..., None], axis=-1)[..., 0]
         return jnp.sum((logz - gold) * mc)
@@ -95,9 +108,11 @@ def chunked_token_loss(project, h, batch, ce_chunk: int):
     return total / jnp.maximum(ntokens, 1.0), ntokens
 
 
-def head_token_loss(project, h, batch, ce_chunk: int = 0):
+def head_token_loss(project, h, batch, ce_chunk: int = 0, logical_vocab=None):
     """Head projection + shifted CE from final hidden states; chunked when
-    ``ce_chunk`` > 0. ``project``: [..., E] -> [..., V]."""
+    ``ce_chunk`` > 0. ``project``: [..., E] -> [..., V]. ``logical_vocab``
+    masks padded vocab columns when the head is wider than the vocabulary
+    (see :func:`mask_pad_vocab`)."""
     if ce_chunk > 0:
-        return chunked_token_loss(project, h, batch, ce_chunk)
-    return token_loss(project(h), batch)
+        return chunked_token_loss(project, h, batch, ce_chunk, logical_vocab)
+    return token_loss(project(h), batch, logical_vocab)

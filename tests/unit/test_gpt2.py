@@ -127,6 +127,46 @@ class TestChunkedCE:
         for gf, gc in zip(jax.tree.leaves(g_full), jax.tree.leaves(g_chunk)):
             np.testing.assert_allclose(np.asarray(gf), np.asarray(gc), atol=1e-5, rtol=1e-4)
 
+    def test_padded_vocab_matches_unpadded(self):
+        """pad_vocab_multiple (Megatron make-vocab-size-divisible-by analog):
+        same loss/grads as the unpadded model, zero grad on pad rows, and
+        identical greedy generation — full-logits AND chunked CE."""
+        from deepspeed_tpu.models import gpt2
+
+        cfg_u = gpt2.get_config("gpt2-tiny", vocab_size=509)
+        params = gpt2.init_params(cfg_u, jax.random.PRNGKey(0))
+        rs = np.random.RandomState(3)
+        ids = rs.randint(0, 509, (2, 64)).astype(np.int32)
+        batch = {"input_ids": ids}
+
+        for chunk in (0, 48):
+            cfg_p = gpt2.get_config(
+                "gpt2-tiny", vocab_size=509, pad_vocab_multiple=128, ce_chunk=chunk
+            )
+            cfg_uc = gpt2.get_config("gpt2-tiny", vocab_size=509, ce_chunk=chunk)
+            assert cfg_p.padded_vocab_size == 512
+            params_p = dict(params)
+            params_p["wte"] = jnp.pad(params["wte"], ((0, 3), (0, 0)))
+
+            def loss(cfg, p):
+                return gpt2.lm_loss(cfg, p, batch, None, True)[0]
+
+            l_u, g_u = jax.value_and_grad(loss, argnums=1)(cfg_uc, params)
+            l_p, g_p = jax.value_and_grad(loss, argnums=1)(cfg_p, params_p)
+            np.testing.assert_allclose(float(l_u), float(l_p), rtol=1e-6)
+            np.testing.assert_allclose(
+                np.asarray(g_p["wte"])[:509], np.asarray(g_u["wte"]), atol=1e-6
+            )
+            assert np.all(np.asarray(g_p["wte"])[509:] == 0.0)
+
+        out_u = gpt2.generate(cfg_u, params, jnp.asarray(ids[:, :8]), 6)
+        out_p = gpt2.generate(
+            gpt2.get_config("gpt2-tiny", vocab_size=509, pad_vocab_multiple=128),
+            {**params, "wte": jnp.pad(params["wte"], ((0, 3), (0, 0)))},
+            jnp.asarray(ids[:, :8]), 6,
+        )
+        np.testing.assert_array_equal(np.asarray(out_u), np.asarray(out_p))
+
     def test_long_sequence_scan_path_matches(self):
         """> 32 chunks takes the dynamic-slice lax.scan branch (bounded
         program size for long sequences); loss + grads stay exact."""
