@@ -46,14 +46,18 @@ grep -q '"metric"' .tpu_r4_infinity_bench.log 2>/dev/null && cp .tpu_r4_infinity
 while true; do
   if bash .tpu_probe.sh 90; then
     log "phase2: tunnel alive"
-    # perf rungs first (cheap, warm cache; decide the tuned headline config)
-    run_step bench_dots32 1800 env BENCH_MICRO=32 BENCH_REMAT=1 BENCH_REMAT_POLICY=dots python bench.py || continue
-    run_step bench_attn16 1800 env BENCH_MICRO=16 BENCH_REMAT=1 BENCH_REMAT_POLICY=attn python bench.py || continue
+    # FIRST: the tuned config on the CURRENT code (restructured chunked CE)
+    # at 20 steps — this is what the driver's round-end bench will run, so a
+    # regression here must surface before anything else burns window time
+    run_step bench_dots16_s20 2400 env BENCH_STEPS=20 python bench.py || continue
+    # CE chunk sweep on the new code + the padded-vocab A/B
     run_step bench_dots16_ce512 1800 env BENCH_MICRO=16 BENCH_REMAT=1 BENCH_REMAT_POLICY=dots BENCH_CE_CHUNK=512 python bench.py || continue
     run_step bench_dots16_ce1024 1800 env BENCH_MICRO=16 BENCH_REMAT=1 BENCH_REMAT_POLICY=dots BENCH_CE_CHUNK=1024 python bench.py || continue
+    run_step bench_pad128 1800 env BENCH_MICRO=16 BENCH_REMAT=1 BENCH_REMAT_POLICY=dots BENCH_PAD_VOCAB=128 python bench.py || continue
+    run_step vocab_probe 1200 python benchmarks/vocab_pad_probe.py || continue
+    run_step bench_dots32 1800 env BENCH_MICRO=32 BENCH_REMAT=1 BENCH_REMAT_POLICY=dots python bench.py || continue
+    run_step bench_attn16 1800 env BENCH_MICRO=16 BENCH_REMAT=1 BENCH_REMAT_POLICY=attn python bench.py || continue
     timeout 300 python benchmarks/collect_r4.py >> .tpu_watch_r4.log 2>&1
-    # confirm the collector's pick at lower variance (20 steps, tuned rung)
-    run_step bench_dots16_s20 2400 env BENCH_STEPS=20 python bench.py || continue
     # fixed measurements
     run_step tb_flashbwd2 2400 env DS_TPU_TESTS=1 python -m pytest \
       "tests/unit/ops/test_tpu_hardware.py::TestFlashAttentionHardware" -q --tb=long || continue
@@ -68,8 +72,6 @@ while true; do
     run_step bench_micro64 1800 env BENCH_MICRO=64 python bench.py || continue
     # headline with the measured-best tuned config (what the driver will run)
     run_step bench_final 2400 python bench.py || continue
-    # alignment probe: decides whether a padded-vocab feature is worth it
-    run_step vocab_probe 1200 python benchmarks/vocab_pad_probe.py || continue
     # fresh profile of the TUNED config with the restructured chunked CE
     run_step bench_profile2 2400 env BENCH_PROFILE=.prof_r4b python bench.py || continue
     run_step profile_attr2 300 python benchmarks/profile_attr.py .prof_r4b || continue

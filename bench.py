@@ -107,7 +107,7 @@ def fit_micros(name: str, seq: int, hbm_bytes: float, n_dev: int = 1,
 
 def build_engine(model_name: str, seq: int, micro: int, n_dev: int, zero_stage: int,
                  remat: bool = None, remat_policy: str = None, attn_impl: str = None,
-                 ce_chunk: int = None):
+                 ce_chunk: int = None, pad_vocab: int = None):
     from deepspeed_tpu.models import gpt2
     from deepspeed_tpu.parallel.topology import MeshSpec
     from deepspeed_tpu.runtime.config import DeepSpeedConfig
@@ -124,7 +124,10 @@ def build_engine(model_name: str, seq: int, micro: int, n_dev: int, zero_stage: 
         model_name, n_positions=seq, remat=remat,
         # Megatron-style vocab padding: BENCH_PAD_VOCAB=128 aligns the head
         # matmul's vocab dim to MXU lanes (logical vocab unchanged)
-        pad_vocab_multiple=int(os.environ.get("BENCH_PAD_VOCAB", "1")),
+        pad_vocab_multiple=(
+            int(os.environ.get("BENCH_PAD_VOCAB", "1")) if pad_vocab is None
+            else int(pad_vocab)
+        ),
         # 0 = classic full-logits CE (no backward logits recompute; only
         # fits small micro batches), default 256-position chunks
         ce_chunk=int(os.environ.get("BENCH_CE_CHUNK", "256")) if ce_chunk is None else int(ce_chunk),
@@ -349,7 +352,8 @@ def main():
     if (on_tpu and auto_micro and remat_env is None
             and "BENCH_MODEL" not in os.environ
             and "BENCH_REMAT_POLICY" not in os.environ
-            and "BENCH_CE_CHUNK" not in os.environ):
+            and "BENCH_CE_CHUNK" not in os.environ
+            and "BENCH_PAD_VOCAB" not in os.environ):
         try:
             with open(tuned_path) as f:
                 t = json.load(f)
@@ -357,14 +361,16 @@ def main():
             # auto ladder instead of aborting the benchmark. The tuned config
             # only applies at the seq it was measured at.
             if int(t.get("seq", seq)) == seq:
-                # rung layout: (model, remat, micro, policy, attn, ce_chunk).
-                # ce_chunk rides the RUNG, not the environment: a tuned
-                # non-default chunking must not leak into the OOM-fallback
-                # ladder (a tuned ce_chunk=0 would make every fallback rung
-                # full-logits too — the most OOM-prone setting)
+                # rung layout: (model, remat, micro, policy, attn, ce_chunk,
+                # pad_vocab). Model-config knobs ride the RUNG, not the
+                # environment: a tuned non-default value must not leak into
+                # the OOM-fallback ladder (e.g. a tuned ce_chunk=0 would make
+                # every fallback rung full-logits — the most OOM-prone
+                # setting)
                 tuned = (str(t["model"]), bool(t.get("remat", True)),
                          int(t["micro_batch"]), str(t.get("remat_policy", "full")),
-                         None, int(t["ce_chunk"]) if "ce_chunk" in t else None)
+                         None, int(t["ce_chunk"]) if "ce_chunk" in t else None,
+                         int(t["pad_vocab"]) if "pad_vocab" in t else None)
         except Exception:
             tuned = None
     if tuned:
@@ -376,7 +382,8 @@ def main():
         remat = r[1] if r[1] is not None else r[0] in ("gpt2-large", "gpt2-xl")
         policy = (r[3] if len(r) > 3 else None) or "full"
         ce = r[5] if len(r) > 5 and r[5] is not None else int(os.environ.get("BENCH_CE_CHUNK", "256"))
-        return (r[0], bool(remat), r[2], policy, ce)
+        pad = r[6] if len(r) > 6 and r[6] is not None else int(os.environ.get("BENCH_PAD_VOCAB", "1"))
+        return (r[0], bool(remat), r[2], policy, ce, pad)
 
     def _push(rung):
         # a failed tuned rung must not make the auto ladder recompile the
@@ -415,6 +422,7 @@ def main():
         policy = rung[3] if len(rung) > 3 else None
         attn = rung[4] if len(rung) > 4 else None
         rung_ce = rung[5] if len(rung) > 5 else None
+        rung_pad = rung[6] if len(rung) > 6 else None
         if remat_pin is not None:
             remat = remat_pin
         try:
@@ -424,7 +432,8 @@ def main():
             disarm_watchdog = _arm_inproc_watchdog(attempts)
             cfg, engine = build_engine(name, seq, mb, n_dev, zero_stage,
                                        remat=remat, remat_policy=policy,
-                                       attn_impl=attn, ce_chunk=rung_ce)
+                                       attn_impl=attn, ce_chunk=rung_ce,
+                                       pad_vocab=rung_pad)
             rs = np.random.RandomState(0)
             batch = {
                 "input_ids": rs.randint(
@@ -582,6 +591,7 @@ def main():
         "remat": bool(cfg.remat),
         "remat_policy": cfg.remat_policy if cfg.remat else None,
         "ce_chunk": int(cfg.ce_chunk),
+        "pad_vocab": int(cfg.pad_vocab_multiple),
         "micro_batch": micro,
         "xl_equiv_tokens_per_sec_chip": round(xl_equiv_tok_per_sec_chip, 1),
         "loss_first_to_last": [round(first_loss, 4), round(last_loss, 4)],

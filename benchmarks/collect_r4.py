@@ -28,6 +28,7 @@ HEADLINE_STEPS = {
     # phase-2 rungs (.tpu_watch_r4c.sh)
     "bench_dots32", "bench_attn16", "bench_dots16_ce512",
     "bench_dots16_ce1024", "bench_dots16_s20", "bench_final",
+    "bench_pad128", "bench_profile2",
     # seeded session-1 captures: keep them in the max so a weaker later rung
     # can never downgrade BENCH_TUNED below the best committed number
     "bench_capture_session1_micro32", "bench1_oldkernels_f32dots",
@@ -99,6 +100,8 @@ def main():
         }
         if "ce_chunk" in j:
             tuned["ce_chunk"] = int(j["ce_chunk"])
+        if j.get("pad_vocab", 1) != 1:
+            tuned["pad_vocab"] = int(j["pad_vocab"])
         with open(os.path.join(ROOT, "BENCH_TUNED.json"), "w") as f:
             json.dump(tuned, f, indent=1)
         print(f"BENCH_TUNED.json <- {step}: vs_baseline={j['vs_baseline']} "
