@@ -49,7 +49,7 @@ while true; do
     # FIRST: the tuned config on the CURRENT code (restructured chunked CE)
     # at 20 steps — this is what the driver's round-end bench will run, so a
     # regression here must surface before anything else burns window time
-    run_step bench_dots16_s20 2400 env BENCH_STEPS=20 python bench.py || continue
+    run_step bench_tuned20 2400 env BENCH_STEPS=20 python bench.py || continue
     # CE chunk sweep on the new code + the padded-vocab A/B
     run_step bench_dots16_ce512 1800 env BENCH_MICRO=16 BENCH_REMAT=1 BENCH_REMAT_POLICY=dots BENCH_CE_CHUNK=512 python bench.py || continue
     run_step bench_dots16_ce1024 1800 env BENCH_MICRO=16 BENCH_REMAT=1 BENCH_REMAT_POLICY=dots BENCH_CE_CHUNK=1024 python bench.py || continue

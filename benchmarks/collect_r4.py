@@ -27,7 +27,7 @@ HEADLINE_STEPS = {
     "bench_attn32", "bench_dots8", "bench_ce0_8", "bench_profile",
     # phase-2 rungs (.tpu_watch_r4c.sh)
     "bench_dots32", "bench_attn16", "bench_dots16_ce512",
-    "bench_dots16_ce1024", "bench_dots16_s20", "bench_final",
+    "bench_dots16_ce1024", "bench_tuned20", "bench_final",
     "bench_pad128", "bench_profile2",
     # seeded session-1 captures: keep them in the max so a weaker later rung
     # can never downgrade BENCH_TUNED below the best committed number

@@ -40,6 +40,39 @@ def categorize(name: str) -> str:
     return "other"
 
 
+def _self_times(events):
+    """Per-event SELF time via interval nesting on one trace line.
+
+    A ``while``/``call`` wrapper event spans its body ops, which appear as
+    separate events on the same line — attributing raw durations counts the
+    same nanoseconds twice (the r4 phase-1 attribution put the fwd scan's
+    whole 19.9% into "other" while ALSO counting its children). Sorting by
+    start time and keeping a nesting stack assigns every op only the time
+    not covered by its children. Yields (name, self_ns)."""
+    evs = sorted(
+        ((ev.start_ns, ev.end_ns, ev.name) for ev in events),
+        key=lambda t: (t[0], -t[1]),
+    )
+    stack = []  # [start, end, name, child_ns]
+
+    def _pop():
+        st = stack.pop()
+        yield_val = (st[2], max(0, (st[1] - st[0]) - st[3]))
+        if stack:
+            # only the overlap with the parent's span counts as its child
+            # time — a partially overlapping sibling (ends after the parent)
+            # must not erase the parent's exclusive head
+            stack[-1][3] += max(0, min(st[1], stack[-1][1]) - st[0])
+        return yield_val
+
+    for s, e, name in evs:
+        while stack and s >= stack[-1][1]:
+            yield _pop()
+        stack.append([s, e, name, 0])
+    while stack:
+        yield _pop()
+
+
 def main(path: str):
     from jax.profiler import ProfileData
 
@@ -67,20 +100,19 @@ def main(path: str):
             # double-count the same wall time
             if "step" in lname or "module" in lname:
                 continue
-            for ev in line.events:
-                dur = ev.duration_ns
-                name = ev.name
+            for name, self_ns in _self_times(line.events):
                 if name.startswith("$"):  # host python frames (CPU fallback)
                     continue
-                by_op[name] += dur
-                by_cat[categorize(name)] += dur
-                total_ps += dur
+                by_op[name] += self_ns
+                by_cat[categorize(name)] += self_ns
+                total_ps += self_ns
     if total_ps == 0:
         print(json.dumps({"error": "no events parsed", "planes": [p.name for p in pd.planes]}))
         return
     summary = {
         "xplane": os.path.basename(files[-1]),
         "total_device_ms": round(total_ps / 1e6, 3),
+        "attribution": "self-time (wrapper ops exclude their children)",
         "by_category_pct": {
             k: round(100.0 * v / total_ps, 1)
             for k, v in by_cat.most_common()
