@@ -55,6 +55,7 @@ def main():
     import jax.numpy as jnp
 
     from deepspeed_tpu.ops.attention import causal_attention_jnp
+    from deepspeed_tpu.ops.pallas import flash_attention as fa_mod
     from deepspeed_tpu.ops.pallas.flash_attention import _flash, _flash_grid, flash_attention
 
     shapes_env = os.environ.get("BENCH_SHAPES")
@@ -115,6 +116,28 @@ def main():
                     row["fwdbwd_ms"] = round(dtg * 1e3, 3)
                     # bwd ≈ 2.5x fwd attention flops
                     row["fwdbwd_tflops"] = round(3.5 * flops / dtg / 1e12, 1)
+                    if name == "pallas-auto" and fa_mod._fused_bwd_ok(S, D):
+                        # A/B the fused single-pass backward against the
+                        # split dq/dkv kernels. BOTH sides get a freshly
+                        # built, unjitted-core grad fn: the prebuilt
+                        # grads[name] was already traced with the fused
+                        # dispatch baked in, so flipping the flag would
+                        # re-time the fused kernel (cached jaxpr), not the
+                        # split one.
+                        def fresh_grad():
+                            loss = lambda q, k, v: jnp.sum(
+                                flash_attention(q, k, v).astype(jnp.float32) ** 2
+                            )
+                            return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+                        fa_mod._FUSED_BWD_ENABLED = False
+                        try:
+                            dts = time_fwdbwd(fresh_grad(), *args[name])
+                            row["fwdbwd_ms_splitbwd"] = round(dts * 1e3, 3)
+                        finally:
+                            fa_mod._FUSED_BWD_ENABLED = True
+                        dtf = time_fwdbwd(fresh_grad(), *args[name])
+                        row["fwdbwd_ms_fusedbwd"] = round(dtf * 1e3, 3)
             except Exception as e:
                 row["error"] = f"{type(e).__name__}: {str(e)[:120]}"
             results.append(row)

@@ -25,8 +25,15 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-BQ = 128
-BK = 128
+# Q/K block sizes, MXU-tile aligned. Env-tunable (read once at import) so
+# the hardware sweep can A/B larger blocks — at D=64 the per-block dots run
+# with a half-width MXU contraction, and bigger blocks amortize more of the
+# grid/DMA overhead per dot — without a code change. All kernels require
+# S % BQ == 0 and S % BK == 0 (flash_ok / windowed_flash_ok enforce).
+import os as _os
+
+BQ = int(_os.environ.get("DS_FLASH_BQ", "128"))
+BK = int(_os.environ.get("DS_FLASH_BK", "128"))
 NUM_LANES = 128  # lse/delta carry a broadcast 128-lane trailing dim (Mosaic
                  # requires >=(8,128)-tileable blocks; same layout as the
                  # official jax TPU flash kernel)
@@ -118,6 +125,36 @@ def _dkv_block(q, k, v, do, lse, delta, qi, ki, causal: bool, sm_scale, window=N
         preferred_element_type=jnp.float32,
     )
     return dk, dv
+
+
+def _joint_bwd_block(q, k, v, do, lse, delta, qi, ki, causal: bool, sm_scale, window=None):
+    """One (q,k) block pair's contributions to (dq, dk, dv) from a SINGLE
+    recompute of s/p/dp/ds — the fused-backward building block. The split
+    dq/dkv kernels each recompute QK^T, exp, dp and ds for every pair; this
+    shares them (7 MXU dots -> 5 per pair, softmax VPU work halved).
+    dq/dk returned unscaled (caller applies sm_scale once)."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * sm_scale
+    if causal:
+        s = _causal_mask(s, qi, ki, window)
+    p = jnp.exp(s - lse)  # [BQ, BK] f32
+    dv = jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = p * (dp - delta)
+    ds_c = ds.astype(q.dtype)
+    dq = jax.lax.dot_general(
+        ds_c, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    dk = jax.lax.dot_general(
+        ds_c, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    return dq, dk, dv
 
 
 def _causal_hi(qi, num_k_blocks):
@@ -283,6 +320,107 @@ def _bwd_dkv_kernel(win_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
+# Fused-backward VMEM budget per element of [S,D]: K + V (bf16, resident,
+# 2+2 B) + whole-sequence dk/dv f32 scratch (4+4 B) + the revisited dk/dv
+# output blocks (2+2 B bf16 MHA; 4+4 B f32 when GQA stages per-q-head
+# grads) = 16 B (20 B GQA). 8 MB keeps the kernel comfortably inside VMEM
+# next to the per-block operands; larger resident shapes fall back to the
+# split dq/dkv kernels.
+FUSED_BWD_BYTES = 8 * 1024 * 1024
+_FUSED_BWD_ENABLED = _os.environ.get("DS_FLASH_FUSED_BWD", "1") != "0"
+
+
+def _fused_bwd_ok(S: int, D: int, kv_rep: int = 1) -> bool:
+    per_elem = 20 if kv_rep > 1 else 16
+    return _FUSED_BWD_ENABLED and S * D * per_elem <= FUSED_BWD_BYTES
+
+
+def _bwd_fused_kernel(win_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+                      *, sm_scale, causal, seq_len, num_q_blocks):
+    """dq + dk + dv in ONE pass over the (q,k) block pairs (resident shapes):
+    dk/dv accumulate in whole-sequence VMEM f32 scratch across the
+    sequential q-block grid dimension and are written once at the last q
+    step. Each pair's s/p/dp/ds are computed once (_joint_bwd_block) instead
+    of once per split kernel."""
+    qi = pl.program_id(1)
+    win = win_ref[0]
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    q = q_ref[0]
+    do = do_ref[0]
+    # load full lanes, slice the VALUE (width-1 lane ref slices are a
+    # Mosaic hazard — same pattern as the split kernels)
+    lse = lse_ref[0][:, 0:1]
+    delta = delta_ref[0][:, 0:1]
+    num_k_blocks = pl.cdiv(seq_len, BK)
+    hi = _causal_hi(qi, num_k_blocks) if causal else num_k_blocks
+    lo = _window_lo(qi, win) if causal else 0
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * BK, BK), :]
+        v = v_ref[0, pl.ds(j * BK, BK), :]
+        dqc, dkc, dvc = _joint_bwd_block(
+            q, k, v, do, lse, delta, qi, j, causal, sm_scale, win
+        )
+        dk_acc[pl.ds(j * BK, BK), :] = dk_acc[pl.ds(j * BK, BK), :] + dkc
+        dv_acc[pl.ds(j * BK, BK), :] = dv_acc[pl.ds(j * BK, BK), :] + dvc
+        return dq + dqc
+
+    dq = jax.lax.fori_loop(lo, hi, body, jnp.zeros((BQ, q_ref.shape[-1]), jnp.float32))
+    dq_ref[0] = (dq * sm_scale).astype(dq_ref.dtype)
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _finalize():
+        dk_ref[0] = (dk_acc[...] * sm_scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _bwd_fused(q3, k3, v3, delta, lse, do3, sm_scale, causal, interpret, kv_rep, win):
+    BH, S, D = q3.shape
+    nq = S // BQ
+    kv_idx = lambda b, i, w: (b // kv_rep, 0, 0)
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_fused_kernel, sm_scale=sm_scale, causal=causal,
+            seq_len=S, num_q_blocks=nq,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(BH, nq),
+            in_specs=[
+                pl.BlockSpec((1, BQ, D), lambda b, i, w: (b, i, 0)),
+                pl.BlockSpec((1, S, D), kv_idx),
+                pl.BlockSpec((1, S, D), kv_idx),
+                pl.BlockSpec((1, BQ, D), lambda b, i, w: (b, i, 0)),
+                pl.BlockSpec((1, BQ, NUM_LANES), lambda b, i, w: (b, i, 0)),
+                pl.BlockSpec((1, BQ, NUM_LANES), lambda b, i, w: (b, i, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, BQ, D), lambda b, i, w: (b, i, 0)),
+                pl.BlockSpec((1, S, D), lambda b, i, w: (b, 0, 0)),
+                pl.BlockSpec((1, S, D), lambda b, i, w: (b, 0, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((S, D), jnp.float32),
+                pltpu.VMEM((S, D), jnp.float32),
+            ],
+        ),
+        interpret=interpret,
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), q3.dtype),
+            # GQA: per-q-head dk/dv stay f32 so the rep-axis sum rounds once
+            jax.ShapeDtypeStruct((BH, S, D), jnp.float32 if kv_rep > 1 else q3.dtype),
+            jax.ShapeDtypeStruct((BH, S, D), jnp.float32 if kv_rep > 1 else q3.dtype),
+        ],
+    )(win, q3, k3, v3, do3, lse, delta)
+    return dq, dk, dv
+
+
 def _bwd(q3, k3, v3, o3, lse, do3, sm_scale: float, causal: bool, interpret: bool = False, kv_rep: int = 1, window=None):
     """Grads for _fwd. With ``kv_rep`` > 1 (GQA) the dk/dv kernels run at
     per-q-head resolution ([BH,S,D], each reading its group's K/V block via
@@ -304,6 +442,14 @@ def _bwd(q3, k3, v3, o3, lse, do3, sm_scale: float, causal: bool, interpret: boo
     full = lambda b, i, w: (b, 0, 0)
     kv_full = lambda b, i, w: (b // kv_rep, 0, 0)
     win = _win_arr(window)
+    if _fused_bwd_ok(S, D, kv_rep):
+        dq, dk, dv = _bwd_fused(
+            q3, k3, v3, delta, lse, do3, sm_scale, causal, interpret, kv_rep, win
+        )
+        if kv_rep > 1:
+            dk = dk.reshape(BH // kv_rep, kv_rep, S, D).sum(axis=1).astype(k3.dtype)
+            dv = dv.reshape(BH // kv_rep, kv_rep, S, D).sum(axis=1).astype(v3.dtype)
+        return dq, dk, dv
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal, seq_len=S),
         grid_spec=pltpu.PrefetchScalarGridSpec(
