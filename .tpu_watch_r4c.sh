@@ -55,6 +55,7 @@ while true; do
     run_step bench_dots16_ce1024 1800 env BENCH_MICRO=16 BENCH_REMAT=1 BENCH_REMAT_POLICY=dots BENCH_CE_CHUNK=1024 python bench.py || continue
     run_step bench_pad128 1800 env BENCH_MICRO=16 BENCH_REMAT=1 BENCH_REMAT_POLICY=dots BENCH_PAD_VOCAB=128 python bench.py || continue
     run_step vocab_probe 1200 python benchmarks/vocab_pad_probe.py || continue
+    run_step bench_splitbwd16 1800 env BENCH_MICRO=16 BENCH_REMAT=1 BENCH_REMAT_POLICY=dots DS_FLASH_FUSED_BWD=0 python bench.py || continue
     run_step bench_dots32 1800 env BENCH_MICRO=32 BENCH_REMAT=1 BENCH_REMAT_POLICY=dots python bench.py || continue
     run_step bench_attn16 1800 env BENCH_MICRO=16 BENCH_REMAT=1 BENCH_REMAT_POLICY=attn python bench.py || continue
     timeout 300 python benchmarks/collect_r4.py >> .tpu_watch_r4.log 2>&1
