@@ -49,6 +49,10 @@ while true; do
     # FIRST: the tuned config on the CURRENT code (restructured chunked CE)
     # at 20 steps — this is what the driver's round-end bench will run, so a
     # regression here must surface before anything else burns window time
+    # kernel CI FIRST: compiles the fused flash backward standalone (2-4
+    # min) so a Mosaic failure surfaces before the headline rung burns time
+    run_step tb_flashbwd2 2400 env DS_TPU_TESTS=1 python -m pytest \
+      "tests/unit/ops/test_tpu_hardware.py::TestFlashAttentionHardware" -q --tb=long || continue
     run_step bench_tuned20 2400 env BENCH_STEPS=20 python bench.py || continue
     # CE chunk sweep on the new code + the padded-vocab A/B
     run_step bench_dots16_ce512 1800 env BENCH_MICRO=16 BENCH_REMAT=1 BENCH_REMAT_POLICY=dots BENCH_CE_CHUNK=512 python bench.py || continue
@@ -60,8 +64,6 @@ while true; do
     run_step bench_attn16 1800 env BENCH_MICRO=16 BENCH_REMAT=1 BENCH_REMAT_POLICY=attn python bench.py || continue
     timeout 300 python benchmarks/collect_r4.py >> .tpu_watch_r4.log 2>&1
     # fixed measurements
-    run_step tb_flashbwd2 2400 env DS_TPU_TESTS=1 python -m pytest \
-      "tests/unit/ops/test_tpu_hardware.py::TestFlashAttentionHardware" -q --tb=long || continue
     run_step fused_adam2 1800 python benchmarks/fused_adam_bench.py || continue
     run_step flash_sweep2 2400 python benchmarks/flash_sweep.py || continue
     run_step inf_bert2 1800 python benchmarks/inference_bench.py bert || continue

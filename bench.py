@@ -450,6 +450,20 @@ def main():
                 + (f",attn={rung[4]}" if len(rung) > 4 else "")
                 + f"): {type(e).__name__}"
             )
+            # a NON-memory failure in a pallas rung is most likely a kernel
+            # lowering problem; the newest Mosaic surface is the fused flash
+            # backward — disable it for the remaining rungs so one bad
+            # kernel can't cascade every pallas rung into the jnp rescue.
+            # (OOMs keep it: the fallback ladder exists for those.)
+            if "RESOURCE_EXHAUSTED" not in str(e) and "ResourceExhausted" not in str(e):
+                try:
+                    from deepspeed_tpu.ops.pallas import flash_attention as _fa
+
+                    if _fa._FUSED_BWD_ENABLED:
+                        _fa._FUSED_BWD_ENABLED = False
+                        sys.stderr.write("[bench] disabled fused flash bwd after non-OOM rung failure\n")
+                except Exception:
+                    pass
             cfg = engine = None
             if rung == ladder[-1]:
                 raise
