@@ -60,6 +60,9 @@ while true; do
     run_step bench_pad128 1800 env BENCH_MICRO=16 BENCH_REMAT=1 BENCH_REMAT_POLICY=dots BENCH_PAD_VOCAB=128 python bench.py || continue
     run_step vocab_probe 1200 python benchmarks/vocab_pad_probe.py || continue
     run_step bench_splitbwd16 1800 env BENCH_MICRO=16 BENCH_REMAT=1 BENCH_REMAT_POLICY=dots DS_FLASH_FUSED_BWD=0 python bench.py || continue
+    run_step tb_bse 1800 env DS_TPU_TESTS=1 python -m pytest \
+      "tests/unit/ops/test_tpu_hardware.py::TestBSEFlashHardware" -q --tb=long || continue
+    run_step bench_bse16 1800 env BENCH_MICRO=16 BENCH_REMAT=1 BENCH_REMAT_POLICY=dots DS_FLASH_BSE=1 python bench.py || continue
     run_step bench_dots32 1800 env BENCH_MICRO=32 BENCH_REMAT=1 BENCH_REMAT_POLICY=dots python bench.py || continue
     run_step bench_attn16 1800 env BENCH_MICRO=16 BENCH_REMAT=1 BENCH_REMAT_POLICY=attn python bench.py || continue
     timeout 300 python benchmarks/collect_r4.py >> .tpu_watch_r4.log 2>&1

@@ -459,9 +459,10 @@ def main():
                 try:
                     from deepspeed_tpu.ops.pallas import flash_attention as _fa
 
-                    if _fa._FUSED_BWD_ENABLED:
+                    if _fa._BSE_ENABLED or _fa._FUSED_BWD_ENABLED:
+                        _fa._BSE_ENABLED = False
                         _fa._FUSED_BWD_ENABLED = False
-                        sys.stderr.write("[bench] disabled fused flash bwd after non-OOM rung failure\n")
+                        sys.stderr.write("[bench] disabled S-major + fused-bwd flash paths after non-OOM rung failure\n")
                 except Exception:
                     pass
             cfg = engine = None

@@ -29,6 +29,9 @@ HEADLINE_STEPS = {
     "bench_dots32", "bench_attn16", "bench_dots16_ce512",
     "bench_dots16_ce1024", "bench_tuned20", "bench_final",
     "bench_pad128", "bench_profile2", "bench_splitbwd16",
+    # bench_bse16 is deliberately NOT a tuned candidate: the S-major path is
+    # a module-level default, not a replayable BENCH_TUNED field — flip the
+    # code default if its rung wins
     # seeded session-1 captures: keep them in the max so a weaker later rung
     # can never downgrade BENCH_TUNED below the best committed number
     "bench_capture_session1_micro32", "bench1_oldkernels_f32dots",

@@ -832,6 +832,130 @@ def _flash_bwd_rule(sm_scale, causal, interpret, kv_rep, res, do3):
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
+# ---------------------------------------------------------------------------
+# S-major ([B, S, H*D]) entry: the kernels read each head's D-lane slice
+# straight out of the fused [B,S,E] activations via lane-offset index maps
+# ((bh // H, i, bh % H) block coords), so the [B,S,H,D] <-> [B*H,S,D]
+# physical transposes around the 3D entry — XLA copies, ~30 ms each at the
+# r4 bench shape, 8+ per layer across fwd/recompute/bwd — never exist.
+# Kernel BODIES are shared with the 3D path; only the pallas_call block
+# maps differ. MHA resident shapes with the fused backward only (GQA dk/dv
+# would need cross-grid-step output accumulation over the group).
+# ---------------------------------------------------------------------------
+
+# OPT-IN until hardware-proven (DS_FLASH_BSE=1): the D-lane blocks sit at
+# h*D lane offsets inside E, and for D=64 those are sub-128-lane origins —
+# a Mosaic tiling surface interpret mode cannot validate. The hardware CI
+# (TestBSEFlashHardware) compiles it on a chip; flip the default only with
+# that evidence.
+_BSE_ENABLED = _os.environ.get("DS_FLASH_BSE", "0") == "1"
+
+
+def _bse_ok(S: int, D: int, itemsize: int = 2) -> bool:
+    return _BSE_ENABLED and resident_ok(S, D, itemsize) and _fused_bwd_ok(S, D)
+
+
+def _fwd_bse(q2, k2, v2, H: int, sm_scale, causal, interpret, window):
+    B, S, E = q2.shape
+    D = E // H
+    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal, seq_len=S)
+    head = lambda bh, i, w: (bh // H, i, bh % H)
+    kv_head = lambda bh, i, w: (bh // H, 0, bh % H)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B * H, S // BQ),
+            in_specs=[
+                pl.BlockSpec((1, BQ, D), head),
+                pl.BlockSpec((1, S, D), kv_head),
+                pl.BlockSpec((1, S, D), kv_head),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, BQ, D), head),
+                pl.BlockSpec((1, BQ, NUM_LANES), lambda bh, i, w: (bh, i, 0)),
+            ],
+        ),
+        interpret=interpret,
+        out_shape=[
+            jax.ShapeDtypeStruct((B, S, E), q2.dtype),
+            jax.ShapeDtypeStruct((B * H, S, NUM_LANES), jnp.float32),
+        ],
+    )(_win_arr(window), q2, k2, v2)
+    return o, lse
+
+
+def _bwd_fused_bse(q2, k2, v2, o2, lse, do2, H: int, sm_scale, causal, interpret, window):
+    B, S, E = q2.shape
+    D = E // H
+    BH = B * H
+    d4 = do2.astype(jnp.float32).reshape(B, S, H, D)
+    o4 = o2.astype(jnp.float32).reshape(B, S, H, D)
+    delta = jnp.sum(d4 * o4, axis=-1).transpose(0, 2, 1).reshape(BH, S)  # [B,S,H] transpose: E-free, cheap
+    delta = jnp.broadcast_to(delta[..., None], (BH, S, NUM_LANES))
+    head = lambda bh, i, w: (bh // H, i, bh % H)
+    kv_head = lambda bh, i, w: (bh // H, 0, bh % H)
+    lse_blk = lambda bh, i, w: (bh, i, 0)
+    nq = S // BQ
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_fused_kernel, sm_scale=sm_scale, causal=causal,
+            seq_len=S, num_q_blocks=nq,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(BH, nq),
+            in_specs=[
+                pl.BlockSpec((1, BQ, D), head),
+                pl.BlockSpec((1, S, D), kv_head),
+                pl.BlockSpec((1, S, D), kv_head),
+                pl.BlockSpec((1, BQ, D), head),
+                pl.BlockSpec((1, BQ, NUM_LANES), lse_blk),
+                pl.BlockSpec((1, BQ, NUM_LANES), lse_blk),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, BQ, D), head),
+                pl.BlockSpec((1, S, D), kv_head),
+                pl.BlockSpec((1, S, D), kv_head),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((S, D), jnp.float32),
+                pltpu.VMEM((S, D), jnp.float32),
+            ],
+        ),
+        interpret=interpret,
+        out_shape=[
+            jax.ShapeDtypeStruct((B, S, E), q2.dtype),
+            jax.ShapeDtypeStruct((B, S, E), k2.dtype),
+            jax.ShapeDtypeStruct((B, S, E), v2.dtype),
+        ],
+    )(_win_arr(window), q2, k2, v2, do2, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_bse(q2, k2, v2, window, H: int, sm_scale: float, causal: bool, interpret: bool):
+    o, _ = _fwd_bse(q2, k2, v2, H, sm_scale, causal, interpret, window)
+    return o
+
+
+def _flash_bse_fwd_rule(q2, k2, v2, window, H, sm_scale, causal, interpret):
+    o, lse = _fwd_bse(q2, k2, v2, H, sm_scale, causal, interpret, window)
+    return o, (q2, k2, v2, o, lse, window)
+
+
+def _flash_bse_bwd_rule(H, sm_scale, causal, interpret, res, do2):
+    q2, k2, v2, o2, lse, window = res
+    dq, dk, dv = _bwd_fused_bse(
+        q2, k2, v2, o2, lse, do2, H, sm_scale, causal, interpret, window
+    )
+    win_ct = None if window is None else np.zeros((1,), jax.dtypes.float0)
+    return dq, dk, dv, win_ct
+
+
+_flash_bse.defvjp(_flash_bse_fwd_rule, _flash_bse_bwd_rule)
+
+
 def validate_kv_heads(H: int, k, v) -> int:
     """THE kv-head rule (one copy; decode + dispatch share it): K/V head
     counts must match and divide the q head count. Returns rep = H // KV."""
@@ -897,13 +1021,23 @@ def flash_attention(q, k, v, causal: bool = True, sm_scale: Optional[float] = No
             )
     scale = sm_scale if sm_scale is not None else 1.0 / (D**0.5)
 
+    win = None if window is None else _win_arr(window)
+    if rep == 1 and _bse_ok(S, D, q.dtype.itemsize):
+        # S-major path: head slices read via lane-offset index maps — the
+        # reshapes below are free (contiguous), no physical transposes
+        E = H * D
+        o2 = _flash_bse(
+            q.reshape(B, S, E), k.reshape(B, S, E), v.reshape(B, S, E),
+            win, H, float(scale), bool(causal), bool(interpret),
+        )
+        return o2.reshape(B, S, H, D)
+
     def to3(x):
         nh = x.shape[2]
         return x.transpose(0, 2, 1, 3).reshape(B * nh, S, D)
 
     # batch-major flattening makes bh = (b*KV + g)*rep + r for q and
     # b*KV + g for k/v, so bh // rep recovers the kv row exactly
-    win = None if window is None else _win_arr(window)
     o3 = _flash(to3(q), to3(k), to3(v), win, float(scale),
                 bool(causal), bool(interpret), rep)
     return o3.reshape(B, H, S, D).transpose(0, 2, 1, 3)

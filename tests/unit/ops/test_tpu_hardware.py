@@ -124,6 +124,43 @@ class TestFlashAttentionHardware:
         )
 
 
+class TestBSEFlashHardware:
+    """S-major flash entry (lane-offset head blocks over [B,S,E]) — opt-in
+    until this very test proves the Mosaic surface: D=64 blocks sit at
+    64-lane origins inside E, which interpret mode cannot validate."""
+
+    @pytest.mark.parametrize("D,H", [(64, 4), (128, 2)])
+    def test_bse_fwd_bwd_matches_3d_on_chip(self, D, H):
+        from deepspeed_tpu.ops.pallas import flash_attention as fa
+
+        q, k, v = _qkv(1, 512, H, D, seed=11)
+
+        def grads():
+            loss = lambda q, k, v: jnp.sum(
+                fa.flash_attention(q, k, v).astype(jnp.float32) ** 2
+            )
+            return jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))(q, k, v)
+
+        prev = fa._BSE_ENABLED
+        fa._BSE_ENABLED = True
+        try:
+            assert fa._bse_ok(512, D)
+            l_bse, g_bse = grads()
+        finally:
+            fa._BSE_ENABLED = prev
+        fa._BSE_ENABLED = False
+        try:
+            l_3d, g_3d = grads()
+        finally:
+            fa._BSE_ENABLED = prev
+        np.testing.assert_allclose(float(l_bse), float(l_3d), rtol=1e-3)
+        for a, b in zip(g_bse, g_3d):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                atol=1e-2, rtol=1e-2,
+            )
+
+
 class TestBlockSparseHardware:
     def test_fixed_pattern_compiles_and_matches(self):
         from deepspeed_tpu.ops.sparse_attention import FixedSparsityConfig
