@@ -1,0 +1,102 @@
+"""Generate docs/CONFIG.md from the runtime config dataclasses.
+
+The JSON schema IS runtime/config.py (reference-compatible DeepSpeed key
+names); this introspects it so the reference doc can never drift from the
+code. Re-run after any config change:
+
+    python docs/gen_config_reference.py
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+import typing
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepspeed_tpu.runtime import config as C
+
+
+def _is_subconfig(t) -> bool:
+    return isinstance(t, type) and dataclasses.is_dataclass(t)
+
+
+def _fmt_default(f: dataclasses.Field):
+    if f.default is not dataclasses.MISSING:
+        return repr(f.default)
+    if f.default_factory is not dataclasses.MISSING:  # type: ignore[misc]
+        v = f.default_factory()  # type: ignore[misc]
+        return "{}" if dataclasses.is_dataclass(v) else repr(v)
+    return ""
+
+
+def _fmt_type(f: dataclasses.Field) -> str:
+    t = f.type
+    return t if isinstance(t, str) else getattr(t, "__name__", str(t))
+
+
+def _resolve(cls, f: dataclasses.Field):
+    """The nested dataclass type of a field, if any."""
+    hints = typing.get_type_hints(C, include_extras=False)
+    try:
+        hints = typing.get_type_hints(cls)
+    except Exception:
+        return None
+    t = hints.get(f.name)
+    if _is_subconfig(t):
+        return t
+    for a in typing.get_args(t) or ():
+        if _is_subconfig(a):
+            return a
+    return None
+
+
+def emit(cls, section: str, out, seen):
+    if cls in seen:
+        return
+    seen.add(cls)
+    doc = (cls.__doc__ or "").strip()
+    out.append(f"## `{section}`\n")
+    # skip the auto-generated dataclass signature docstring
+    if doc and not doc.startswith(cls.__name__ + "("):
+        out.append(" ".join(l.strip() for l in doc.splitlines() if l.strip()) + "\n")
+    out.append("| key | type | default |")
+    out.append("|---|---|---|")
+    nested = []
+    for f in dataclasses.fields(cls):
+        if f.name.startswith("_"):
+            continue
+        sub = _resolve(cls, f)
+        if sub is not None:
+            key = f.name if section == "(top level)" else f"{section}.{f.name}"
+            nested.append((sub, key))
+            out.append(f"| `{f.name}` | section | see `{key}` |")
+        else:
+            out.append(f"| `{f.name}` | {_fmt_type(f)} | {_fmt_default(f)} |")
+    out.append("")
+    for sub, key in nested:
+        emit(sub, key, out, seen)
+
+
+def main():
+    out = [
+        "# Configuration reference",
+        "",
+        "Auto-generated from `deepspeed_tpu/runtime/config.py` "
+        "(`python docs/gen_config_reference.py`). The JSON keys are the "
+        "reference DeepSpeed names — an existing `ds_config.json` loads "
+        "unchanged via `deepspeed_tpu.initialize(config=...)`; unknown keys "
+        "raise `DeepSpeedConfigError` with the nearest known key.",
+        "",
+    ]
+    emit(C.DeepSpeedConfig, "(top level)", out, set())
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "CONFIG.md")
+    with open(path, "w") as f:
+        f.write("\n".join(out) + "\n")
+    print(f"wrote {path} ({len(out)} lines)")
+
+
+if __name__ == "__main__":
+    main()
