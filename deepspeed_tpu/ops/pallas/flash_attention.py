@@ -225,6 +225,32 @@ def _win_arr(window) -> jnp.ndarray:
     return jnp.asarray(0 if window is None else window, jnp.int32).reshape(1)
 
 
+def _fwd_call(q, k, v, window, *, S, D, grid, head_idx, kv_idx, lse_idx,
+              o_shape, lse_shape, sm_scale, causal, interpret):
+    """ONE pallas_call site for the resident forward, shared by the 3D
+    ([BH,S,D]) and S-major ([B,S,E]) layouts — they differ only in index
+    maps and output shapes; the kernel body is identical."""
+    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal, seq_len=S)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, BQ, D), head_idx),
+                pl.BlockSpec((1, S, D), kv_idx),
+                pl.BlockSpec((1, S, D), kv_idx),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, BQ, D), head_idx),
+                pl.BlockSpec((1, BQ, NUM_LANES), lse_idx),
+            ],
+        ),
+        interpret=interpret,
+        out_shape=[o_shape, lse_shape],
+    )(_win_arr(window), q, k, v)
+
+
 def _fwd(q3, k3, v3, sm_scale: float, causal: bool, interpret: bool = False, kv_rep: int = 1, window=None):
     """q3: [BH, S, D], k3/v3: [BH // kv_rep, S, D] → (o [BH,S,D], lse).
 
@@ -238,30 +264,15 @@ def _fwd(q3, k3, v3, sm_scale: float, causal: bool, interpret: bool = False, kv_
     a scalar-prefetch operand so one compiled kernel serves every per-layer
     window (GPT-Neo alternating local/global layers under one lax.scan)."""
     BH, S, D = q3.shape
-    grid = (BH, S // BQ)
-    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal, seq_len=S)
-    o, lse = pl.pallas_call(
-        kernel,
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=grid,
-            in_specs=[
-                pl.BlockSpec((1, BQ, D), lambda b, i, w: (b, i, 0)),
-                pl.BlockSpec((1, S, D), lambda b, i, w: (b // kv_rep, 0, 0)),
-                pl.BlockSpec((1, S, D), lambda b, i, w: (b // kv_rep, 0, 0)),
-            ],
-            out_specs=[
-                pl.BlockSpec((1, BQ, D), lambda b, i, w: (b, i, 0)),
-                pl.BlockSpec((1, BQ, NUM_LANES), lambda b, i, w: (b, i, 0)),
-            ],
-        ),
-        interpret=interpret,
-        out_shape=[
-            jax.ShapeDtypeStruct((BH, S, D), q3.dtype),
-            jax.ShapeDtypeStruct((BH, S, NUM_LANES), jnp.float32),
-        ],
-    )(_win_arr(window), q3, k3, v3)
-    return o, lse
+    return _fwd_call(
+        q3, k3, v3, window, S=S, D=D, grid=(BH, S // BQ),
+        head_idx=lambda b, i, w: (b, i, 0),
+        kv_idx=lambda b, i, w: (b // kv_rep, 0, 0),
+        lse_idx=lambda b, i, w: (b, i, 0),
+        o_shape=jax.ShapeDtypeStruct((BH, S, D), q3.dtype),
+        lse_shape=jax.ShapeDtypeStruct((BH, S, NUM_LANES), jnp.float32),
+        sm_scale=sm_scale, causal=causal, interpret=interpret,
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -380,30 +391,32 @@ def _bwd_fused_kernel(win_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _bwd_fused(q3, k3, v3, delta, lse, do3, sm_scale, causal, interpret, kv_rep, win):
-    BH, S, D = q3.shape
-    nq = S // BQ
-    kv_idx = lambda b, i, w: (b // kv_rep, 0, 0)
-    dq, dk, dv = pl.pallas_call(
+def _bwd_fused_call(q, k, v, do, lse, delta, win, *, S, D, grid, head_idx,
+                    kv_idx, dkv_idx, lse_idx, dq_shape, dkv_shape,
+                    sm_scale, causal, interpret):
+    """ONE pallas_call site for the fused backward, shared by the 3D and
+    S-major layouts (index maps + output shapes differ, body is shared)."""
+    nq = grid[1]
+    return pl.pallas_call(
         functools.partial(
             _bwd_fused_kernel, sm_scale=sm_scale, causal=causal,
             seq_len=S, num_q_blocks=nq,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(BH, nq),
+            grid=grid,
             in_specs=[
-                pl.BlockSpec((1, BQ, D), lambda b, i, w: (b, i, 0)),
+                pl.BlockSpec((1, BQ, D), head_idx),
                 pl.BlockSpec((1, S, D), kv_idx),
                 pl.BlockSpec((1, S, D), kv_idx),
-                pl.BlockSpec((1, BQ, D), lambda b, i, w: (b, i, 0)),
-                pl.BlockSpec((1, BQ, NUM_LANES), lambda b, i, w: (b, i, 0)),
-                pl.BlockSpec((1, BQ, NUM_LANES), lambda b, i, w: (b, i, 0)),
+                pl.BlockSpec((1, BQ, D), head_idx),
+                pl.BlockSpec((1, BQ, NUM_LANES), lse_idx),
+                pl.BlockSpec((1, BQ, NUM_LANES), lse_idx),
             ],
             out_specs=[
-                pl.BlockSpec((1, BQ, D), lambda b, i, w: (b, i, 0)),
-                pl.BlockSpec((1, S, D), lambda b, i, w: (b, 0, 0)),
-                pl.BlockSpec((1, S, D), lambda b, i, w: (b, 0, 0)),
+                pl.BlockSpec((1, BQ, D), head_idx),
+                pl.BlockSpec((1, S, D), dkv_idx),
+                pl.BlockSpec((1, S, D), dkv_idx),
             ],
             scratch_shapes=[
                 pltpu.VMEM((S, D), jnp.float32),
@@ -411,14 +424,26 @@ def _bwd_fused(q3, k3, v3, delta, lse, do3, sm_scale, causal, interpret, kv_rep,
             ],
         ),
         interpret=interpret,
-        out_shape=[
-            jax.ShapeDtypeStruct((BH, S, D), q3.dtype),
-            # GQA: per-q-head dk/dv stay f32 so the rep-axis sum rounds once
-            jax.ShapeDtypeStruct((BH, S, D), jnp.float32 if kv_rep > 1 else q3.dtype),
-            jax.ShapeDtypeStruct((BH, S, D), jnp.float32 if kv_rep > 1 else q3.dtype),
-        ],
-    )(win, q3, k3, v3, do3, lse, delta)
-    return dq, dk, dv
+        out_shape=[dq_shape, dkv_shape, dkv_shape],
+    )(win, q, k, v, do, lse, delta)
+
+
+def _bwd_fused(q3, k3, v3, delta, lse, do3, sm_scale, causal, interpret, kv_rep, win):
+    BH, S, D = q3.shape
+    return _bwd_fused_call(
+        q3, k3, v3, do3, lse, delta, win, S=S, D=D, grid=(BH, S // BQ),
+        head_idx=lambda b, i, w: (b, i, 0),
+        kv_idx=lambda b, i, w: (b // kv_rep, 0, 0),
+        # dk/dv staged PER Q HEAD (b, not b//kv_rep): under GQA the group is
+        # summed outside in f32 so the storage rounding happens exactly once
+        dkv_idx=lambda b, i, w: (b, 0, 0),
+        lse_idx=lambda b, i, w: (b, i, 0),
+        dq_shape=jax.ShapeDtypeStruct((BH, S, D), q3.dtype),
+        dkv_shape=jax.ShapeDtypeStruct(
+            (BH, S, D), jnp.float32 if kv_rep > 1 else q3.dtype
+        ),
+        sm_scale=sm_scale, causal=causal, interpret=interpret,
+    )
 
 
 def _bwd(q3, k3, v3, o3, lse, do3, sm_scale: float, causal: bool, interpret: bool = False, kv_rep: int = 1, window=None):
@@ -858,31 +883,15 @@ def _bse_ok(S: int, D: int, itemsize: int = 2) -> bool:
 def _fwd_bse(q2, k2, v2, H: int, sm_scale, causal, interpret, window):
     B, S, E = q2.shape
     D = E // H
-    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal, seq_len=S)
-    head = lambda bh, i, w: (bh // H, i, bh % H)
-    kv_head = lambda bh, i, w: (bh // H, 0, bh % H)
-    o, lse = pl.pallas_call(
-        kernel,
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=(B * H, S // BQ),
-            in_specs=[
-                pl.BlockSpec((1, BQ, D), head),
-                pl.BlockSpec((1, S, D), kv_head),
-                pl.BlockSpec((1, S, D), kv_head),
-            ],
-            out_specs=[
-                pl.BlockSpec((1, BQ, D), head),
-                pl.BlockSpec((1, BQ, NUM_LANES), lambda bh, i, w: (bh, i, 0)),
-            ],
-        ),
-        interpret=interpret,
-        out_shape=[
-            jax.ShapeDtypeStruct((B, S, E), q2.dtype),
-            jax.ShapeDtypeStruct((B * H, S, NUM_LANES), jnp.float32),
-        ],
-    )(_win_arr(window), q2, k2, v2)
-    return o, lse
+    return _fwd_call(
+        q2, k2, v2, window, S=S, D=D, grid=(B * H, S // BQ),
+        head_idx=lambda bh, i, w: (bh // H, i, bh % H),
+        kv_idx=lambda bh, i, w: (bh // H, 0, bh % H),
+        lse_idx=lambda bh, i, w: (bh, i, 0),
+        o_shape=jax.ShapeDtypeStruct((B, S, E), q2.dtype),
+        lse_shape=jax.ShapeDtypeStruct((B * H, S, NUM_LANES), jnp.float32),
+        sm_scale=sm_scale, causal=causal, interpret=interpret,
+    )
 
 
 def _bwd_fused_bse(q2, k2, v2, o2, lse, do2, H: int, sm_scale, causal, interpret, window):
@@ -893,44 +902,17 @@ def _bwd_fused_bse(q2, k2, v2, o2, lse, do2, H: int, sm_scale, causal, interpret
     o4 = o2.astype(jnp.float32).reshape(B, S, H, D)
     delta = jnp.sum(d4 * o4, axis=-1).transpose(0, 2, 1).reshape(BH, S)  # [B,S,H] transpose: E-free, cheap
     delta = jnp.broadcast_to(delta[..., None], (BH, S, NUM_LANES))
-    head = lambda bh, i, w: (bh // H, i, bh % H)
-    kv_head = lambda bh, i, w: (bh // H, 0, bh % H)
-    lse_blk = lambda bh, i, w: (bh, i, 0)
-    nq = S // BQ
-    dq, dk, dv = pl.pallas_call(
-        functools.partial(
-            _bwd_fused_kernel, sm_scale=sm_scale, causal=causal,
-            seq_len=S, num_q_blocks=nq,
-        ),
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=(BH, nq),
-            in_specs=[
-                pl.BlockSpec((1, BQ, D), head),
-                pl.BlockSpec((1, S, D), kv_head),
-                pl.BlockSpec((1, S, D), kv_head),
-                pl.BlockSpec((1, BQ, D), head),
-                pl.BlockSpec((1, BQ, NUM_LANES), lse_blk),
-                pl.BlockSpec((1, BQ, NUM_LANES), lse_blk),
-            ],
-            out_specs=[
-                pl.BlockSpec((1, BQ, D), head),
-                pl.BlockSpec((1, S, D), kv_head),
-                pl.BlockSpec((1, S, D), kv_head),
-            ],
-            scratch_shapes=[
-                pltpu.VMEM((S, D), jnp.float32),
-                pltpu.VMEM((S, D), jnp.float32),
-            ],
-        ),
-        interpret=interpret,
-        out_shape=[
-            jax.ShapeDtypeStruct((B, S, E), q2.dtype),
-            jax.ShapeDtypeStruct((B, S, E), k2.dtype),
-            jax.ShapeDtypeStruct((B, S, E), v2.dtype),
-        ],
-    )(_win_arr(window), q2, k2, v2, do2, lse, delta)
-    return dq, dk, dv
+    return _bwd_fused_call(
+        q2, k2, v2, do2, lse, delta, _win_arr(window), S=S, D=D,
+        grid=(BH, S // BQ),
+        head_idx=lambda bh, i, w: (bh // H, i, bh % H),
+        kv_idx=lambda bh, i, w: (bh // H, 0, bh % H),
+        dkv_idx=lambda bh, i, w: (bh // H, 0, bh % H),
+        lse_idx=lambda bh, i, w: (bh, i, 0),
+        dq_shape=jax.ShapeDtypeStruct((B, S, E), q2.dtype),
+        dkv_shape=jax.ShapeDtypeStruct((B, S, E), k2.dtype),
+        sm_scale=sm_scale, causal=causal, interpret=interpret,
+    )
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
