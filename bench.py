@@ -164,8 +164,10 @@ def attn_impl_used(cfg, micro: int, seq: int) -> str:
         return cfg.attn_impl
     q = jax.ShapeDtypeStruct((micro, seq, cfg.n_head, cfg.head_dim), jnp.bfloat16)
     if cfg.attn_impl == "pallas" or _pallas_ok(q):
-        from deepspeed_tpu.ops.pallas.flash_attention import resident_ok
+        from deepspeed_tpu.ops.pallas.flash_attention import _bse_ok, resident_ok
 
+        if _bse_ok(seq, cfg.head_dim, q.dtype.itemsize):
+            return "pallas-bse"  # S-major entry (DS_FLASH_BSE=1)
         if resident_ok(seq, cfg.head_dim, q.dtype.itemsize):
             return "pallas"
         return "pallas-grid"
