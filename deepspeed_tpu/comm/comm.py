@@ -347,9 +347,13 @@ def init_distributed(
 
     backend = XLABackend()
     if world_size > 1:
-        if verbose:
-            log_dist(f"Initializing distributed: world_size={world_size} rank={rank} coordinator={coord}")
+        # NOTHING may touch the jax backend before jax.distributed.initialize
+        # — log_dist queries jax.process_index(), which initializes it and
+        # makes multi-host init raise. Log only AFTER the rendezvous (bug
+        # caught by tests/unit/test_init_distributed.py).
         backend.init_process_group(coordinator_address=coord, num_processes=world_size, process_id=rank)
+        if verbose:
+            log_dist(f"Initialized distributed: world_size={world_size} rank={rank} coordinator={coord}")
     else:
         backend.init_process_group()
     cdb = backend

@@ -44,12 +44,22 @@ logger = _create_logger()
 
 
 def _process_index() -> int:
+    """Process index for rank-filtered logging, WITHOUT initializing the jax
+    backend: ``jax.process_index()`` before ``jax.distributed.initialize``
+    both returns the wrong answer (always 0) and permanently breaks
+    multi-host init (the backend can no longer join a rendezvous). Until
+    backends exist, fall back to the launcher-provided env rank."""
     try:
         import jax
+        from jax._src import xla_bridge
 
+        if not getattr(xla_bridge, "backends_are_initialized", lambda: True)():
+            raise LookupError  # env fallback below
         return jax.process_index()
-    except Exception:  # pragma: no cover - before jax init
-        return 0
+    except Exception:  # pragma: no cover - before jax init / API drift
+        import os
+
+        return int(os.environ.get("RANK", os.environ.get("OMPI_COMM_WORLD_RANK", "0")))
 
 
 def log_dist(message: str, ranks=None, level: int = logging.INFO) -> None:
