@@ -76,6 +76,10 @@ while true; do
     # name is not reused: the tests changed since)
     run_step tpu_suite2 3600 env DS_TPU_TESTS=1 python -m pytest tests/ -m tpu -q --tb=short || continue
     run_step bench_micro64 1800 env BENCH_MICRO=64 python bench.py || continue
+    # XLA flag experiments (not tuned candidates: flags aren't replayable
+    # BENCH_TUNED fields — bake a winner into bench.py defaults instead)
+    run_step bench_vmem64 1800 env BENCH_MICRO=16 BENCH_REMAT=1 BENCH_REMAT_POLICY=dots BENCH_XLA_FLAGS=--xla_tpu_scoped_vmem_limit_kib=65536 python bench.py || continue
+    run_step bench_vmem128 1800 env BENCH_MICRO=16 BENCH_REMAT=1 BENCH_REMAT_POLICY=dots BENCH_XLA_FLAGS=--xla_tpu_scoped_vmem_limit_kib=131072 python bench.py || continue
     # headline with the measured-best tuned config (what the driver will run)
     run_step bench_final 2400 python bench.py || continue
     # fresh profile of the TUNED config with the restructured chunked CE

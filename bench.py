@@ -32,6 +32,11 @@ import time
 
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+# experiment rungs can append compiler flags (must happen before jax import)
+if os.environ.get("BENCH_XLA_FLAGS"):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " " + os.environ["BENCH_XLA_FLAGS"]
+    ).strip()
 
 import numpy as np
 
