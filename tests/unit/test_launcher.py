@@ -91,6 +91,39 @@ class TestLaunchCommands:
         assert "PROCESS_ID=1" in c1 and "TPU_VISIBLE_CHIPS=0,1" in c1
         assert "train.py --flag v" in c0
 
+    def test_cli_trains_end_to_end(self, tmp_path):
+        """The single-host launcher path actually TRAINS: CLI -> runner ->
+        user script -> engine -> loss drops -> exit 0 (reference single-node
+        deepspeed launch). CPU-forced in-script (env alone is unreliable
+        under the axon hook)."""
+        script = tmp_path / "train_tiny.py"
+        script.write_text(
+            "import os\n"
+            "os.environ['XLA_FLAGS'] = '--xla_force_host_platform_device_count=1'\n"
+            "import jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "import numpy as np\n"
+            "import deepspeed_tpu\n"
+            "from deepspeed_tpu.models import gpt2\n"
+            "cfg = gpt2.get_config('gpt2-tiny')\n"
+            "eng, _, _, _ = deepspeed_tpu.initialize(model=gpt2.make_module(cfg), config={\n"
+            "    'train_micro_batch_size_per_gpu': 2,\n"
+            "    'optimizer': {'type': 'AdamW', 'params': {'lr': 1e-3}},\n"
+            "    'zero_optimization': {'stage': 1}, 'steps_per_print': 10**9})\n"
+            "rs = np.random.RandomState(0)\n"
+            "b = {'input_ids': rs.randint(0, cfg.vocab_size, (2, 64)).astype(np.int32)}\n"
+            "losses = [float(eng.train_batch(b)['loss']) for _ in range(8)]\n"
+            "assert losses[-1] < losses[0], losses\n"
+            "print('E2E_TRAIN_OK', round(losses[0], 3), '->', round(losses[-1], 3))\n"
+        )
+        out = subprocess.run(
+            [sys.executable, "-m", "deepspeed_tpu.launcher.runner", str(script)],
+            capture_output=True, text=True, cwd="/root/repo", timeout=600,
+            env={**os.environ, "PYTHONPATH": "/root/repo"},
+        )
+        assert out.returncode == 0, (out.stdout[-1500:], out.stderr[-1500:])
+        assert "E2E_TRAIN_OK" in out.stdout
+
     def test_cli_dry_run(self, hostfile):
         out = subprocess.run(
             [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
