@@ -17,6 +17,7 @@ P = exp(S - lse) blockwise, using delta = rowsum(dO * O).
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -30,10 +31,8 @@ from jax.experimental.pallas import tpu as pltpu
 # with a half-width MXU contraction, and bigger blocks amortize more of the
 # grid/DMA overhead per dot — without a code change. All kernels require
 # S % BQ == 0 and S % BK == 0 (flash_ok / windowed_flash_ok enforce).
-import os as _os
-
-BQ = int(_os.environ.get("DS_FLASH_BQ", "128"))
-BK = int(_os.environ.get("DS_FLASH_BK", "128"))
+BQ = int(os.environ.get("DS_FLASH_BQ", "128"))
+BK = int(os.environ.get("DS_FLASH_BK", "128"))
 NUM_LANES = 128  # lse/delta carry a broadcast 128-lane trailing dim (Mosaic
                  # requires >=(8,128)-tileable blocks; same layout as the
                  # official jax TPU flash kernel)
@@ -338,7 +337,7 @@ def _bwd_dkv_kernel(win_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk
 # next to the per-block operands; larger resident shapes fall back to the
 # split dq/dkv kernels.
 FUSED_BWD_BYTES = 8 * 1024 * 1024
-_FUSED_BWD_ENABLED = _os.environ.get("DS_FLASH_FUSED_BWD", "1") != "0"
+_FUSED_BWD_ENABLED = os.environ.get("DS_FLASH_FUSED_BWD", "1") != "0"
 
 
 def _fused_bwd_ok(S: int, D: int, kv_rep: int = 1) -> bool:
@@ -873,7 +872,7 @@ _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 # a Mosaic tiling surface interpret mode cannot validate. The hardware CI
 # (TestBSEFlashHardware) compiles it on a chip; flip the default only with
 # that evidence.
-_BSE_ENABLED = _os.environ.get("DS_FLASH_BSE", "0") == "1"
+_BSE_ENABLED = os.environ.get("DS_FLASH_BSE", "0") == "1"
 
 
 def _bse_ok(S: int, D: int, itemsize: int = 2) -> bool:
