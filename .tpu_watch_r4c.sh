@@ -70,6 +70,8 @@ while true; do
     run_step fused_adam2 1800 python benchmarks/fused_adam_bench.py || continue
     run_step flash_sweep2 2400 python benchmarks/flash_sweep.py || continue
     run_step inf_bert2 1800 python benchmarks/inference_bench.py bert || continue
+    run_step inf_decode_prof 1800 env BENCH_PROFILE=.prof_dec python benchmarks/inference_bench.py decode || continue
+    run_step profile_attr_dec 300 python benchmarks/profile_attr.py .prof_dec || continue
     run_step offload2 2400 python benchmarks/offload_bench.py offload || continue
     run_step infinity2 2400 python benchmarks/offload_bench.py infinity || continue
     # full hardware suite with the restructured tests (phase-1's tpu_suite

@@ -70,6 +70,15 @@ def _decode_bench():
         eng.generate(ids, max_new_tokens=1)
     dt_prefill = (time.perf_counter() - t0) / iters
 
+    # BENCH_PROFILE=<dir>: xplane trace of one generate call for ms/token
+    # attribution (weights stream vs cache reads vs dispatch overhead —
+    # the r4 capture's 5.46 ms/token is ~16% of pure weight-streaming
+    # bandwidth, so something besides HBM is the limit)
+    prof_dir = os.environ.get("BENCH_PROFILE")
+    if prof_dir:
+        with jax.profiler.trace(prof_dir):
+            out = eng.generate(ids, max_new_tokens=new)
+
     decode_tok_s = B * new / max(dt - dt_prefill, 1e-9)
     print(json.dumps({
         "metric": f"kv-decode tokens/sec {name} b{B} prompt{prompt} new{new}",
