@@ -17,6 +17,8 @@ import shlex
 import subprocess
 import sys
 
+from ..utils.logging import logger
+
 
 def ds_ssh(argv=None) -> int:
     p = argparse.ArgumentParser("ds_ssh", description="run a command on all hosts")
@@ -73,9 +75,40 @@ def ds_bench(argv=None) -> int:
     return 0
 
 
+def _watch_and_run(cmd, probe_timeout_s: float, backoff_s: float,
+                   max_runs: int, probe_fn=None, sleep_fn=None) -> int:
+    """Wait for a healthy accelerator, run ``cmd``, re-probe and retry on
+    failure — the preemption/wedge-recovery loop (the pattern that captured
+    this build's own hardware evidence through a flaky single-tenant
+    tunnel, productized). The command should be idempotent/resumable (e.g.
+    training with checkpoint auto-resume). ``max_runs`` 0 = retry until the
+    command succeeds."""
+    import time as _time
+
+    from ..elasticity.elastic_agent import _default_probe
+
+    probe = probe_fn or _default_probe
+    sleep = sleep_fn or _time.sleep
+    runs = 0
+    rc = 1
+    while True:
+        if probe(probe_timeout_s):
+            runs += 1
+            logger.info(f"ds_elastic --watch: accelerator healthy, run {runs}: {cmd}")
+            rc = subprocess.call(cmd)
+            if rc == 0:
+                return 0
+            logger.warning(f"ds_elastic --watch: command exited rc={rc}")
+            if max_runs and runs >= max_runs:
+                return rc
+        else:
+            logger.info("ds_elastic --watch: accelerator unhealthy, backing off")
+        sleep(backoff_s)
+
+
 def ds_elastic(argv=None) -> int:
     p = argparse.ArgumentParser("ds_elastic", description="elastic config ladder")
-    p.add_argument("-c", "--config", required=True, help="ds_config JSON path")
+    p.add_argument("-c", "--config", required=False, help="ds_config JSON path")
     p.add_argument("-w", "--world-size", type=int, default=0)
     p.add_argument(
         "--verify-resize",
@@ -85,7 +118,31 @@ def ds_elastic(argv=None) -> int:
         "must sit on the ladder with the SAME effective batch; prints the "
         "micro x gas x dp split per size (rc 1 if any is incompatible)",
     )
+    p.add_argument(
+        "--watch", action="store_true",
+        help="wait for a healthy accelerator, run CMD (everything after "
+        "--), and retry with backoff while it fails — wedge/preemption "
+        "recovery for an idempotent, checkpoint-resumable command",
+    )
+    p.add_argument("--probe-timeout", type=float, default=90.0)
+    p.add_argument("--backoff", type=float, default=240.0)
+    p.add_argument("--max-runs", type=int, default=0, help="0 = until success")
+    p.add_argument("cmd", nargs=argparse.REMAINDER)
     args = p.parse_args(argv)
+    if args.watch:
+        # drop only the LEADING separator: an inner "--" belongs to the
+        # wrapped command (e.g. --watch -- ds_ssh -f hosts -- echo hi)
+        cmd = args.cmd[1:] if args.cmd[:1] == ["--"] else args.cmd
+        if not cmd:
+            p.error("--watch needs a command after --")
+        return _watch_and_run(
+            cmd, args.probe_timeout, args.backoff, args.max_runs
+        )
+    if args.cmd:
+        p.error(f"unrecognized arguments: {' '.join(args.cmd)} (a trailing "
+                "command is only accepted with --watch)")
+    if not args.config:
+        p.error("-c/--config is required (unless --watch)")
     from ..elasticity.elasticity import ElasticityError, compute_elastic_config
 
     with open(args.config) as f:

@@ -189,6 +189,72 @@ class TestAuxCLIs:
         out = json.loads(capsys.readouterr().out)
         assert out["final_batch_size"] >= 4 and out["valid_gpus"]
 
+    def test_watch_and_run_recovers_then_succeeds(self):
+        """--watch: unhealthy probes back off; on recovery the command runs;
+        success stops the loop (the wedge-recovery pattern, productized)."""
+        from deepspeed_tpu.launcher.tools import _watch_and_run
+
+        probes = iter([False, False, True])
+        sleeps = []
+        rc = _watch_and_run(
+            [sys.executable, "-c", "print('ran')"],
+            probe_timeout_s=1.0, backoff_s=7.0, max_runs=0,
+            probe_fn=lambda t: next(probes),
+            sleep_fn=sleeps.append,
+        )
+        assert rc == 0
+        assert sleeps == [7.0, 7.0]  # two unhealthy backoffs, then success
+
+    def test_watch_and_run_max_runs_caps_retries(self):
+        from deepspeed_tpu.launcher.tools import _watch_and_run
+
+        sleeps = []
+        rc = _watch_and_run(
+            [sys.executable, "-c", "import sys; sys.exit(3)"],
+            probe_timeout_s=1.0, backoff_s=1.0, max_runs=2,
+            probe_fn=lambda t: True,
+            sleep_fn=sleeps.append,
+        )
+        assert rc == 3 and sleeps == [1.0]  # one backoff between the two runs
+
+    def test_watch_cli_plumbs_through(self, monkeypatch):
+        from deepspeed_tpu.elasticity import elastic_agent
+        from deepspeed_tpu.launcher.tools import ds_elastic
+
+        monkeypatch.setattr(elastic_agent, "_default_probe", lambda t: True)
+        rc = ds_elastic([
+            "--watch", "--max-runs", "1", "--",
+            sys.executable, "-c", "print('cli ok')",
+        ])
+        assert rc == 0
+
+    def test_watch_preserves_inner_separator(self, monkeypatch):
+        """Only the LEADING -- is the ds_elastic separator; an inner one
+        belongs to the wrapped command."""
+        from deepspeed_tpu.elasticity import elastic_agent
+        from deepspeed_tpu.launcher import tools
+
+        monkeypatch.setattr(elastic_agent, "_default_probe", lambda t: True)
+        seen = {}
+
+        def fake_run(cmd, *a, **k):
+            seen["cmd"] = cmd
+            return 0
+
+        monkeypatch.setattr(tools.subprocess, "call", fake_run)
+        rc = tools.ds_elastic(["--watch", "--", "tool", "--", "inner", "args"])
+        assert rc == 0 and seen["cmd"] == ["tool", "--", "inner", "args"]
+
+    def test_stray_args_without_watch_error(self, tmp_path):
+        import json as _json
+
+        from deepspeed_tpu.launcher.tools import ds_elastic
+
+        p = tmp_path / "c.json"
+        p.write_text(_json.dumps({"train_batch_size": 4}))
+        with pytest.raises(SystemExit):
+            ds_elastic(["-c", str(p), "stray", "typo"])
+
     def test_ds_bench_runs(self, capsys, devices):
         from deepspeed_tpu.launcher.tools import ds_bench
 
