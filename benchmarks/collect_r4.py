@@ -1,13 +1,15 @@
-"""Collect round-4 hardware artifacts into committed files.
+"""Collect hardware capture artifacts into committed files.
 
-Reads the watcher's per-step logs (.tpu_r4_*.log, gitignored), extracts the
-final JSON line of each, writes:
+Reads the watcher's per-step logs (.tpu_r4_*.log and .tpu_r5_*.log,
+gitignored), extracts the final JSON line of each, writes:
 
-- BENCH_R4_EXPERIMENTS.json — one entry per captured artifact (committed
-  evidence; the raw logs do not survive container restarts)
+- BENCH_EXPERIMENTS.json — one entry per captured artifact (committed
+  evidence; the raw logs do not survive container restarts). Round-5 steps
+  are keyed "r5_<name>"; the file is seeded from the round-4 store
+  (BENCH_R4_EXPERIMENTS.json) so nothing committed is ever lost.
 - BENCH_TUNED.json — the best headline-bench config by vs_baseline (only
-  from rungs that ran the headline metric at the default seq), consumed by
-  bench.py as its first ladder rung
+  from rungs that ran the headline tokens/sec metric at the default seq),
+  consumed by bench.py as its first ladder rung
 
 Idempotent; run after any recovery pass:  python benchmarks/collect_r4.py
 """
@@ -51,39 +53,75 @@ def last_json_line(path: str):
     return out
 
 
+def _tuned_candidate(step: str, j: dict) -> bool:
+    """Round-5 rungs qualify by evidence, not by name list: the JSON must be
+    a headline tokens/sec line. BSE and XLA-flag (vmem) rungs are excluded —
+    neither is replayable through BENCH_TUNED fields (their winners get baked
+    into code defaults instead)."""
+    if step in HEADLINE_STEPS:
+        return True
+    if not step.startswith("r5_bench"):
+        return False
+    # splitbwd rides DS_FLASH_FUSED_BWD=0, also not a BENCH_TUNED field
+    if "bse" in step or "vmem" in step or "splitbwd" in step:
+        return False
+    return "tokens/sec/chip" in str(j.get("metric", ""))
+
+
 def main():
     results = {}
-    for path in sorted(glob.glob(os.path.join(ROOT, ".tpu_r4_*.log"))):
-        step = os.path.basename(path)[len(".tpu_r4_"):-len(".log")]
-        if not os.path.getsize(path):
-            continue
-        wedged = "WEDGE" in open(path, errors="replace").read()
-        j = last_json_line(path)
-        if j is not None:
-            results[step] = j
-        elif wedged:
-            results[step] = {"error": "wedge", "artifact": os.path.basename(path)}
+    for prefix, keyfmt in ((".tpu_r4_", "{}"), (".tpu_r5_", "r5_{}")):
+        for path in sorted(glob.glob(os.path.join(ROOT, prefix + "*.log"))):
+            step = keyfmt.format(os.path.basename(path)[len(prefix):-len(".log")])
+            if not os.path.getsize(path):
+                continue
+            wedged = "WEDGE" in open(path, errors="replace").read()
+            j = last_json_line(path)
+            if j is not None:
+                results[step] = j
+            elif wedged:
+                results[step] = {"error": "wedge", "artifact": os.path.basename(path)}
 
-    out_path = os.path.join(ROOT, "BENCH_R4_EXPERIMENTS.json")
+    out_path = os.path.join(ROOT, "BENCH_EXPERIMENTS.json")
     existing = {}
+    # seed from the round-4 store the first time (committed evidence carries).
+    # A present-but-unparseable primary store is set aside, not silently
+    # replaced by the r4 seed: its entries are unrecoverable, but the rename
+    # makes the loss visible instead of masking it.
     if os.path.exists(out_path):
         try:
             with open(out_path) as f:
                 existing = json.load(f)
         except ValueError:
-            existing = {}
+            os.replace(out_path, out_path + ".corrupt")
+            print(f"WARNING: unparseable {out_path} moved to .corrupt")
+    if not existing:
+        seed_path = os.path.join(ROOT, "BENCH_R4_EXPERIMENTS.json")
+        if os.path.exists(seed_path):
+            try:
+                with open(seed_path) as f:
+                    existing = json.load(f)
+            except ValueError:
+                pass
     if not results and not existing:
         print("no artifacts found")
         return 1
-    # merge: a fresh capture overwrites; never drop a previously committed one
-    existing.update(results)
-    with open(out_path, "w") as f:
+    # merge: a fresh capture overwrites, EXCEPT a wedge/error entry never
+    # replaces a previously committed good result (a container restart wipes
+    # the logs; the rerun's wedge must not erase session-1 evidence)
+    for step, j in results.items():
+        if j.get("error") and step in existing and not existing[step].get("error"):
+            continue
+        existing[step] = j
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
         json.dump(existing, f, indent=1, sort_keys=True)
+    os.replace(tmp, out_path)
     print(f"wrote {out_path} ({len(existing)} entries)")
 
     best = None
     for step, j in existing.items():
-        if step not in HEADLINE_STEPS or j.get("error"):
+        if not _tuned_candidate(step, j) or j.get("error"):
             continue
         if "vs_baseline" not in j or j.get("value", 0) <= 0:
             continue
