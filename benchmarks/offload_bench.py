@@ -107,6 +107,13 @@ def bench_offload():
 
 
 def bench_infinity():
+    """The BASELINE "OPT-13B on one chip" run (docs/_pages/training.md:293
+    analog at Infinity scale): BENCH_EMBD=5120 BENCH_LAYERS=40 is the
+    OPT-13B shape (~12.9 B params). The hybrid optimizer tier packs as many
+    [master|m|v] records as DRAM holds and spills the rest to NVMe; compute
+    copies cast from the masters at load (from_master), init is numpy-native
+    in DRAM (host_init), and the per-block optimizer step runs eagerly
+    inside the backward sweep so grads never pile up host-side."""
     import jax
 
     from deepspeed_tpu.models import gpt2
@@ -114,19 +121,21 @@ def bench_infinity():
     from deepspeed_tpu.runtime.config import DeepSpeedConfig
     from deepspeed_tpu.runtime.engine import DeepSpeedEngine
 
-    # largest decoder whose fp32 master+moments+bf16 copies fit host DRAM:
-    # bytes/param = 12 (master+m+v) + 2 (bf16 block copy) = 14
+    # default sizing: largest decoder whose fp32 master+moments fit the
+    # DRAM+disk budget at 12 B/param (from_master stores no bf16 copies)
     avail = float(os.environ.get("BENCH_HOST_BYTES", 0)) or _free_ram()
     E = int(os.environ.get("BENCH_EMBD", "4096"))
     L = int(os.environ.get("BENCH_LAYERS", "0"))
     if not L:
         budget = avail * 0.80
-        per_layer = 12 * E * E * 14.0
-        fixed = 50257 * E * 14.0
+        per_layer = 12 * E * E * 12.0
+        fixed = 50257 * E * 12.0
         L = max(2, int((budget - fixed) // per_layer))
     seq = int(os.environ.get("BENCH_SEQ", "1024"))
     micro = int(os.environ.get("BENCH_MICRO", "1"))
     steps = int(os.environ.get("BENCH_STEPS", "1"))
+    nvme_path = os.environ.get("BENCH_NVME_PATH", "/tmp/ds_tpu_nvme")
+    opt_device = os.environ.get("BENCH_OPT_DEVICE", "hybrid")
 
     cfg = gpt2.get_config("gpt2", n_positions=seq, n_embd=E, n_layer=L,
                           n_head=E // 128, remat=True)
@@ -137,8 +146,16 @@ def bench_infinity():
             "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
             "zero_optimization": {
                 "stage": 3,
-                "offload_param": {"device": "cpu"},
-                "offload_optimizer": {"device": "cpu"},
+                "offload_param": {
+                    "device": "cpu",
+                    "nvme_path": nvme_path,
+                    "from_master": bool(int(os.environ.get("BENCH_FROM_MASTER", "1"))),
+                    "host_init": bool(int(os.environ.get("BENCH_HOST_INIT", "1"))),
+                },
+                "offload_optimizer": {
+                    "device": opt_device,
+                    "dram_budget_gb": float(os.environ.get("BENCH_OPT_DRAM_GB", "0")),
+                },
             },
             "bf16": {"enabled": True},
             "steps_per_print": 10**9,
@@ -146,7 +163,10 @@ def bench_infinity():
         dp_world_size=1,
     )
     mesh = MeshSpec(dp=1, devices=jax.devices()[:1]).build_mesh()
+    t_init = time.perf_counter()
     engine = DeepSpeedEngine(gpt2.make_module(cfg), ds, mesh=mesh, seed=0)
+    init_s = time.perf_counter() - t_init
+    inf = engine._infinity
     n_params = 12 * L * E * E + 50257 * E + seq * E
     rs = np.random.RandomState(0)
     batch = {"input_ids": rs.randint(0, cfg.vocab_size, (micro, seq)).astype(np.int32)}
@@ -164,6 +184,15 @@ def bench_infinity():
         hbm_peak = jax.devices()[0].memory_stats().get("peak_bytes_in_use")
     except Exception:
         hbm_peak = None
+    rss = None
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmHWM"):
+                    rss = int(line.split()[1]) * 1024
+                    break
+    except OSError:
+        pass
     print(json.dumps({
         "metric": f"ZeRO-Infinity params/chip (L={L} E={E} streamed, 1 chip)",
         "value": n_params,
@@ -172,9 +201,18 @@ def bench_infinity():
         "model_tflops": round(tflops, 2),
         "step_s": round(dt, 1),
         "first_step_s": round(warm, 1),
+        "init_s": round(init_s, 1),
         "hbm_peak_bytes": hbm_peak,
         "host_dram_bytes": int(avail),
+        "host_peak_rss_bytes": rss,
+        "opt_device": opt_device,
+        "opt_nvme_blocks": len(inf._opt_nvme),
+        "opt_dram_blocks": L - len(inf._opt_nvme),
+        "eager_step": bool(inf._eager),
+        "from_master": bool(inf._param_from_master),
+        "max_resident_blocks": inf.max_resident_blocks,
         "loss": round(float(m["loss"]), 4),
+        "grad_norm": round(float(m.get("grad_norm", float("nan"))), 4),
     }))
 
 

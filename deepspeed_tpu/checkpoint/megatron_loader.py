@@ -1,0 +1,130 @@
+"""Megatron-style training-checkpoint ingestion (state-dict factory).
+
+Analog of reference ``runtime/state_dict_factory.py`` (SDLoaderFactory:20,
+MegatronSDLoader:214): the reference merges/splits ``mp_rank_XX`` torch
+shards at ``load_checkpoint`` time so a TP-sharded Megatron training
+checkpoint can resume under a different TP degree. Here the same ingestion
+is three explicit steps over plain numpy dicts:
+
+1. regrid: ``checkpoint/reshape.py`` merges the tp×pp shard grid to the
+   full logical model (any source grid; reference supports shrink only),
+2. name map: classic Megatron-LM GPT keys → the stacked ``[L, ...]`` JAX
+   layout (torch Linear weights ``[out, in]`` transpose to ``[in, out]``),
+3. reshard: ``DeepSpeedEngine.load_megatron_checkpoint`` casts to the
+   engine's master dtype and ``device_put``s with the engine's param
+   shardings — XLA lays the tensors straight onto the current dp/tp/pp mesh.
+
+QKV layout note: the converter treats ``query_key_value.weight`` as the
+globally-concatenated ``[3E, E]`` = ``[q; k; v]`` matrix (classic
+Megatron-LM pre-MCore). Checkpoints using per-head interleaving must be
+de-interleaved first (the reference's MegatronSDLoader carries the same
+per-version branching, ``state_dict_factory.py:380``).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Sequence
+
+import numpy as np
+
+PyTree = Any
+
+_LAYER_RE = re.compile(r"layers\.(\d+)\.(.+)$")
+
+# megatron key (within a layer) -> (our block path, transpose?)
+_LAYER_MAP = {
+    "input_layernorm.weight": (("ln_1", "scale"), False),
+    "input_layernorm.bias": (("ln_1", "bias"), False),
+    "attention.query_key_value.weight": (("attn", "c_attn_w"), True),
+    "attention.query_key_value.bias": (("attn", "c_attn_b"), False),
+    "attention.dense.weight": (("attn", "c_proj_w"), True),
+    "attention.dense.bias": (("attn", "c_proj_b"), False),
+    "post_attention_layernorm.weight": (("ln_2", "scale"), False),
+    "post_attention_layernorm.bias": (("ln_2", "bias"), False),
+    "mlp.dense_h_to_4h.weight": (("mlp", "c_fc_w"), True),
+    "mlp.dense_h_to_4h.bias": (("mlp", "c_fc_b"), False),
+    "mlp.dense_4h_to_h.weight": (("mlp", "c_proj_w"), True),
+    "mlp.dense_4h_to_h.bias": (("mlp", "c_proj_b"), False),
+}
+
+
+def megatron_to_gpt2_tree(full_sd: Dict[str, Any]) -> Dict[str, Any]:
+    """Full (already TP/PP-merged) Megatron GPT state dict → our stacked
+    ``{wte, wpe, ln_f, blocks}`` numpy tree. Vocab padding is NOT applied
+    here (the engine pads/slices to its own padded vocab)."""
+    per_layer: Dict[int, Dict[str, Dict[str, np.ndarray]]] = {}
+    out: Dict[str, Any] = {}
+    for key, val in full_sd.items():
+        arr = np.asarray(val)
+        m = _LAYER_RE.search(key)
+        if m:
+            n, sub = int(m.group(1)), m.group(2)
+            if sub not in _LAYER_MAP:
+                raise KeyError(f"unmapped megatron layer key: {key}")
+            (grp, name), transpose = _LAYER_MAP[sub]
+            per_layer.setdefault(n, {}).setdefault(grp, {})[name] = (
+                arr.T if transpose else arr
+            )
+        elif "word_embeddings" in key:
+            out["wte"] = arr
+        elif "position_embeddings" in key:
+            out["wpe"] = arr
+        elif "final_layernorm" in key:
+            out.setdefault("ln_f", {})[
+                "scale" if key.endswith("weight") else "bias"
+            ] = arr
+        else:
+            raise KeyError(f"unmapped megatron key: {key}")
+    L = len(per_layer)
+    assert sorted(per_layer) == list(range(L)), f"non-contiguous layers: {sorted(per_layer)}"
+    blocks: Dict[str, Any] = {}
+    for grp in ("ln_1", "ln_2", "attn", "mlp"):
+        blocks[grp] = {}
+        for name in per_layer[0][grp]:
+            blocks[grp][name] = np.stack([per_layer[i][grp][name] for i in range(L)])
+    out["blocks"] = blocks
+    return out
+
+
+def gpt2_tree_to_megatron(params: PyTree) -> Dict[str, np.ndarray]:
+    """Inverse: our stacked tree → a full Megatron-style state dict (for
+    export to torch consumers and for the round-trip tests)."""
+    inv = {}
+    for sub, ((grp, name), transpose) in _LAYER_MAP.items():
+        inv[(grp, name)] = (sub, transpose)
+    out: Dict[str, np.ndarray] = {
+        "embedding.word_embeddings.weight": np.asarray(params["wte"]),
+        "embedding.position_embeddings.weight": np.asarray(params["wpe"]),
+        "final_layernorm.weight": np.asarray(params["ln_f"]["scale"]),
+        "final_layernorm.bias": np.asarray(params["ln_f"]["bias"]),
+    }
+    blocks = params["blocks"]
+    L = int(np.asarray(next(iter(jax_leaves(blocks)))).shape[0])
+    for grp, tensors in blocks.items():
+        for name, stacked in tensors.items():
+            sub, transpose = inv[(grp, name)]
+            for i in range(L):
+                a = np.asarray(stacked[i])
+                out[f"layers.{i}.{sub}"] = a.T if transpose else a
+    return out
+
+
+def jax_leaves(tree: PyTree):
+    import jax
+
+    return jax.tree.leaves(tree)
+
+
+def megatron_shards_to_gpt2_tree(shards) -> Dict[str, Any]:
+    """Accepts a single full dict, a TP row ``[dict]``, or a pp×tp grid
+    ``[[dict]]``; merges and maps."""
+    from .reshape import merge_pp_state_dicts, merge_tp_state_dicts
+
+    if isinstance(shards, dict):
+        full = shards
+    elif shards and isinstance(shards[0], dict):
+        full = merge_tp_state_dicts(shards)
+    else:
+        full = merge_pp_state_dicts([merge_tp_state_dicts(row) for row in shards])
+    return megatron_to_gpt2_tree(full)

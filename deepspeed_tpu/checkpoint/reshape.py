@@ -79,3 +79,92 @@ def reshape_tp(shards: Sequence[Dict[str, Any]], new_tp: int) -> List[Dict[str, 
     """old-TP shards → new-TP shards (reshape_meg_2d_parallel analog for the
     TP axis; dp reshape is a no-op for model weights)."""
     return split_tp_state_dict(merge_tp_state_dicts(shards), new_tp)
+
+
+# ---------------------------------------------------------------------------
+# Pipeline (layer) dimension + full 2D tp×pp regrid.
+#
+# Analog of reference ``checkpoint/reshape_meg_2d.py:75``
+# (reshape_meg_2d_parallel) and ``reshape_3d_utils.py:12`` (model_3d_desc).
+# The reference builds a RANK map (which old ranks' files feed each new rank)
+# and only supports shrinking either degree; since our shards are plain
+# numpy dicts we regrid the DATA instead — merge to the full logical model,
+# then split to any target grid, growing or shrinking both axes.
+# ---------------------------------------------------------------------------
+
+_LAYER_RE = re.compile(r"^(.*?)layers\.(\d+)\.(.+)$")
+
+# non-layer tensors and the pipeline stage that owns them (Megatron layout:
+# embeddings enter on the first stage, final norm/head leave on the last)
+FIRST_STAGE_PATTERNS = (r"word_embeddings", r"position_embeddings", r"^embedding\.")
+LAST_STAGE_PATTERNS = (r"final_layernorm", r"lm_head", r"output_layer")
+
+
+def _stage_for_extra(key: str, pp: int) -> int:
+    for pat in LAST_STAGE_PATTERNS:
+        if re.search(pat, key):
+            return pp - 1
+    for pat in FIRST_STAGE_PATTERNS:
+        if re.search(pat, key):
+            return 0
+    return 0  # unknown extras default to the first stage too
+
+
+def _partition(n: int, parts: int) -> List[int]:
+    """Per-part counts, remainder spread over the leading parts (the
+    reference's partition_data contract, reshape_utils.py)."""
+    base, rem = divmod(n, parts)
+    return [base + (1 if i < rem else 0) for i in range(parts)]
+
+
+def merge_pp_state_dicts(stage_dicts: Sequence[Dict[str, Any]]) -> Dict[str, np.ndarray]:
+    """PP-stage state dicts (locally-numbered ``layers.N.``) → one dict with
+    global layer numbering; stage-owned extras pass through."""
+    out: Dict[str, np.ndarray] = {}
+    offset = 0
+    for sd in stage_dicts:
+        local_max = -1
+        for key, val in sd.items():
+            m = _LAYER_RE.match(key)
+            if m:
+                n = int(m.group(2))
+                local_max = max(local_max, n)
+                out[f"{m.group(1)}layers.{n + offset}.{m.group(3)}"] = np.asarray(val)
+            else:
+                out[key] = np.asarray(val)
+        offset += local_max + 1
+    return out
+
+
+def split_pp_state_dict(sd: Dict[str, Any], pp: int) -> List[Dict[str, np.ndarray]]:
+    """Full dict → ``pp`` stage dicts with local layer numbering."""
+    n_layers = 0
+    for key in sd:
+        m = _LAYER_RE.match(key)
+        if m:
+            n_layers = max(n_layers, int(m.group(2)) + 1)
+    counts = _partition(n_layers, pp)
+    starts = np.cumsum([0] + counts)
+    stage_of = np.searchsorted(starts[1:], np.arange(n_layers), side="right")
+    stages: List[Dict[str, np.ndarray]] = [dict() for _ in range(pp)]
+    for key, val in sd.items():
+        m = _LAYER_RE.match(key)
+        if m:
+            n = int(m.group(2))
+            s = int(stage_of[n])
+            local = n - int(starts[s])
+            stages[s][f"{m.group(1)}layers.{local}.{m.group(3)}"] = np.asarray(val)
+        else:
+            stages[_stage_for_extra(key, pp)][key] = np.asarray(val)
+    return stages
+
+
+def reshape_2d(
+    grid: Sequence[Sequence[Dict[str, Any]]], new_tp: int, new_pp: int
+) -> List[List[Dict[str, np.ndarray]]]:
+    """``grid[pp][tp]`` shards → ``[new_pp][new_tp]`` shards, regridding
+    both dimensions through the full logical model (tp merge per stage →
+    pp merge → pp split → tp split per stage). Unlike the reference map,
+    degrees may grow or shrink."""
+    full = merge_pp_state_dicts([merge_tp_state_dicts(row) for row in grid])
+    return [split_tp_state_dict(s, new_tp) for s in split_pp_state_dict(full, new_pp)]

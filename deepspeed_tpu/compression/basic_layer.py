@@ -19,29 +19,49 @@ import jax
 import jax.numpy as jnp
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
-def quantize_weight_ste(w: jnp.ndarray, bits: int = 8, symmetric: bool = True) -> jnp.ndarray:
+def quantize_weight_ste(w: jnp.ndarray, bits: int = 8, symmetric: bool = True,
+                        key=None) -> jnp.ndarray:
     """Fake-quantize with a straight-through estimator (QAT forward).
 
     Reference LinearLayer_Compress weight quantization; gradients pass
     through unchanged (STE), so the training loop needs no changes.
+    ``key`` engages unbiased stochastic rounding (the reference's
+    quantizer.cu:1037 SR path — at 4-6 bits RTN bias visibly skews MoQ
+    training; SR keeps E[q(w)] == w). The SR path can't ride the
+    custom_vjp (a traced key is not a static nondiff arg), so it uses the
+    equivalent stop-gradient STE identity.
     """
+    if key is None:
+        return _quantize_weight_rtn(w, bits, symmetric)
+    return w + jax.lax.stop_gradient(_fake_quant(w, bits, symmetric, key=key) - w)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _quantize_weight_rtn(w: jnp.ndarray, bits: int = 8, symmetric: bool = True) -> jnp.ndarray:
     return _fake_quant(w, bits, symmetric)
 
 
-def _fake_quant(w, bits, symmetric, axis=None):
+def _round(x, key):
+    if key is None:
+        return jnp.round(x)
+    import jax
+
+    return jnp.floor(x + jax.random.uniform(key, x.shape, x.dtype))
+
+
+def _fake_quant(w, bits, symmetric, axis=None, key=None):
     """Shared fake-quant math; ``axis`` selects per-row (dynamic per-token)
-    vs whole-tensor scales."""
+    vs whole-tensor scales; ``key`` selects stochastic rounding."""
     kd = axis is not None
     qmax = 2.0 ** (bits - 1) - 1
     if symmetric:
         scale = jnp.maximum(jnp.max(jnp.abs(w), axis=axis, keepdims=kd), 1e-8) / qmax
-        return jnp.round(w / scale) * scale
+        return jnp.clip(_round(w / scale, key), -qmax - 1, qmax) * scale
     lo = jnp.min(w, axis=axis, keepdims=kd)
     hi = jnp.max(w, axis=axis, keepdims=kd)
     scale = jnp.maximum(hi - lo, 1e-8) / (2.0**bits - 1)
     zp = jnp.round(-lo / scale)
-    return (jnp.clip(jnp.round(w / scale) + zp, 0, 2.0**bits - 1) - zp) * scale
+    return (jnp.clip(_round(w / scale, key) + zp, 0, 2.0**bits - 1) - zp) * scale
 
 
 def _qw_fwd(w, bits, symmetric):
@@ -52,7 +72,7 @@ def _qw_bwd(bits, symmetric, _res, g):
     return (g,)  # straight-through
 
 
-quantize_weight_ste.defvjp(_qw_fwd, _qw_bwd)
+_quantize_weight_rtn.defvjp(_qw_fwd, _qw_bwd)
 
 
 def sparse_pruning_mask(w: jnp.ndarray, ratio: float, method: str = "l1") -> jnp.ndarray:

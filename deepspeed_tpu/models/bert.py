@@ -44,6 +44,12 @@ class BertConfig:
     # the BERT-large pretraining objective that is the reference's headline
     # workload (docs/_pages/training.md:42 "44 min on 1024 V100")
     pretraining: bool = False
+    # encoder attention dispatch: auto | pallas | jnp | sparse. "sparse"
+    # routes through the block-sparse kernel (reference SparseAttentionUtils
+    # .replace_model_self_attention_with_sparse_self_attention:85);
+    # sparsity_config is a SparsityConfig (None → Fixed at n_head)
+    attn_impl: str = "auto"
+    sparsity_config: object = None
 
     @property
     def head_dim(self) -> int:
@@ -158,12 +164,22 @@ def _block(cfg: BertConfig, lp, h, attention_mask):
     q = (h @ _deq(a["wq"], h.dtype) + a["bq"]).reshape(B, S, H, D)
     k_ = (h @ _deq(a["wk"], h.dtype) + a["bk"]).reshape(B, S, H, D)
     v = (h @ _deq(a["wv"], h.dtype) + a["bv"]).reshape(B, S, H, D)
-    # shared encoder-attention dispatcher: Pallas flash on TPU when
-    # unmasked/shape-admitted, f32-softmax jnp path otherwise — BERT-large
-    # inference rides the same kernel as the decoder families
-    from ..ops.attention import bidirectional_attention
+    if cfg.attn_impl == "sparse":
+        from ..ops.sparse_attention import FixedSparsityConfig, sparse_attention
 
-    o = bidirectional_attention(q, k_, v, mask=attention_mask).reshape(B, S, E)
+        sc = cfg.sparsity_config or FixedSparsityConfig(num_heads=H)
+        o = sparse_attention(
+            q, k_, v, sc, causal=False, key_mask=attention_mask
+        ).reshape(B, S, E)
+    else:
+        # shared encoder-attention dispatcher: Pallas flash on TPU when
+        # unmasked/shape-admitted, f32-softmax jnp path otherwise —
+        # BERT-large inference rides the same kernel as the decoder families
+        from ..ops.attention import bidirectional_attention
+
+        o = bidirectional_attention(
+            q, k_, v, mask=attention_mask, impl=cfg.attn_impl
+        ).reshape(B, S, E)
     h = _ln(h + (o @ _deq(a["wo"], o.dtype) + a["bo"]), lp["attn_ln"]["scale"], lp["attn_ln"]["bias"], cfg.layer_norm_epsilon)
     m = lp["mlp"]
     y = jax.nn.gelu(h @ _deq(m["fc_in_w"], h.dtype) + m["fc_in_b"], approximate=False)

@@ -797,6 +797,40 @@ def make_block_api(cfg: GPT2Config):
         ]
         return pers, blocks
 
+    # numpy-native init (InfinityEngine host_init): same structure and
+    # distribution as the device init, built straight into DRAM — at 13B the
+    # device path would stream ~50 GB of initial masters D2H before step 0
+    def host_init_persistent(gen):
+        wte = gen.standard_normal((cfg.padded_vocab_size, E), dtype=np.float32) * std
+        if cfg.padded_vocab_size > V:
+            wte[V:] = 0
+        return {
+            "wte": wte,
+            "wpe": gen.standard_normal((P, E), dtype=np.float32) * std,
+            "ln_f": {"scale": np.ones((E,), np.float32), "bias": np.zeros((E,), np.float32)},
+        }
+
+    def host_init_block(gen, i):
+        def normal(shape, s):
+            return gen.standard_normal(shape, dtype=np.float32) * s
+
+        return {
+            "ln_1": {"scale": np.ones((E,), np.float32), "bias": np.zeros((E,), np.float32)},
+            "ln_2": {"scale": np.ones((E,), np.float32), "bias": np.zeros((E,), np.float32)},
+            "attn": {
+                "c_attn_w": normal((E, 3 * E), std),
+                "c_attn_b": np.zeros((3 * E,), np.float32),
+                "c_proj_w": normal((E, E), pstd),
+                "c_proj_b": np.zeros((E,), np.float32),
+            },
+            "mlp": {
+                "c_fc_w": normal((E, 4 * E), std),
+                "c_fc_b": np.zeros((4 * E,), np.float32),
+                "c_proj_w": normal((4 * E, E), pstd),
+                "c_proj_b": np.zeros((E,), np.float32),
+            },
+        }
+
     return BlockAPI(
         num_blocks=L,
         init_persistent=init_persistent,
@@ -805,6 +839,8 @@ def make_block_api(cfg: GPT2Config):
         block_fwd=block_fwd,
         head_loss=head_loss,
         split_params=split_params,
+        host_init_persistent=host_init_persistent,
+        host_init_block=host_init_block,
     )
 
 

@@ -31,8 +31,37 @@ from jax.experimental.pallas import tpu as pltpu
 # with a half-width MXU contraction, and bigger blocks amortize more of the
 # grid/DMA overhead per dot — without a code change. All kernels require
 # S % BQ == 0 and S % BK == 0 (flash_ok / windowed_flash_ok enforce).
-BQ = int(os.environ.get("DS_FLASH_BQ", "128"))
-BK = int(os.environ.get("DS_FLASH_BK", "128"))
+def _block_env(name: str, default: int) -> int:
+    """Validated block-size override: must be a positive multiple of 128
+    (MXU lane width — anything else yields opaque Mosaic lowering errors,
+    and odd sizes silently flip flash_ok dispatch for S % B != 0 shapes)."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        v = int(raw)
+    except ValueError:
+        v = -1
+    if v <= 0 or v % 128:
+        import warnings
+
+        warnings.warn(
+            f"{name}={raw!r} ignored: flash block sizes must be positive "
+            f"multiples of 128 (using {default})"
+        )
+        return default
+    if v != default:
+        import warnings
+
+        warnings.warn(
+            f"{name}={v}: non-default flash block size changes dispatch "
+            f"eligibility (kernels require S % {v} == 0)"
+        )
+    return v
+
+
+BQ = _block_env("DS_FLASH_BQ", 128)
+BK = _block_env("DS_FLASH_BK", 128)
 NUM_LANES = 128  # lse/delta carry a broadcast 128-lane trailing dim (Mosaic
                  # requires >=(8,128)-tileable blocks; same layout as the
                  # official jax TPU flash kernel)

@@ -1,22 +1,28 @@
-"""Group-wise symmetric int8 weight quantization (weight-only inference).
+"""Group-wise int8 weight quantization (weight-only inference + training).
 
 Analog of reference ``deepspeed/ops/quantizer`` + ``csrc/quantization/``
-(quantizer.cu, 1037 LoC of symmetric/asymmetric kernels) and the inference
-``GroupQuantizer`` (module_inject/replace_module.py:139). On TPU the
-quant/dequant arithmetic is ordinary XLA ops fused into the surrounding
-matmul; what must be engineered is the storage format (int8 + per-group
-scales → ~4x HBM and bandwidth savings) and the model-side hook
+(quantizer.cu:1037 — symmetric/asymmetric kernels with round-to-nearest AND
+stochastic-rounding variants) and the inference ``GroupQuantizer``
+(module_inject/replace_module.py:139). On TPU the quant/dequant arithmetic
+is ordinary XLA ops fused into the surrounding matmul — including the
+stochastic rounding, which is one uniform draw + floor and fuses the same
+way, so the reference's dedicated SR CUDA kernels need no Pallas analog;
+what must be engineered is the storage format (int8 + per-group scales →
+~4x HBM and bandwidth savings) and the model-side hook
 (``maybe_dequantize``) that lets one forward serve both full-precision and
 quantized param trees.
 
 Scheme: groups along the input (contraction) dimension of each weight —
 ``w[..., I, O] → q[..., G, I/G, O] int8`` with fp scale ``[..., G, 1, O]`` —
-i.e. per-(group, output-channel) scales, symmetric, round-to-nearest.
+i.e. per-(group, output-channel) scales, symmetric round-to-nearest by
+default; ``key=`` engages unbiased stochastic rounding
+(``E[dequant(q)] == w``, the property MoQ low-bit training relies on), and
+``quantize_asym`` adds the zero-point variant.
 """
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple
+from typing import Any, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -32,17 +38,63 @@ class QuantizedWeight(NamedTuple):
     # original [..., I, O] shape is recovered as q.reshape(*q.shape[:-3], -1, O)
 
 
-def quantize(w: jnp.ndarray, groups: int = 64, scale_dtype=jnp.bfloat16) -> QuantizedWeight:
-    """Symmetric group int8 quantization of ``w [..., I, O]``."""
+class AsymQuantizedWeight(NamedTuple):
+    """Asymmetric variant: int8 codes + per-group (scale, zero_point)."""
+
+    q: jnp.ndarray  # [..., G, I/G, O] int8 (codes 0..2^bits-1 biased by -128)
+    scale: jnp.ndarray  # [..., G, 1, O] float
+    zero_point: jnp.ndarray  # [..., G, 1, O] float (real value of code -128)
+
+
+def _round(x: jnp.ndarray, key: Optional[jax.Array]) -> jnp.ndarray:
+    """Round-to-nearest, or unbiased stochastic rounding when ``key`` given:
+    floor(x + u), u ~ U[0,1) — E[result] = x exactly (reference
+    quantizer.cu:1037 stochastic_rounding path)."""
+    if key is None:
+        return jnp.round(x)
+    return jnp.floor(x + jax.random.uniform(key, x.shape, x.dtype))
+
+
+def _grouped(w: jnp.ndarray, groups: int):
     *lead, I, O = w.shape
     g = min(groups, I)
     while I % g:  # largest divisor of I not above requested groups
         g -= 1
-    wg = w.reshape(*lead, g, I // g, O).astype(jnp.float32)
+    return w.reshape(*lead, g, I // g, O).astype(jnp.float32)
+
+
+def quantize(w: jnp.ndarray, groups: int = 64, scale_dtype=jnp.bfloat16,
+             key: Optional[jax.Array] = None) -> QuantizedWeight:
+    """Symmetric group int8 quantization of ``w [..., I, O]``; stochastic
+    rounding when ``key`` is given."""
+    wg = _grouped(w, groups)
     amax = jnp.max(jnp.abs(wg), axis=-2, keepdims=True)
     scale = jnp.where(amax > 0, amax / 127.0, 1.0)
-    q = jnp.clip(jnp.round(wg / scale), -127, 127).astype(jnp.int8)
+    q = jnp.clip(_round(wg / scale, key), -127, 127).astype(jnp.int8)
     return QuantizedWeight(q=q, scale=scale.astype(scale_dtype))
+
+
+def quantize_asym(w: jnp.ndarray, groups: int = 64, scale_dtype=jnp.bfloat16,
+                  key: Optional[jax.Array] = None) -> AsymQuantizedWeight:
+    """Asymmetric group int8: codes span [min, max] exactly (non-centered
+    distributions waste no range); stochastic rounding when ``key`` given."""
+    wg = _grouped(w, groups)
+    lo = jnp.min(wg, axis=-2, keepdims=True)
+    hi = jnp.max(wg, axis=-2, keepdims=True)
+    scale = jnp.where(hi > lo, (hi - lo) / 255.0, 1.0)
+    q = jnp.clip(_round((wg - lo) / scale, key), 0, 255) - 128
+    return AsymQuantizedWeight(
+        q=q.astype(jnp.int8),
+        scale=scale.astype(scale_dtype),
+        zero_point=lo.astype(scale_dtype),
+    )
+
+
+def dequantize_asym(qw: AsymQuantizedWeight, dtype=jnp.float32) -> jnp.ndarray:
+    *lead, g, gsz, O = qw.q.shape
+    w = (qw.q.astype(jnp.float32) + 128.0) * qw.scale.astype(jnp.float32) \
+        + qw.zero_point.astype(jnp.float32)
+    return w.reshape(*lead, g * gsz, O).astype(dtype)
 
 
 def dequantize(qw: QuantizedWeight, dtype=jnp.float32) -> jnp.ndarray:
@@ -52,22 +104,30 @@ def dequantize(qw: QuantizedWeight, dtype=jnp.float32) -> jnp.ndarray:
 
 
 def maybe_dequantize(x, dtype=None):
-    """Model-side hook: pass arrays through, expand QuantizedWeight."""
+    """Model-side hook: pass arrays through, expand quantized weights."""
     if isinstance(x, QuantizedWeight):
         return dequantize(x, dtype or x.scale.dtype)
+    if isinstance(x, AsymQuantizedWeight):
+        return dequantize_asym(x, dtype or x.scale.dtype)
     return x
 
 
-def quantize_tree(params: PyTree, groups: int = 64, dtype=jnp.bfloat16) -> PyTree:
+def quantize_tree(params: PyTree, groups: int = 64, dtype=jnp.bfloat16,
+                  key: Optional[jax.Array] = None) -> PyTree:
     """Quantize the stacked transformer matmul weights (ndim >= 3 float
     leaves — the [L, I, O] blocks); cast everything else to ``dtype``.
     Embeddings ([V, E], ndim 2) stay full precision like the reference
-    (only attention/MLP tensors go through GroupQuantizer)."""
+    (only attention/MLP tensors go through GroupQuantizer). ``key``
+    engages stochastic rounding (fresh fold per leaf)."""
+    box = [key]
 
     def visit(x):
         if isinstance(x, jnp.ndarray) and jnp.issubdtype(x.dtype, jnp.floating):
             if x.ndim >= 3:
-                return quantize(x, groups=groups, scale_dtype=dtype)
+                leaf_key = None
+                if box[0] is not None:
+                    box[0], leaf_key = jax.random.split(box[0])
+                return quantize(x, groups=groups, scale_dtype=dtype, key=leaf_key)
             return x.astype(dtype)
         return x
 

@@ -1,3 +1,10 @@
+from .sparse_attention_utils import (
+    extend_position_embedding,
+    pad_to_block_size,
+    sparse_bert_module,
+    unpad_sequence_output,
+    update_tokenizer_model_max_length,
+)
 from .sparse_self_attention import SparseSelfAttention, sparse_attention
 from .sparsity_config import (
     BigBirdSparsityConfig,
@@ -19,8 +26,13 @@ __all__ = [
     "SparseSelfAttention",
     "SparsityConfig",
     "VariableSparsityConfig",
+    "extend_position_embedding",
     "from_ds_config",
     "layout_density",
     "layout_to_dense_mask",
+    "pad_to_block_size",
     "sparse_attention",
+    "sparse_bert_module",
+    "unpad_sequence_output",
+    "update_tokenizer_model_max_length",
 ]

@@ -1,0 +1,116 @@
+"""Model-integration helpers for block-sparse attention.
+
+Analog of reference ``ops/sparse_attention/sparse_attention_utils.py:1-225``
+(SparseAttentionUtils): pad ragged real-model inputs up to the kernel's
+block granularity, unpad the outputs, extend position embeddings past the
+pretrained window, and convert a (HF) BERT into a sparse-attention model.
+The reference mutates live torch modules; here models are functional, so
+"replacement" = building the same model config with ``attn_impl="sparse"``
+(models/bert.py routes attention through the Pallas block-sparse kernel)
+and the tensor helpers are pure functions usable inside or outside jit.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+PyTree = Any
+
+
+def pad_to_block_size(
+    block_size: int,
+    input_ids,
+    attention_mask=None,
+    token_type_ids=None,
+    position_ids=None,
+    pad_token_id: int = 0,
+) -> Tuple[int, Any, Any, Any, Any]:
+    """Pad ``[B, S]`` inputs so S becomes a multiple of ``block_size``
+    (reference SparseAttentionUtils.pad_to_block_size:151 — the kernels
+    require whole blocks). Returns ``(pad_len, input_ids, attention_mask,
+    token_type_ids, position_ids)`` with every given tensor padded:
+
+    - input_ids / token_type_ids with ``pad_token_id`` / 0,
+    - attention_mask with 0 (padded keys masked out),
+    - position_ids by continuing the running index (keeps wpe lookups valid).
+    """
+    S = input_ids.shape[1]
+    pad_len = (-S) % block_size
+    if pad_len == 0:
+        return 0, input_ids, attention_mask, token_type_ids, position_ids
+
+    def pad(x, value):
+        if x is None:
+            return None
+        widths = [(0, 0)] * x.ndim
+        widths[1] = (0, pad_len)
+        return jnp.pad(jnp.asarray(x), widths, constant_values=value)
+
+    input_ids = pad(input_ids, pad_token_id)
+    attention_mask = pad(attention_mask, 0)
+    token_type_ids = pad(token_type_ids, 0)
+    if position_ids is not None:
+        tail = jnp.arange(S, S + pad_len, dtype=jnp.asarray(position_ids).dtype)
+        position_ids = jnp.concatenate(
+            [jnp.asarray(position_ids), jnp.broadcast_to(tail, (position_ids.shape[0], pad_len))],
+            axis=1,
+        )
+    return pad_len, input_ids, attention_mask, token_type_ids, position_ids
+
+
+def unpad_sequence_output(pad_len: int, sequence_output):
+    """Strip the padding positions added by :func:`pad_to_block_size`
+    (reference :210)."""
+    if pad_len == 0:
+        return sequence_output
+    return sequence_output[:, :-pad_len]
+
+
+def extend_position_embedding(params: PyTree, max_position: int) -> PyTree:
+    """Extend ``wpe`` beyond the pretrained window by tiling the learned
+    table (reference :19 copies the original weights k times — positions
+    past the window reuse the pretrained positional geometry). Returns a
+    new param tree; ``max_position`` must be a multiple-extension target."""
+    wpe = np.asarray(params["wpe"])
+    orig = wpe.shape[0]
+    assert max_position > orig, (max_position, orig)
+    reps = -(-max_position // orig)  # ceil
+    new = np.concatenate([wpe] * reps, axis=0)[:max_position]
+    out = dict(params)
+    out["wpe"] = jnp.asarray(new)
+    return out
+
+
+def update_tokenizer_model_max_length(tokenizer, max_position: int):
+    """Reference :68 — keep the tokenizer's window in sync after
+    :func:`extend_position_embedding`."""
+    tokenizer.model_max_length = max_position
+    if hasattr(tokenizer, "init_kwargs"):
+        tokenizer.init_kwargs["model_max_length"] = max_position
+    return tokenizer
+
+
+def sparse_bert_module(name_or_cfg="bert-large", sparsity_config=None,
+                       **overrides):
+    """Build our BERT with block-sparse self-attention (the functional
+    analog of reference replace_model_self_attention_with_sparse_self_
+    attention:85). ``name_or_cfg``: a models/bert preset name or a
+    BertConfig; returns ``(cfg, ModuleSpec)``."""
+    from ...models import bert
+
+    if isinstance(name_or_cfg, str):
+        cfg = bert.get_config(
+            name_or_cfg, attn_impl="sparse",
+            sparsity_config=sparsity_config, **overrides,
+        )
+    else:
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            name_or_cfg, attn_impl="sparse", sparsity_config=sparsity_config,
+            **overrides,
+        )
+    return cfg, bert.make_module(cfg)

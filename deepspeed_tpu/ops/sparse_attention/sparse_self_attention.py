@@ -25,14 +25,18 @@ from .sparsity_config import SparsityConfig, layout_to_dense_mask
 NEG_INF = -1e30
 
 
-def _dense_masked(q, k, v, mask_hss: np.ndarray, causal: bool, sm_scale: float):
-    """[B,S,H,D] dense attention under an [H,S,S] element mask (reference path)."""
+def _dense_masked(q, k, v, mask_hss: np.ndarray, causal: bool, sm_scale: float,
+                  key_mask=None):
+    """[B,S,H,D] dense attention under an [H,S,S] element mask (reference
+    path); optional [B,S] key padding mask (1 = attend) ANDed in."""
     B, S, H, D = q.shape
     scores = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32), k.astype(jnp.float32)) * sm_scale
     mask = jnp.asarray(mask_hss)[None]  # [1,H,S,S]
     if causal:
         tri = jnp.tril(jnp.ones((S, S), bool))
         mask = mask & tri[None, None]
+    if key_mask is not None:
+        mask = mask & jnp.asarray(key_mask).astype(bool)[:, None, None, :]
     scores = jnp.where(mask, scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     # fully-masked rows (possible in exotic layouts): zero them like flash does
@@ -50,8 +54,13 @@ def sparse_attention(
     sm_scale: Optional[float] = None,
     impl: str = "auto",
     interpret: bool = False,
+    key_mask: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
-    """q/k/v: [B, S, H, D] → [B, S, H, D]."""
+    """q/k/v: [B, S, H, D] → [B, S, H, D]. ``key_mask`` [B,S] (1 = attend)
+    masks padded keys — ragged real-model inputs padded by
+    ``sparse_attention_utils.pad_to_block_size``. The Pallas kernel has no
+    mask input, so a mask routes to the jnp path (same contract as
+    ``ops.attention.bidirectional_attention``)."""
     B, S, H, D = q.shape
     assert H == sparsity_config.num_heads, (H, sparsity_config.num_heads)
     scale = sm_scale if sm_scale is not None else 1.0 / (D**0.5)
@@ -59,15 +68,26 @@ def sparse_attention(
 
     if impl == "auto":
         impl = "pallas" if jax.default_backend() == "tpu" else "jnp"
-    if impl == "pallas":
+    if impl == "pallas" and key_mask is None:
         from ..pallas.block_sparse_attention import block_sparse_attention
 
         return block_sparse_attention(
             q, k, v, layout, sparsity_config.block,
             causal=causal, sm_scale=scale, interpret=interpret,
         )
+    if impl == "pallas" and key_mask is not None and S >= 2048:
+        # the long-sequence regime the kernel exists for: make the O(S^2)
+        # dense fallback loud instead of silent (drop the mask — e.g. run
+        # unpadded full-length batches — to regain the kernel path)
+        import warnings
+
+        warnings.warn(
+            f"sparse_attention: key_mask at S={S} routes to the dense jnp "
+            "fallback (the Pallas block-sparse kernel has no mask input); "
+            "materializes [B,H,S,S] scores"
+        )
     mask = layout_to_dense_mask(layout, sparsity_config.block)
-    return _dense_masked(q, k, v, mask, causal, scale)
+    return _dense_masked(q, k, v, mask, causal, scale, key_mask=key_mask)
 
 
 class SparseSelfAttention:

@@ -71,7 +71,7 @@ class OffloadDeviceConfig(DSConfigModel):
     both directions unconditionally); ``fast_init``/``ratio`` tune
     reference-specific init paths that do not exist here."""
 
-    device: str = "none"  # none | cpu | nvme
+    device: str = "none"  # none | cpu | nvme (| hybrid, optimizer tier only)
     nvme_path: str = "/local_nvme"
     buffer_count: int = 5
     buffer_size: int = 100_000_000
@@ -81,6 +81,16 @@ class OffloadDeviceConfig(DSConfigModel):
     fast_init: bool = False
     max_in_cpu: int = 1_000_000_000
     ratio: float = 1.0
+    # --- TPU-native extensions (runtime/zero/infinity.py) ---------------
+    # offload_param.from_master: don't store separate bf16 compute copies;
+    # cast from the fp32 master record at load (saves 2 B/param of capacity)
+    from_master: bool = False
+    # offload_param.host_init: numpy init straight into DRAM (the reference
+    # ``fast_init`` intent) — no device materialization at multi-B scale
+    host_init: bool = False
+    # offload_optimizer.device="hybrid": DRAM-resident records up to this
+    # budget (GB; 0 = auto from MemAvailable), the rest swap through NVMe
+    dram_budget_gb: float = 0.0
 
 
 @dataclass

@@ -240,8 +240,11 @@ class DeepSpeedEngine:
             eps=float(p.get("eps", 1e-8)),
             weight_decay=float(p.get("weight_decay", 0.0)),
             device=offp.device,
-            opt_device=off.device if off.device in ("cpu", "nvme") else "cpu",
+            opt_device=off.device if off.device in ("cpu", "nvme", "hybrid") else "cpu",
             nvme_path=offp.nvme_path,
+            param_from_master=bool(offp.from_master),
+            host_init=bool(offp.host_init),
+            opt_dram_budget=float(off.dram_budget_gb) * 1e9,
             gradient_clipping=float(config.gradient_clipping or 0.0),
             compute_dtype=self.compute_dtype,
             seed=seed,
@@ -1606,3 +1609,47 @@ class DeepSpeedEngine:
                 self._offload.load_state_dict(dict(np.load(npz)))
         log_dist(f"loaded checkpoint from {load_dir} (tag={tag or 'latest'})")
         return load_dir, client_state
+
+    def load_megatron_checkpoint(self, shards) -> None:
+        """Load a TP/PP-sharded Megatron-style training checkpoint into THIS
+        engine, whatever its mesh (reference ``state_dict_factory.py:20``,
+        MegatronSDLoader merge/split at load time — here the shards regrid
+        through the full logical model and reshard onto the current
+        dp/tp/pp mesh via the engine's own param shardings).
+
+        ``shards``: one full state dict, a TP row ``[dict]``, or a pp×tp
+        grid ``[[dict]]``. Params only — optimizer state starts fresh, as
+        with the reference's ``load_module_only`` path.
+        """
+        from ..checkpoint.megatron_loader import megatron_shards_to_gpt2_tree
+
+        tree = megatron_shards_to_gpt2_tree(shards)
+        tgt = self.state.params
+        # vocab rows: pad/slice the source embedding to the engine's padded
+        # vocab (Megatron checkpoints carry their own padding)
+        if isinstance(tree, dict) and "wte" in tree and isinstance(tgt, dict):
+            rows = tgt["wte"].shape[0]
+            src = np.asarray(tree["wte"])
+            if src.shape[0] > rows:
+                tree["wte"] = src[:rows]
+            elif src.shape[0] < rows:
+                pad = np.zeros((rows - src.shape[0],) + src.shape[1:], src.dtype)
+                tree["wte"] = np.concatenate([src, pad], axis=0)
+
+        if self.param_offload_enabled:
+            # Infinity engines keep no device param tree (state.params is
+            # ()): adopt straight into the host tiers instead
+            self._infinity.adopt_params(tree)
+            log_dist("loaded megatron-style checkpoint into the Infinity tier")
+            return
+
+        def adopt(cur, new):
+            a = np.asarray(new)
+            assert a.shape == cur.shape, f"shape mismatch {a.shape} vs {cur.shape}"
+            return a.astype(cur.dtype)
+
+        new_params = jax.tree.map(adopt, tgt, tree)
+        shardings = self.state_shardings.params
+        new_params = jax.device_put(new_params, shardings)
+        self.state = self.state._replace(params=new_params)
+        log_dist("loaded megatron-style checkpoint (params only, resharded)")

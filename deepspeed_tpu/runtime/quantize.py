@@ -34,6 +34,7 @@ class Quantizer:
         q_groups: int = 1,
         use_quantizer_kernel: bool = True,
         modules: Optional[List[str]] = None,
+        q_rounding: str = "nearest",  # nearest | stochastic (quantizer.cu:1037)
     ):
         self.start_bits = q_start_bits
         self.target_bits = q_target_bits
@@ -41,6 +42,8 @@ class Quantizer:
         self.symmetric = q_type == "symmetric"
         self.groups = q_groups
         self.modules = modules or []
+        assert q_rounding in ("nearest", "stochastic"), q_rounding
+        self.stochastic = q_rounding == "stochastic"
         # precompute the (step, bits) staircase: bits drop by 1 at each
         # boundary, boundaries double (reference quantize_period doubling)
         self._schedule = []
@@ -64,18 +67,28 @@ class Quantizer:
     def _match(self, path: str) -> bool:
         return any(m in path for m in self.modules) if self.modules else True
 
-    def quantize_params(self, params: PyTree, step: int, eigenvalue_ratio: float = 1.0) -> PyTree:
+    def quantize_params(self, params: PyTree, step: int, eigenvalue_ratio: float = 1.0,
+                        rng=None) -> PyTree:
         bits = self.bits_at(step, eigenvalue_ratio)
         if bits >= 16:
             return params
         from ..utils.pytree import path_str
 
+        # stochastic rounding draws a fresh per-leaf key each step so the
+        # rounding noise is i.i.d. across steps (unbiased in expectation);
+        # derive from the step when no rng is threaded in
+        key = None
+        if self.stochastic:
+            key = rng if rng is not None else jax.random.PRNGKey(step)
         flat = jax.tree_util.tree_flatten_with_path(params)[0]
         out = []
         for path, leaf in flat:
             name = path_str(path)
             if hasattr(leaf, "ndim") and leaf.ndim >= 2 and self._match(name):
-                out.append(quantize_weight_ste(leaf, bits, self.symmetric))
+                leaf_key = None
+                if key is not None:
+                    key, leaf_key = jax.random.split(key)
+                out.append(quantize_weight_ste(leaf, bits, self.symmetric, key=leaf_key))
             else:
                 out.append(leaf)
         return jax.tree.unflatten(jax.tree.structure(params), out)

@@ -79,6 +79,24 @@ class PartitionedOptimizerSwapper:
             if release:
                 del self._buffers[gid]
 
+    def release(self, gid: int) -> None:
+        """Drop the DRAM staging buffer without writing (record on disk is
+        already current)."""
+        self._buffers.pop(gid, None)
+
+    def read_tensor_slot(self, gid: int, idx: int) -> np.ndarray:
+        """Partial record read: one tensor slot (e.g. only the master) into a
+        fresh buffer, without staging the whole [master|m|v] record in DRAM.
+        Returns the swapped-in view when the record is already resident."""
+        numel = self._numel[gid]
+        if gid in self._buffers:
+            return self.tensors(gid)[idx]
+        per = self._record_numel(numel) // self.n_tensors
+        buf = self.handle.new_aligned_buffer(per * 4).view(np.float32)
+        self.handle.async_pread(buf, self._path(gid), file_offset=idx * per * 4)
+        self.handle.wait()
+        return buf[:numel]
+
     def dram_bytes(self) -> int:
         return sum(b.nbytes for b in self._buffers.values())
 

@@ -72,6 +72,13 @@ class BlockAPI:
     # engine adopt externally initialized weights (and the parity tests start
     # both engines from identical values)
     split_params: Optional[Callable[[PyTree], Tuple[PyTree, List[PyTree]]]] = None
+    # numpy-native init (np.random.Generator -> np pytrees): at 13B scale the
+    # device-init path would materialize every block on chip and pull ~50 GB
+    # D2H through the tunnel before training starts; host init builds the
+    # fp32 masters directly in DRAM (reference analog: offload_config
+    # ``fast_init`` intent). Structure must match init_persistent/init_block.
+    host_init_persistent: Optional[Callable[[Any], PyTree]] = None
+    host_init_block: Optional[Callable[[Any, int], PyTree]] = None
 
 
 def memory_math(
@@ -82,6 +89,7 @@ def memory_math(
     micro_batch: int,
     n_positions: Optional[int] = None,
     mlp_ratio: int = 4,
+    param_from_master: bool = False,
 ) -> Dict[str, float]:
     """HBM footprint estimate (bytes) for the streamed step; the demo that a
     13-20B model fits one 16 GB chip (BASELINE.md ZeRO-Infinity row)."""
@@ -103,7 +111,11 @@ def memory_math(
     }
     hbm["total_hbm"] = float(sum(hbm.values()))
     hbm["total_params"] = float(total_params)
-    hbm["dram_or_nvme_bytes"] = float(total_params * (2 + 12))  # bf16 copy + fp32 m/v/master
+    # bf16 copy + fp32 master/m/v; with param_from_master the bf16 compute
+    # copy is cast from the master at load time and never stored
+    hbm["dram_or_nvme_bytes"] = float(
+        total_params * ((0 if param_from_master else 2) + 12)
+    )
     return hbm
 
 
@@ -132,7 +144,7 @@ class InfinityEngine:
         eps: float = 1e-8,
         weight_decay: float = 0.0,
         device: str = "cpu",  # offload_param.device: cpu | nvme
-        opt_device: str = "cpu",  # offload_optimizer.device
+        opt_device: str = "cpu",  # offload_optimizer.device: cpu | nvme | hybrid
         nvme_path: str = "/tmp/ds_tpu_nvme",
         gradient_clipping: float = 0.0,
         compute_dtype=jnp.bfloat16,
@@ -141,9 +153,24 @@ class InfinityEngine:
         trace_validator=None,
         aio_config=None,
         mesh=None,
+        # bf16 compute copies are cast from the fp32 masters at load time
+        # instead of being stored (saves 2 B/param of host/NVMe capacity —
+        # the knob that lets OPT-13B fit a 125 GB-DRAM + 80 GB-disk host)
+        param_from_master: bool = False,
+        # numpy-native init in DRAM (BlockAPI.host_init_*); avoids the
+        # ~4 B/param device-init D2H at multi-B scale
+        host_init: bool = False,
+        # "hybrid" opt tier: first K block records stay in DRAM, the rest
+        # swap via the pipelined NVMe swapper. K from this DRAM budget
+        # (bytes; 0 = auto from /proc/meminfo minus a working-set reserve).
+        opt_dram_budget: float = 0.0,
+        # eager=None auto-engages the per-block optimizer step inside the
+        # backward sweep (bounds DRAM grad high-water to ~2 blocks) whenever
+        # it is exact: gas==1, no loss scale, no global clipping
+        eager: Optional[bool] = None,
     ):
         assert device in ("cpu", "nvme"), device
-        assert opt_device in ("cpu", "nvme"), opt_device
+        assert opt_device in ("cpu", "nvme", "hybrid"), opt_device
         self.api = api
         self.mesh = mesh
         # debug mode: block fetch order must replay the recorded trace
@@ -155,6 +182,11 @@ class InfinityEngine:
         self.opt_device = opt_device
         self.lr_schedule = lr_schedule
         self.clip = float(gradient_clipping)
+        self._param_from_master = bool(param_from_master)
+        self._eager_requested = eager
+        self._eager = False
+        self._eager_sq = 0.0
+        self._eager_lr = 0.0
         self.compute_dtype = compute_dtype
         # host compute-copy dtype follows the engine's compute dtype: fp16
         # configs store fp16 block copies (loss-scaled math end to end)
@@ -172,10 +204,16 @@ class InfinityEngine:
         rng = jax.random.PRNGKey(seed)
         pers_rng, *block_rngs = jax.random.split(rng, L + 1)
         init_blocks = None
+        host_gen = None
         if initial_params is not None:
             assert api.split_params is not None, "block API lacks split_params"
             pers, init_blocks = api.split_params(jax.device_get(initial_params))
             pers = jax.device_get(pers)
+        elif host_init and api.host_init_block is not None and api.host_init_persistent is not None:
+            # numpy init straight into DRAM: no device materialization, no
+            # multi-GB D2H through the (possibly remote) device transport
+            host_gen = np.random.default_rng(seed)
+            pers = api.host_init_persistent(host_gen)
         else:
             # persistent part: fp32 master pytree in DRAM (small)
             pers = jax.device_get(jax.jit(api.init_persistent)(pers_rng))
@@ -186,11 +224,12 @@ class InfinityEngine:
         self._pers_shapes = [l.shape for l in self._pers_leaves]
 
         # block template: flatten/unflatten spec shared by every block
-        b0 = (
-            jax.device_get(init_blocks[0])
-            if init_blocks is not None
-            else jax.device_get(jax.jit(lambda k: api.init_block(k, 0))(block_rngs[0]))
-        )
+        if init_blocks is not None:
+            b0 = jax.device_get(init_blocks[0])
+        elif host_gen is not None:
+            b0 = api.host_init_block(host_gen, 0)
+        else:
+            b0 = jax.device_get(jax.jit(lambda k: api.init_block(k, 0))(block_rngs[0]))
         b0_leaves, self._blk_tree = jax.tree.flatten(b0)
         self._blk_shapes = [l.shape for l in b0_leaves]
         self._blk_sizes = [int(np.prod(s)) if s else 1 for s in self._blk_shapes]
@@ -211,15 +250,37 @@ class InfinityEngine:
             self._repl_sharding = None
             self._blk_pad = 0
 
-        # bf16 compute copies per block (DRAM or NVMe)
+        # ---- optimizer-tier placement: which blocks' [master|m|v] records
+        # live in DRAM vs swap through NVMe. "hybrid" packs as many records
+        # as the DRAM budget holds and spills the rest — the split that lets
+        # a 13B model train on a host where neither tier alone fits.
+        rec_bytes = 3.0 * self.block_numel * 4.0
+        if opt_device == "hybrid":
+            budget = float(opt_dram_budget)
+            if budget <= 0:
+                budget = self._auto_dram_budget(L)
+            k = int(max(0, min(L, budget // rec_bytes)))
+            self._opt_nvme = frozenset(range(k, L))
+            log_dist(
+                f"ZeRO-Infinity hybrid optimizer tier: {k}/{L} block records in "
+                f"DRAM ({k * rec_bytes / 1e9:.1f} GB), {L - k} on NVMe "
+                f"({(L - k) * rec_bytes / 1e9:.1f} GB)"
+            )
+        elif opt_device == "nvme":
+            self._opt_nvme = frozenset(range(L))
+        else:
+            self._opt_nvme = frozenset()
+
+        # bf16 compute copies per block (DRAM or NVMe; none in from_master
+        # mode — loads cast from the fp32 master record instead)
         self._param_swapper = None
         self._blk_bf16: List[Optional[np.ndarray]] = [None] * L
         # fp32 master + moments per block (DRAM or NVMe [master|m|v] records)
         self._opt_swapper = None
         self._blk_master: List[Optional[np.ndarray]] = [None] * L
-        if device == "nvme" or opt_device == "nvme":
+        if device == "nvme" or self._opt_nvme:
             os.makedirs(nvme_path, exist_ok=True)
-        if device == "nvme":
+        if device == "nvme" and not self._param_from_master:
             from ...ops.aio import AsyncIOHandle
             from ..swap_tensor.partitioned_param_swapper import (
                 AsyncPartitionedParameterSwapper,
@@ -231,7 +292,7 @@ class InfinityEngine:
                 os.path.join(nvme_path, "infinity"), dtype=self._cdt,
                 aio_handle=AsyncIOHandle.from_config(aio_config),
             )
-        if opt_device == "nvme":
+        if self._opt_nvme:
             from ...ops.aio import AsyncIOHandle
             from ..swap_tensor.partitioned_optimizer_swapper import (
                 PipelinedOptimizerSwapper,
@@ -246,6 +307,8 @@ class InfinityEngine:
         for i in range(L):
             if init_blocks is not None:
                 blk = jax.device_get(init_blocks[i]) if i else b0
+            elif host_gen is not None:
+                blk = b0 if i == 0 else api.host_init_block(host_gen, i)
             else:
                 blk = b0 if i == 0 else jax.device_get(
                     jax.jit(lambda k, i=i: api.init_block(k, i))(block_rngs[i])
@@ -254,7 +317,8 @@ class InfinityEngine:
                 [np.asarray(l, np.float32).reshape(-1) for l in jax.tree.leaves(blk)]
             )
             self._store_block_master(i, flat, init=True)
-            self._store_block_bf16(i, flat.astype(self._cdt))
+            if not self._param_from_master:
+                self._store_block_bf16(i, flat.astype(self._cdt))
         del b0
 
         self._g_pers_acc: Optional[List[np.ndarray]] = None
@@ -274,6 +338,36 @@ class InfinityEngine:
         )
 
     # ---- block storage ----------------------------------------------------
+    def _auto_dram_budget(self, L: int) -> float:
+        """DRAM bytes available for resident optimizer records: MemAvailable
+        minus a working-set reserve (in-flight grads + upload staging +
+        persistent masters + runtime) and, when bf16 copies are stored in
+        DRAM, the copies themselves."""
+        avail = 64e9
+        try:
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    if line.startswith("MemAvailable"):
+                        avail = float(line.split()[1]) * 1024
+                        break
+        except OSError:
+            pass
+        reserve = 18e9
+        if self.device == "cpu" and not self._param_from_master:
+            reserve += L * self.block_numel * self._cdt.itemsize
+        return max(0.0, avail - reserve)
+
+    def _cast_master(self, master: np.ndarray) -> np.ndarray:
+        """fp32 master -> compute-dtype copy for upload (SIMD cast when bf16)."""
+        if self._cdt == _BF16:
+            try:
+                from ...ops.cpu_adam import f32_to_bf16
+
+                return f32_to_bf16(master).view(_BF16)
+            except Exception:
+                pass
+        return master.astype(self._cdt)
+
     def _pad_flat(self, flat: np.ndarray) -> np.ndarray:
         """Host flat buffers carry the shard padding so every load is
         upload-ready with no per-step concatenate."""
@@ -282,6 +376,8 @@ class InfinityEngine:
         return flat
 
     def _store_block_bf16(self, i: int, flat_bf16: np.ndarray) -> None:
+        if self._param_from_master:
+            return  # compute copies are cast from the master at load time
         if flat_bf16.size == self.block_numel:
             flat_bf16 = self._pad_flat(flat_bf16)
         if self._param_swapper is not None:
@@ -293,23 +389,34 @@ class InfinityEngine:
             self._blk_bf16[i] = flat_bf16
 
     def _load_block_bf16(self, i: int) -> np.ndarray:
+        if self._param_from_master:
+            if i in self._opt_nvme and self._blk_master[i] is None:
+                # partial record read: only the master slot comes off disk
+                master = self._opt_swapper.read_tensor_slot(i, 0)
+            else:
+                master = self._blk_master[i]
+            return self._pad_flat(self._cast_master(master))
         if self._param_swapper is not None:
             self._param_swapper.swap_in([i])
             return self._param_swapper.get(i)
         return self._blk_bf16[i]
 
     def _release_block_bf16(self, i: int) -> None:
+        if self._param_from_master:
+            return  # nothing cached: the cast copy dies with the caller ref
         if self._param_swapper is not None and self._param_swapper.available(i):
             # drop the DRAM copy without rewriting (params unchanged since load)
             self._param_swapper._buffers.pop(i, None)
             self._param_swapper._available.discard(i)
 
     def _store_block_master(self, i: int, master: np.ndarray, init: bool = False) -> None:
-        if self._opt_swapper is not None:
+        if i in self._opt_nvme:
             if init:
                 z = np.zeros_like(master)
+                # initialize_subgroup persists the record itself; just drop
+                # the DRAM staging buffer (no second write)
                 self._opt_swapper.initialize_subgroup(i, [master, z, z])
-                self._opt_swapper.swap_out(i, release=True)
+                self._opt_swapper.release(i)
             # non-init: run_pipeline writes back via its own swap_out
         else:
             self._blk_master[i] = master
@@ -439,12 +546,12 @@ class InfinityEngine:
             acts[i + 1] = None  # boundary act consumed
             if pending is not None:
                 # D2H of block i+1's grads overlaps block i's VJP on device
-                self._acc_block(*pending)
+                self._sink_block(*pending)
             pending = (i, g_blk)
             cur = None
             self._mark_block_released()
         if pending is not None:
-            self._acc_block(*pending)
+            self._sink_block(*pending)
 
         g_pers_embed = self._j_embed_bwd(pers, batch_dev, rngs[L], dh)
         self._acc_pers(g_pers_embed)
@@ -468,6 +575,40 @@ class InfinityEngine:
         else:
             self._g_blk_acc[i] = flat
 
+    def _sink_block(self, i: int, g_flat_dev) -> None:
+        if self._eager:
+            self._eager_block_step(i, g_flat_dev)
+        else:
+            self._acc_block(i, g_flat_dev)
+
+    def _eager_block_step(self, i: int, g_flat_dev) -> None:
+        """Apply block i's optimizer update inside the backward sweep.
+
+        Exact only under the conditions train_step checks (gas==1, no loss
+        scale, no global clipping): then the accumulate-everything path would
+        apply the identical per-block update later, while holding every
+        block's fp32 grad in DRAM at once (~4 B/param — at 13B that alone is
+        ~50 GB). Eager bounds the grad high-water to the ~2 in-flight blocks.
+        """
+        g = np.asarray(jax.device_get(g_flat_dev), np.float32).reshape(-1)
+        g = g[: self.block_numel]
+        self._eager_sq += float(np.dot(g, g))
+        lr = self._eager_lr
+        if i in self._opt_nvme:
+            self._opt_swapper.swap_in(i)
+            master, m, v = self._opt_swapper.tensors(i)
+            self.opt.set_state(i, [m, v])
+            self.opt._step.setdefault(i, 0)
+            self.opt.step(master, g, key=i, lr=lr)
+            if not self._param_from_master:
+                self._store_block_bf16(i, master.astype(self._cdt))
+            del self.opt._m[i], self.opt._v[i]  # views into the record
+            self._opt_swapper.swap_out(i, release=True)
+        else:
+            self.opt.step(self._blk_master[i], g, key=i, lr=lr)
+            if not self._param_from_master:
+                self._store_block_bf16(i, self._blk_master[i].astype(self._cdt))
+
     def train_step(
         self, batch_gas: PyTree, global_step: int, rng, scale: Optional[float] = None
     ) -> Dict[str, Any]:
@@ -479,6 +620,20 @@ class InfinityEngine:
         ``overflow=True`` for the engine to back the scale off."""
         gas = int(jax.tree.leaves(batch_gas)[0].shape[0])
         scale_f = 1.0 if scale is None else float(scale)
+        lr_now = (
+            float(self.lr_schedule(global_step))
+            if callable(self.lr_schedule)
+            else float(self.lr_schedule)
+        )
+        # eager per-block updates are exact only when nothing global gates
+        # the step: single micro-batch, no loss-scale overflow check, no
+        # global-norm clipping
+        eager_ok = gas == 1 and scale is None and self.clip == 0.0
+        self._eager = eager_ok if self._eager_requested is None else (
+            bool(self._eager_requested) and eager_ok
+        )
+        self._eager_sq = 0.0
+        self._eager_lr = lr_now
         self._g_pers_acc = None
         self._g_blk_acc = {}
         losses = []
@@ -497,11 +652,6 @@ class InfinityEngine:
             self._tracing = False
         loss = float(np.mean([float(jax.device_get(l)) for l in losses]))
 
-        lr_now = (
-            float(self.lr_schedule(global_step))
-            if callable(self.lr_schedule)
-            else float(self.lr_schedule)
-        )
         if scale is not None:
             overflow = not (
                 all(np.isfinite(a).all() for a in self._g_blk_acc.values())
@@ -520,9 +670,12 @@ class InfinityEngine:
                     "overflow": True,
                 }
 
-        # mean over gas, unscale + global grad norm (host side, all staged)
+        # mean over gas, unscale + global grad norm (host side, all staged).
+        # Eager mode already applied every block's update inside the backward
+        # sweep (conditions guarantee inv == 1 and coef == 1); its per-block
+        # squared norms fold into the reported global norm here.
         inv = 1.0 / (gas * scale_f)
-        sq = 0.0
+        sq = self._eager_sq if self._eager else 0.0
         for gacc in self._g_blk_acc.values():
             gacc *= inv
             sq += float(np.dot(gacc, gacc))
@@ -539,28 +692,33 @@ class InfinityEngine:
         # ---- per-block optimizer tier (pipelined when NVMe) -------------
         L = self.api.num_blocks
 
-        if self._opt_swapper is not None:
+        if not self._eager:
+            nvme_ids = sorted(self._opt_nvme)
+            if nvme_ids:
 
-            def step_fn(i, tensors):
-                master, m, v = tensors
-                self.opt.set_state(i, [m, v])
-                self.opt._step.setdefault(i, 0)
-                g = self._g_blk_acc[i]
-                if coef != 1.0:
-                    g = g * coef
-                self.opt.step(master, g, key=i, lr=lr)
-                self._store_block_bf16(i, master.astype(self._cdt))
-                del self.opt._m[i], self.opt._v[i]  # views into the record
-                del self._g_blk_acc[i]
+                def step_fn(i, tensors):
+                    master, m, v = tensors
+                    self.opt.set_state(i, [m, v])
+                    self.opt._step.setdefault(i, 0)
+                    g = self._g_blk_acc[i]
+                    if coef != 1.0:
+                        g = g * coef
+                    self.opt.step(master, g, key=i, lr=lr)
+                    if not self._param_from_master:
+                        self._store_block_bf16(i, master.astype(self._cdt))
+                    del self.opt._m[i], self.opt._v[i]  # views into the record
+                    del self._g_blk_acc[i]
 
-            self._opt_swapper.run_pipeline(list(range(L)), step_fn)
-        else:
+                self._opt_swapper.run_pipeline(nvme_ids, step_fn)
             for i in range(L):
+                if i in self._opt_nvme:
+                    continue
                 g = self._g_blk_acc.pop(i)
                 if coef != 1.0:
                     g = g * coef
                 self.opt.step(self._blk_master[i], g, key=i, lr=lr)
-                self._store_block_bf16(i, self._blk_master[i].astype(self._cdt))
+                if not self._param_from_master:
+                    self._store_block_bf16(i, self._blk_master[i].astype(self._cdt))
 
         # ---- persistent part (always DRAM; key space above the blocks) --
         for j, (m, g) in enumerate(zip(self._pers_master, self._g_pers_acc)):
@@ -600,11 +758,11 @@ class InfinityEngine:
         ms = np.empty((L, self.block_numel), np.float32)
         vs = np.empty((L, self.block_numel), np.float32)
         for i in range(L):
-            if self._opt_swapper is not None:
+            if i in self._opt_nvme:
                 self._opt_swapper.swap_in(i)
                 master, m, v = self._opt_swapper.tensors(i)
                 blocks[i], ms[i], vs[i] = master, m, v
-                self._opt_swapper.swap_out(i, release=True)
+                self._opt_swapper.release(i)  # read-only: no writeback
             else:
                 blocks[i] = self._blk_master[i]
                 m, v = self.opt.state_tensors(i, self.block_numel)
@@ -626,7 +784,7 @@ class InfinityEngine:
         L = self.api.num_blocks
         for i in range(L):
             master = np.asarray(sd["blocks"][i], np.float32)
-            if self._opt_swapper is not None:
+            if i in self._opt_nvme:
                 self._opt_swapper.swap_in(i)
                 t_master, t_m, t_v = self._opt_swapper.tensors(i)
                 t_master[:] = master
@@ -636,7 +794,8 @@ class InfinityEngine:
             else:
                 self._blk_master[i] = master.copy()
                 self.opt.set_state(i, [np.array(sd["block_m"][i]), np.array(sd["block_v"][i])])
-            self._store_block_bf16(i, master.astype(self._cdt))
+            if not self._param_from_master:
+                self._store_block_bf16(i, master.astype(self._cdt))
         for j, (m, saved) in enumerate(zip(self._pers_master, sd["persistent"])):
             m[:] = saved
             if "persistent_m" in sd:
@@ -646,4 +805,52 @@ class InfinityEngine:
                 )
         for k, s in sd.get("steps", {}).items():
             self.opt._step[int(k)] = int(s)
+        self._pers_dev = None
+
+    def adopt_params(self, params: PyTree) -> None:
+        """Adopt an externally built full param tree into the host tiers —
+        params only, Adam moments reset (the reference ``load_module_only``
+        semantics). Used by ``engine.load_megatron_checkpoint`` so Megatron
+        ingestion works on engines whose params never materialize on device.
+        Persistent leaves whose leading dim differs (vocab padding) are
+        padded/sliced to the engine's shapes."""
+        assert self.api.split_params is not None, "block API lacks split_params"
+        L = self.api.num_blocks
+        pers, blocks = self.api.split_params(jax.device_get(params))
+        new_leaves, tree2 = jax.tree.flatten(pers)
+        assert tree2 == self._pers_tree, "persistent structure mismatch"
+        for j, leaf in enumerate(new_leaves):
+            a = np.asarray(leaf, np.float32)
+            tgt = self._pers_master[j]
+            if a.shape != tgt.shape:
+                assert a.shape[1:] == tgt.shape[1:], (a.shape, tgt.shape)
+                if a.shape[0] >= tgt.shape[0]:
+                    a = a[: tgt.shape[0]]
+                else:
+                    a = np.concatenate(
+                        [a, np.zeros((tgt.shape[0] - a.shape[0],) + a.shape[1:], np.float32)]
+                    )
+            tgt[...] = a
+            self.opt._m.pop(L + j, None)
+            self.opt._v.pop(L + j, None)
+            self.opt._step.pop(L + j, None)
+        for i, blk in enumerate(blocks):
+            flat = np.concatenate(
+                [np.asarray(l, np.float32).reshape(-1) for l in jax.tree.leaves(blk)]
+            )
+            assert flat.size == self.block_numel, (flat.size, self.block_numel)
+            if i in self._opt_nvme:
+                self._opt_swapper.swap_in(i)
+                master, m, v = self._opt_swapper.tensors(i)
+                master[:] = flat
+                m[:] = 0.0
+                v[:] = 0.0
+                self._opt_swapper.swap_out(i, release=True)
+            else:
+                self._blk_master[i] = flat
+                self.opt._m.pop(i, None)
+                self.opt._v.pop(i, None)
+            self.opt._step.pop(i, None)
+            if not self._param_from_master:
+                self._store_block_bf16(i, flat.astype(self._cdt))
         self._pers_dev = None

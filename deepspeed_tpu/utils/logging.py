@@ -53,7 +53,11 @@ def _process_index() -> int:
         import jax
         from jax._src import xla_bridge
 
-        if not getattr(xla_bridge, "backends_are_initialized", lambda: True)():
+        # If the private probe ever disappears, assume backends are NOT
+        # initialized: the env-rank fallback is always safe, while calling
+        # jax.process_index() here would initialize the backend and break
+        # any later jax.distributed.initialize (ADVICE r4).
+        if not getattr(xla_bridge, "backends_are_initialized", lambda: False)():
             raise LookupError  # env fallback below
         return jax.process_index()
     except Exception:  # pragma: no cover - before jax init / API drift

@@ -1,0 +1,114 @@
+"""Quantizer op tests: grouped int8 sym/asym, stochastic rounding, STE.
+
+Reference analog: csrc/quantization/quantizer.cu:1037 (sym/asym kernels
+with round-to-nearest and stochastic-rounding variants) and the MoQ
+training path (runtime/quantize.py). The SR property under test is
+unbiasedness: E[dequant(quant_sr(w))] == w, which RTN lacks off-grid.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.ops.quantizer import (
+    AsymQuantizedWeight,
+    dequantize,
+    dequantize_asym,
+    maybe_dequantize,
+    quantize,
+    quantize_asym,
+    quantize_tree,
+)
+
+
+class TestStochasticRounding:
+    def test_sr_is_unbiased_where_rtn_is_biased(self):
+        # values at 0.3 of a quantization step: RTN always rounds down
+        # (deterministic bias), SR averages to the true value
+        scale_anchor = 127.0
+        w = jnp.full((64, 8), 0.3).at[0, 0].set(scale_anchor)
+        x_true = 0.3  # in units of the (=1.0) scale
+        rtn = dequantize(quantize(w, groups=1, scale_dtype=jnp.float32), jnp.float32)[1, 0]
+        assert abs(float(rtn) - x_true) > 0.25  # RTN bias ~0.3 steps
+
+        draws = []
+        for s in range(200):
+            qw = quantize(w, groups=1, scale_dtype=jnp.float32, key=jax.random.PRNGKey(s))
+            draws.append(float(dequantize(qw, jnp.float32)[1, 0]))
+        mean = np.mean(draws)
+        np.testing.assert_allclose(mean, x_true, atol=0.08)
+        # individual draws land on adjacent grid points only
+        assert set(np.round(draws)) <= {0.0, 1.0}
+
+    def test_sr_exact_on_grid(self):
+        # values already on the int grid never move under SR
+        w = jnp.asarray(np.arange(-127, 128, dtype=np.float32)).reshape(-1, 1) / 127.0
+        w = jnp.concatenate([w] * 4, axis=1)
+        qw = quantize(w, groups=1, scale_dtype=jnp.float32, key=jax.random.PRNGKey(3))
+        np.testing.assert_allclose(
+            np.asarray(dequantize(qw, jnp.float32)), np.asarray(w), atol=1e-6
+        )
+
+    def test_asym_roundtrip_and_advantage(self):
+        rs = np.random.RandomState(0)
+        # non-centered distribution: all-positive weights waste half the
+        # symmetric range; asymmetric codes span [min, max]
+        w = jnp.asarray(rs.rand(256, 16).astype(np.float32) + 2.0)
+        sym_err = float(jnp.abs(dequantize(quantize(w, 4, scale_dtype=jnp.float32), jnp.float32) - w).max())
+        qa = quantize_asym(w, 4, scale_dtype=jnp.float32)
+        asym_err = float(jnp.abs(dequantize_asym(qa, jnp.float32) - w).max())
+        assert asym_err < sym_err
+        # scale bound: RTN error <= scale/2
+        assert asym_err <= float(qa.scale.max()) * 0.5 + 1e-5
+        # SR variant stays within one step and is unbiased on average
+        qs = quantize_asym(w, 4, scale_dtype=jnp.float32, key=jax.random.PRNGKey(1))
+        sr_err = float(jnp.abs(dequantize_asym(qs, jnp.float32) - w).max())
+        assert sr_err <= float(qs.scale.max()) + 1e-5
+
+    def test_maybe_dequantize_asym(self):
+        w = jnp.asarray(np.random.RandomState(1).randn(64, 8).astype(np.float32))
+        qa = quantize_asym(w, 4, scale_dtype=jnp.float32)
+        assert isinstance(qa, AsymQuantizedWeight)
+        out = maybe_dequantize(qa, jnp.float32)
+        assert out.shape == w.shape
+        np.testing.assert_allclose(np.asarray(out), np.asarray(w), atol=0.05)
+
+    def test_quantize_tree_with_key(self):
+        rs = np.random.RandomState(2)
+        params = {"blocks": {"w": jnp.asarray(rs.randn(4, 64, 32).astype(np.float32))},
+                  "wte": jnp.asarray(rs.randn(100, 32).astype(np.float32))}
+        qt = quantize_tree(params, groups=8, key=jax.random.PRNGKey(0))
+        deq = maybe_dequantize(qt["blocks"]["w"], jnp.float32)
+        assert deq.shape == (4, 64, 32)
+        # embeddings stay unquantized (cast only)
+        assert qt["wte"].dtype == jnp.bfloat16
+
+
+class TestSTEStochastic:
+    def test_sr_ste_grads_pass_through(self):
+        from deepspeed_tpu.compression import quantize_weight_ste
+
+        w = jnp.asarray(np.random.RandomState(3).randn(32, 16).astype(np.float32))
+        key = jax.random.PRNGKey(7)
+        qw = quantize_weight_ste(w, 6, True, key=key)
+        assert float(jnp.abs(qw - w).max()) > 0  # actually quantized
+        g = jax.grad(lambda w: jnp.sum(quantize_weight_ste(w, 6, True, key=key) ** 2))(w)
+        g_ref = 2.0 * np.asarray(quantize_weight_ste(w, 6, True, key=key))
+        np.testing.assert_allclose(np.asarray(g), g_ref, rtol=1e-5)
+
+    def test_moq_stochastic_rounding_schedule(self):
+        from deepspeed_tpu.runtime.quantize import Quantizer
+
+        q = Quantizer(q_start_bits=8, q_target_bits=4, q_period=10,
+                      q_rounding="stochastic")
+        params = {"w": jnp.asarray(np.random.RandomState(4).randn(64, 32).astype(np.float32))}
+        a = q.quantize_params(params, step=100)
+        b = q.quantize_params(params, step=101)
+        assert a["w"].shape == params["w"].shape
+        # fresh per-step keys: the SR noise differs step to step
+        assert float(jnp.abs(a["w"] - b["w"]).max()) > 0
+        # nearest mode stays deterministic
+        qn = Quantizer(q_start_bits=8, q_target_bits=4, q_period=10)
+        c = qn.quantize_params(params, step=100)
+        d = qn.quantize_params(params, step=101)
+        np.testing.assert_array_equal(np.asarray(c["w"]), np.asarray(d["w"]))

@@ -310,3 +310,96 @@ class TestDsConfigWiring:
             sparse_attention={"mode": "fixed", "block": 16},
         )
         assert cfg.attn_impl == "jnp" and cfg.sparsity is not None
+
+
+class TestSparseAttentionUtils:
+    """Model-integration helpers (reference sparse_attention_utils.py:1-225):
+    pad ragged inputs to block granularity, unpad outputs, extend the
+    position table, convert BERT to sparse attention."""
+
+    def test_pad_unpad_roundtrip(self):
+        from deepspeed_tpu.ops.sparse_attention import (
+            pad_to_block_size, unpad_sequence_output,
+        )
+
+        rs = np.random.RandomState(0)
+        ids = jnp.asarray(rs.randint(1, 100, (2, 100)).astype(np.int32))
+        am = jnp.ones((2, 100), jnp.int32)
+        tt = jnp.zeros((2, 100), jnp.int32)
+        pos = jnp.broadcast_to(jnp.arange(100), (2, 100))
+        pad_len, pids, pam, ptt, ppos = pad_to_block_size(64, ids, am, tt, pos, pad_token_id=0)
+        assert pad_len == 28
+        assert pids.shape == (2, 128)
+        np.testing.assert_array_equal(np.asarray(pids[:, :100]), np.asarray(ids))
+        assert int(pids[:, 100:].sum()) == 0  # pad token
+        assert int(pam[:, 100:].sum()) == 0  # padded keys masked out
+        np.testing.assert_array_equal(np.asarray(ppos[0, 100:]), np.arange(100, 128))
+        out = jnp.asarray(rs.randn(2, 128, 64).astype(np.float32))
+        assert unpad_sequence_output(pad_len, out).shape == (2, 100, 64)
+        # already-aligned input is a no-op
+        pl, i2, a2, t2, p2 = pad_to_block_size(64, pids, pam, ptt, ppos)
+        assert pl == 0 and i2 is pids
+
+    def test_ragged_bert_forward_ignores_pad_content(self):
+        """End-to-end: a ragged batch padded to block size runs through the
+        sparse-attention BERT, and the real positions' outputs don't depend
+        on what the pad positions contain (the attention_mask seals them)."""
+        from deepspeed_tpu.models import bert
+        from deepspeed_tpu.ops.sparse_attention import (
+            FixedSparsityConfig, pad_to_block_size, unpad_sequence_output,
+        )
+
+        cfg = bert.get_config(
+            "bert-tiny", attn_impl="sparse",
+            sparsity_config=FixedSparsityConfig(num_heads=4, block=16),
+        )
+        module = bert.make_module(cfg)
+        params = jax.jit(module.init)(jax.random.PRNGKey(0))
+        rs = np.random.RandomState(1)
+        ids = jnp.asarray(rs.randint(1, cfg.vocab_size, (2, 50)).astype(np.int32))
+        am = jnp.ones((2, 50), jnp.int32)
+        pad_len, pids, pam, _, _ = pad_to_block_size(16, ids, am, pad_token_id=0)
+        assert pids.shape[1] == 64
+        out1 = module.apply_fn(params, {"input_ids": pids, "attention_mask": pam})
+        # different pad content, same mask
+        pids2 = pids.at[:, 50:].set(7)
+        out2 = module.apply_fn(params, {"input_ids": pids2, "attention_mask": pam})
+        a = np.asarray(unpad_sequence_output(pad_len, out1))
+        b = np.asarray(unpad_sequence_output(pad_len, out2))
+        np.testing.assert_allclose(a, b, atol=1e-5)
+
+    def test_extend_position_embedding(self):
+        from deepspeed_tpu.models import bert
+        from deepspeed_tpu.ops.sparse_attention import extend_position_embedding
+
+        cfg = bert.get_config("bert-tiny")
+        params = jax.jit(bert.make_module(cfg).init)(jax.random.PRNGKey(0))
+        ext = extend_position_embedding(params, 256)
+        assert ext["wpe"].shape[0] == 256
+        # tiled: second window repeats the learned table
+        np.testing.assert_array_equal(
+            np.asarray(ext["wpe"][128:256]), np.asarray(ext["wpe"][:128])
+        )
+
+    def test_sparse_bert_module_builder(self):
+        from deepspeed_tpu.ops.sparse_attention import (
+            FixedSparsityConfig, sparse_bert_module,
+        )
+
+        sc = FixedSparsityConfig(num_heads=4, block=16)
+        cfg, module = sparse_bert_module("bert-tiny", sparsity_config=sc)
+        assert cfg.attn_impl == "sparse" and cfg.sparsity_config is sc
+        params = jax.jit(module.init)(jax.random.PRNGKey(0))
+        ids = jnp.asarray(np.random.RandomState(2).randint(1, cfg.vocab_size, (2, 64)).astype(np.int32))
+        out = module.apply_fn(params, {"input_ids": ids})
+        assert out.shape == (2, 64, cfg.n_embd)
+
+    def test_update_tokenizer_model_max_length(self):
+        from deepspeed_tpu.ops.sparse_attention import update_tokenizer_model_max_length
+
+        class Tok:
+            model_max_length = 512
+            init_kwargs = {}
+
+        t = update_tokenizer_model_max_length(Tok(), 4096)
+        assert t.model_max_length == 4096 and t.init_kwargs["model_max_length"] == 4096

