@@ -57,6 +57,11 @@ while true; do
     # --- 2. kernel CI ----------------------------------------------------
     run_step tb_flashbwd2 2400 env DS_TPU_TESTS=1 python -m pytest \
       "tests/unit/ops/test_tpu_hardware.py::TestFlashAttentionHardware" -q --tb=long || continue
+    # small-scale TPU smoke of the 13B path (hybrid spill + from_master +
+    # host_init + eager) so a hardware-only bug surfaces cheaply before the
+    # long rung burns an hour of window
+    run_step infinity_smoke 1800 env BENCH_EMBD=1024 BENCH_LAYERS=8 BENCH_SEQ=512 \
+      BENCH_STEPS=1 BENCH_OPT_DRAM_GB=0.1 python benchmarks/offload_bench.py infinity || continue
     # --- 3. MFU harvest --------------------------------------------------
     run_step bench_dots32 1800 env BENCH_MICRO=32 BENCH_REMAT=1 BENCH_REMAT_POLICY=dots python bench.py || continue
     run_step bench_attn16 1800 env BENCH_MICRO=16 BENCH_REMAT=1 BENCH_REMAT_POLICY=attn python bench.py || continue
