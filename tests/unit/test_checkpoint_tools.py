@@ -215,6 +215,26 @@ class Test2DReshape:
         for k in sd:
             assert np.array_equal(merged[k], np.asarray(sd[k])), k
 
+    def test_pp_split_with_prefixed_keys(self):
+        """Real Megatron checkpoints prefix the layer keys
+        (language_model.transformer.layers.N.) — renumbering must preserve
+        the prefix."""
+        from deepspeed_tpu.checkpoint.reshape import (
+            merge_pp_state_dicts, split_pp_state_dict,
+        )
+
+        pre = "language_model.transformer."
+        sd = {pre + f"layers.{i}.attention.dense.bias": np.full(4, float(i)) for i in range(4)}
+        sd["language_model.embedding.word_embeddings.weight"] = np.ones((8, 4))
+        stages = split_pp_state_dict(sd, pp=2)
+        assert pre + "layers.0.attention.dense.bias" in stages[1]  # local 0 = global 2
+        np.testing.assert_array_equal(
+            stages[1][pre + "layers.0.attention.dense.bias"], np.full(4, 2.0)
+        )
+        merged = merge_pp_state_dicts(stages)
+        for k in sd:
+            assert np.array_equal(merged[k], np.asarray(sd[k])), k
+
     @pytest.mark.parametrize("new_tp,new_pp", [(1, 4), (4, 1), (1, 2), (2, 4)])
     def test_2d_regrid(self, new_tp, new_pp):
         """tp2×pp2 grid → any target grid (including GROWING a degree),
@@ -277,6 +297,14 @@ class TestMegatronIngestion:
             # and it trains
             m = eng.train_batch(batch)
             assert np.isfinite(float(jax.device_get(m["loss"])))
+
+    def test_megatron_loader_rejects_unknown_keys(self):
+        from deepspeed_tpu.checkpoint.megatron_loader import megatron_to_gpt2_tree
+
+        with pytest.raises(KeyError, match="unmapped"):
+            megatron_to_gpt2_tree({"layers.0.attention.rotary_emb.inv_freq": np.ones(4)})
+        with pytest.raises(KeyError, match="unmapped"):
+            megatron_to_gpt2_tree({"some.unrelated.tensor": np.ones(4)})
 
     def test_megatron_into_infinity_engine(self, devices, mesh_single, tmp_path):
         """Ingestion into a param-offload (Infinity) engine, whose

@@ -360,6 +360,26 @@ class TestInfinityHybridTier:
         g2 = float(lazy.train_batch(b)["grad_norm"])
         np.testing.assert_allclose(g1, g2, rtol=1e-5)
 
+    def test_hybrid_lazy_path_matches_dram(self, mesh_single, tmp_path):
+        """gas=2 disengages eager: the accumulate-then-step path must drive
+        the hybrid split too (run_pipeline over the spilled subset + plain
+        loop over the DRAM-resident blocks)."""
+        cfg = _cfg(n_layer=4)
+        ref = DeepSpeedEngine(
+            gpt2.make_module(cfg), self._ds(str(tmp_path), opt_device="cpu", gas=2),
+            mesh=mesh_single, seed=0,
+        )
+        rec_gb = 3 * ref._infinity.block_numel * 4 / 1e9
+        hyb = DeepSpeedEngine(
+            gpt2.make_module(cfg),
+            self._ds(str(tmp_path), dram_budget_gb=2.5 * rec_gb, gas=2),
+            mesh=mesh_single, seed=0,
+        )
+        assert sorted(hyb._infinity._opt_nvme) == [2, 3]
+        l_hyb, l_ref = self._losses(hyb, cfg), self._losses(ref, cfg)
+        assert not hyb._infinity._eager
+        np.testing.assert_allclose(l_hyb, l_ref, rtol=1e-6)
+
     def test_eager_disengages_under_gas_or_clip(self, mesh_single, tmp_path):
         cfg = _cfg()
         eng = DeepSpeedEngine(
