@@ -82,6 +82,13 @@ while true; do
       run_step infinity13b 7200 env BENCH_EMBD=5120 BENCH_LAYERS=40 BENCH_STEPS=1 \
         python benchmarks/offload_bench.py infinity || continue
       collect
+      # stretch: ~13.9B (L44) is the most this host's DRAM+disk tiers hold
+      # (opt records 166 GB vs ~104 DRAM + ~75 disk); only with disk room
+      if [ "$(df --output=avail -k / | tail -1)" -gt 70000000 ]; then
+        run_step infinity14b 7200 env BENCH_EMBD=5120 BENCH_LAYERS=44 BENCH_STEPS=1 \
+          python benchmarks/offload_bench.py infinity || continue
+        collect
+      fi
     fi
     # --- 5. micro-bench recaptures + suite + final -----------------------
     run_step offload2 2400 python benchmarks/offload_bench.py offload || continue
