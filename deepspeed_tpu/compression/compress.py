@@ -131,6 +131,13 @@ def apply_compression(
             "embedding_quantization requires explicit 'modules' patterns "
             "naming the embedding tables (e.g. [\"wte\"])"
         )
+    # rounding: "nearest" (default) | "stochastic" — the reference's
+    # WEIGHT_QUANTIZE_ROUNDING knob (compression/constants.py:60). SR keys
+    # derive from (step, leaf index): fresh noise per step (unbiased across
+    # steps), bit-reproducible on same-step replay (checkpoint resume).
+    rounding = str(q.get("rounding", "nearest"))
+    assert rounding in ("nearest", "stochastic"), rounding
+    sr_base = jax.random.PRNGKey(step) if rounding == "stochastic" else None
     out = {}
     for path, leaf in flat:
         w = leaf
@@ -156,7 +163,12 @@ def apply_compression(
                 w, int(eq.get("bits", 8)), bool(eq.get("symmetric", True))
             )
         elif q_on and hasattr(w, "ndim") and w.ndim >= 2 and _matches(path, q.get("modules", [])):
-            w = quantize_weight_ste(w, int(q.get("bits", 8)), bool(q.get("symmetric", True)))
+            key = (
+                jax.random.fold_in(sr_base, len(out)) if sr_base is not None else None
+            )
+            w = quantize_weight_ste(
+                w, int(q.get("bits", 8)), bool(q.get("symmetric", True)), key=key
+            )
         out[path] = w
     # rebuild tree
     leaves_in_order = [out[p] for p, _ in flat]
@@ -166,7 +178,14 @@ def apply_compression(
 
 def redundancy_clean(params: PyTree, config: Dict[str, Any], masks: Dict[str, PyTree]) -> PyTree:
     """Bake all compression permanently into the weights (reference
-    redundancy_clean:127): final masked+quantized tree for export."""
+    redundancy_clean:127): final masked+quantized tree for export.
+
+    Always rounds to NEAREST: SR is a training-time de-biasing device; the
+    exported weights must be the deterministic grid values inference
+    expects, not a one-shot random draw."""
+    if config.get("weight_quantization", {}).get("rounding") == "stochastic":
+        config = dict(config)
+        config["weight_quantization"] = dict(config["weight_quantization"], rounding="nearest")
     return apply_compression(params, config, masks, step=10**12)
 
 

@@ -542,6 +542,45 @@ class TestCompression:
         # ln never touched
         assert np.array_equal(np.asarray(late["ln"]["scale"]), np.ones(8))
 
+    def test_stochastic_rounding_from_config(self):
+        """The reference WEIGHT_QUANTIZE_ROUNDING knob (compression/
+        constants.py:60): rounding="stochastic" engages SR — noise differs
+        step to step; "nearest" stays deterministic."""
+        from deepspeed_tpu.compression import apply_compression, init_compression
+
+        rs = np.random.RandomState(0)
+        params = {"mlp": {"w": jnp.asarray(rs.randn(16, 16).astype(np.float32))}}
+        cfg = {
+            "weight_quantization": {
+                "enabled": True, "bits": 4, "modules": ["mlp"],
+                "start_step": 0, "rounding": "stochastic",
+            },
+        }
+        masks = init_compression(params, cfg)
+        a = apply_compression(params, cfg, masks, step=1)
+        b = apply_compression(params, cfg, masks, step=2)
+        assert float(jnp.abs(a["mlp"]["w"] - b["mlp"]["w"]).max()) > 0
+        # same-step replay is bit-reproducible (checkpoint resume)
+        a2 = apply_compression(params, cfg, masks, step=1)
+        np.testing.assert_array_equal(np.asarray(a["mlp"]["w"]), np.asarray(a2["mlp"]["w"]))
+        # export bakes NEAREST even under SR config
+        from deepspeed_tpu.compression import redundancy_clean
+
+        baked = redundancy_clean(params, cfg, masks)
+        cfg_n = dict(cfg, weight_quantization=dict(cfg["weight_quantization"], rounding="nearest"))
+        baked_n = apply_compression(params, cfg_n, masks, step=10**12)
+        np.testing.assert_array_equal(
+            np.asarray(baked["mlp"]["w"]), np.asarray(baked_n["mlp"]["w"])
+        )
+        cfg["weight_quantization"]["rounding"] = "nearest"
+        c = apply_compression(params, cfg, masks, step=1)
+        d = apply_compression(params, cfg, masks, step=2)
+        np.testing.assert_array_equal(np.asarray(c["mlp"]["w"]), np.asarray(d["mlp"]["w"]))
+        # invalid values fail loudly
+        cfg["weight_quantization"]["rounding"] = "Stochastic"
+        with pytest.raises(AssertionError):
+            apply_compression(params, cfg, masks, step=1)
+
     def test_compression_in_training(self, mesh_dp8):
         """QAT through the engine: compressed forward trains and loss drops."""
         from deepspeed_tpu.compression import quantize_weight_ste
