@@ -6,7 +6,19 @@ from .engine import (
     read_latest_tag,
     save_train_state,
 )
-from .reshape import merge_tp_state_dicts, reshape_tp, split_tp_state_dict
+from .megatron_loader import (
+    gpt2_tree_to_megatron,
+    megatron_shards_to_gpt2_tree,
+    megatron_to_gpt2_tree,
+)
+from .reshape import (
+    merge_pp_state_dicts,
+    merge_tp_state_dicts,
+    reshape_2d,
+    reshape_tp,
+    split_pp_state_dict,
+    split_tp_state_dict,
+)
 from .universal_checkpoint import convert_to_universal, load_universal
 
 __all__ = [
@@ -14,11 +26,17 @@ __all__ = [
     "DeepSpeedCheckpoint",
     "OrbaxCheckpointEngine",
     "convert_to_universal",
+    "gpt2_tree_to_megatron",
     "load_train_state",
     "load_universal",
+    "megatron_shards_to_gpt2_tree",
+    "megatron_to_gpt2_tree",
+    "merge_pp_state_dicts",
     "merge_tp_state_dicts",
     "read_latest_tag",
+    "reshape_2d",
     "reshape_tp",
     "save_train_state",
+    "split_pp_state_dict",
     "split_tp_state_dict",
 ]

@@ -136,7 +136,10 @@ def apply_compression(
     # derive from (step, leaf index): fresh noise per step (unbiased across
     # steps), bit-reproducible on same-step replay (checkpoint resume).
     rounding = str(q.get("rounding", "nearest"))
-    assert rounding in ("nearest", "stochastic"), rounding
+    if rounding not in ("nearest", "stochastic"):
+        raise ValueError(
+            f"weight_quantization.rounding must be 'nearest' or 'stochastic', got {rounding!r}"
+        )
     sr_base = jax.random.PRNGKey(step) if rounding == "stochastic" else None
     out = {}
     for path, leaf in flat:

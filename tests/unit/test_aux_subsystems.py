@@ -576,9 +576,9 @@ class TestCompression:
         c = apply_compression(params, cfg, masks, step=1)
         d = apply_compression(params, cfg, masks, step=2)
         np.testing.assert_array_equal(np.asarray(c["mlp"]["w"]), np.asarray(d["mlp"]["w"]))
-        # invalid values fail loudly
+        # invalid values fail loudly (ValueError, -O-proof)
         cfg["weight_quantization"]["rounding"] = "Stochastic"
-        with pytest.raises(AssertionError):
+        with pytest.raises(ValueError, match="rounding"):
             apply_compression(params, cfg, masks, step=1)
 
     def test_compression_in_training(self, mesh_dp8):
