@@ -129,8 +129,19 @@ class PipelinedOptimizerSwapper(PartitionedOptimizerSwapper):
     def drain_writes(self) -> None:
         self.write_handle.wait()
         for gid in self._write_pending:
-            del self._buffers[gid]
+            # pop, not del: release() may already have dropped the buffer
+            # (an aborted step can leave a pending gid behind)
+            self._buffers.pop(gid, None)
         self._write_pending.clear()
+
+    def release(self, gid: int) -> None:
+        """Drop the staging buffer; if an async writeback of this record is
+        still in flight (aborted step), wait for it first — async_pwrite
+        holds only a raw pointer into the buffer."""
+        if gid in self._write_pending:
+            self.write_handle.wait()
+            self._write_pending.remove(gid)
+        super().release(gid)
 
     def run_pipeline(self, gids: List[int], step_fn: Callable[[int, List[np.ndarray]], None]) -> None:
         """Execute ``step_fn(gid, tensors)`` over every subgroup with swap

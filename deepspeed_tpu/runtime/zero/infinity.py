@@ -595,6 +595,11 @@ class InfinityEngine:
         self._eager_sq += float(np.dot(g, g))
         lr = self._eager_lr
         if i in self._opt_nvme:
+            # previous record's async writeback must land (and its staging
+            # buffer free) before this one stages — bounds DRAM to one
+            # in-flight record while the write overlaps the next blocks'
+            # device VJPs (the reference's writeback(i-1) pipeline stage)
+            self._opt_swapper.drain_writes()
             self._opt_swapper.swap_in(i)
             master, m, v = self._opt_swapper.tensors(i)
             self.opt.set_state(i, [m, v])
@@ -603,7 +608,7 @@ class InfinityEngine:
             if not self._param_from_master:
                 self._store_block_bf16(i, master.astype(self._cdt))
             del self.opt._m[i], self.opt._v[i]  # views into the record
-            self._opt_swapper.swap_out(i, release=True)
+            self._opt_swapper.swap_out(i, release=True, async_op=True)
         else:
             self.opt.step(self._blk_master[i], g, key=i, lr=lr)
             if not self._param_from_master:
@@ -725,6 +730,10 @@ class InfinityEngine:
             if coef != 1.0:
                 g = g * coef
             self.opt.step(m.reshape(-1), g, key=L + j, lr=lr)
+        if self._eager and self._opt_swapper is not None:
+            # flush the last async record writeback: no pending write (or
+            # its staging buffer) survives the step
+            self._opt_swapper.drain_writes()
         self._pers_dev = None  # refresh device copy next step
         self._g_pers_acc = None
         if self._trace_validator is not None:

@@ -430,6 +430,26 @@ class TestInfinityHybridTier:
             rtol=1e-5,
         )
 
+    def test_pending_async_write_survives_release_and_drain(self, tmp_path):
+        """Aborted-step hygiene: a pending async writeback followed by
+        release() must wait for the in-flight write (raw pointer into the
+        buffer) and a later drain must not KeyError on the released gid."""
+        from deepspeed_tpu.runtime.swap_tensor.partitioned_optimizer_swapper import (
+            PipelinedOptimizerSwapper,
+        )
+
+        sw = PipelinedOptimizerSwapper(str(tmp_path), n_tensors=3)
+        vals = np.arange(4096, dtype=np.float32)
+        sw.initialize_subgroup(0, [vals, vals * 2, vals * 3])
+        master, m, v = sw.tensors(0)
+        master += 1.0
+        sw.swap_out(0, release=True, async_op=True)
+        assert sw._write_pending == [0]
+        sw.release(0)  # waits for the write, then drops the buffer
+        assert not sw._buffers and not sw._write_pending
+        sw.drain_writes()  # no KeyError on the already-released gid
+        np.testing.assert_array_equal(sw.read_tensor_slot(0, 0), vals + 1.0)
+
     def test_read_tensor_slot_partial_read(self, tmp_path):
         from deepspeed_tpu.runtime.swap_tensor.partitioned_optimizer_swapper import (
             PipelinedOptimizerSwapper,
