@@ -298,6 +298,21 @@ class TestMegatronIngestion:
             m = eng.train_batch(batch)
             assert np.isfinite(float(jax.device_get(m["loss"])))
 
+    def test_converter_roundtrip_identity(self, mesh_single):
+        """gpt2 tree → megatron dict → gpt2 tree is the identity (transposes
+        and stacking invert exactly)."""
+        from deepspeed_tpu.checkpoint.megatron_loader import (
+            gpt2_tree_to_megatron, megatron_to_gpt2_tree,
+        )
+
+        _, src = self._gpt2_engine(mesh_single, dp=1)
+        ref = jax.device_get(src.params)
+        back = megatron_to_gpt2_tree(gpt2_tree_to_megatron(ref))
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+            ref, back,
+        )
+
     def test_megatron_loader_rejects_unknown_keys(self):
         from deepspeed_tpu.checkpoint.megatron_loader import megatron_to_gpt2_tree
 
