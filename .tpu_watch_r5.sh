@@ -18,6 +18,9 @@
 # must not run while a timing step owns the one host core.
 cd /root/repo || exit 1
 log() { echo "[$(date +%H:%M:%S)] $*" >> .tpu_watch_r5.log; }
+# never leak the busy marker: a stale one makes every later bench.py burn
+# its backend budget waiting (bench.py also ignores markers older than 2h)
+trap 'rm -f .tpu_busy' EXIT
 
 run_step() { # name, timeout, cmd...
   local name="$1" t="$2"; shift 2
@@ -27,7 +30,9 @@ run_step() { # name, timeout, cmd...
   fi
   log "run $name"
   touch .tpu_busy
-  timeout "$t" "$@" > "$out" 2>&1
+  # DS_WATCHER_CHILD: our own bench.py rungs must not wait on the marker
+  # their parent holds
+  DS_WATCHER_CHILD=1 timeout "$t" "$@" > "$out" 2>&1
   local rc=$?
   rm -f .tpu_busy
   log "done $name rc=$rc"
@@ -49,7 +54,23 @@ run_step() { # name, timeout, cmd...
 collect() { timeout 300 python benchmarks/collect_r4.py >> .tpu_watch_r5.log 2>&1; }
 
 while true; do
-  if bash .tpu_probe.sh 90; then
+  # a foreign bench.py (the driver's round-end run) owns the chip: stand
+  # down — even the tiny probe matmul can wedge an in-flight session. Our
+  # own rungs can't match here (they only run inside run_step, not while
+  # this probe loop is active); the loose pattern also catches python3 /
+  # absolute-path / offload_bench invocations.
+  if pgrep -f "bench\.py" >/dev/null 2>&1; then
+    log "foreign bench.py on the chip; standing down"
+    sleep 240
+    continue
+  fi
+  # hold the marker across the probe too: closes the race where a foreign
+  # bench.py starts inside the probe's 90s window seeing neither signal
+  touch .tpu_busy
+  probe_ok=0
+  bash .tpu_probe.sh 90 && probe_ok=1
+  rm -f .tpu_busy
+  if [ "$probe_ok" = 1 ]; then
     log "tunnel alive"
     # --- 1. headline -----------------------------------------------------
     run_step bench_tuned20 2400 env BENCH_STEPS=20 python bench.py || continue

@@ -231,6 +231,25 @@ def _await_backend() -> tuple[bool, str, int]:
     budget = float(os.environ.get("BENCH_BACKEND_WAIT", "1200"))
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "150"))
     deadline = time.monotonic() + budget
+    # the chip is single-tenant: a capture-watcher rung in flight (marked by
+    # .tpu_busy next to this script) must finish before we probe — two
+    # concurrent processes deadlock the relay. Waits within the same budget.
+    # The watcher's OWN rungs set DS_WATCHER_CHILD (they hold the marker
+    # themselves); a marker older than 2h is stale (killed watcher) and
+    # ignored.
+    busy_marker = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".tpu_busy")
+
+    def _busy():
+        if os.environ.get("DS_WATCHER_CHILD"):
+            return False
+        try:
+            return time.time() - os.path.getmtime(busy_marker) < 7200
+        except OSError:
+            return False
+
+    while _busy() and time.monotonic() < deadline:
+        sys.stderr.write("[bench] waiting for in-flight capture rung (.tpu_busy)\n")
+        time.sleep(30)
     attempts, sleep_s, msg = 0, 15.0, ""
     while True:
         attempts += 1
