@@ -55,11 +55,18 @@ collect() { timeout 300 python benchmarks/collect_r4.py >> .tpu_watch_r5.log 2>&
 
 while true; do
   # a foreign bench.py (the driver's round-end run) owns the chip: stand
-  # down — even the tiny probe matmul can wedge an in-flight session. Our
-  # own rungs can't match here (they only run inside run_step, not while
-  # this probe loop is active); the loose pattern also catches python3 /
-  # absolute-path / offload_bench invocations.
-  if pgrep -f "bench\.py" >/dev/null 2>&1; then
+  # down — even the tiny probe matmul can wedge an in-flight session. Only
+  # SHORT cmdlines count: the session-harness wrapper quotes "bench.py"
+  # inside a ~15 KB prompt string and must not trip this forever. Our own
+  # rungs can't match here (they only run inside run_step, not while this
+  # probe loop is active).
+  foreign=0
+  for pid in $(pgrep -f "bench\.py" 2>/dev/null); do
+    f="/proc/$pid/cmdline"
+    [ -r "$f" ] || continue
+    if [ "$(wc -c < "$f")" -lt 300 ]; then foreign=1; break; fi
+  done
+  if [ "$foreign" = 1 ]; then
     log "foreign bench.py on the chip; standing down"
     sleep 240
     continue
