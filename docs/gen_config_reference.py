@@ -92,6 +92,20 @@ def main():
         "",
     ]
     emit(C.DeepSpeedConfig, "(top level)", out, set())
+    out += [
+        "## `compression_training` (dict-schema section)",
+        "",
+        "Consumed by `deepspeed_tpu.compression` (not a dataclass — the "
+        "reference's dict schema is kept as-is). Technique sections: "
+        "`weight_quantization` (`bits`, `symmetric`, `modules`, "
+        "`start_step`/`end_step`, `rounding`: `nearest` | `stochastic` — "
+        "unbiased SR for low-bit QAT; exports always bake nearest), "
+        "`embedding_quantization`, `activation_quantization`, "
+        "`sparse_pruning`, `row_pruning`, `head_pruning`, "
+        "`channel_pruning`. See `compression/compress.py` and "
+        "`tests/unit/test_aux_subsystems.py` for working configs.",
+        "",
+    ]
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "CONFIG.md")
     with open(path, "w") as f:
         f.write("\n".join(out) + "\n")
