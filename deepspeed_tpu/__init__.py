@@ -18,7 +18,7 @@ from __future__ import annotations
 
 from typing import Any, Optional, Tuple
 
-__version__ = "0.1.0"
+__version__ = "0.5.0"
 __git_branch__ = "main"
 
 from . import comm  # noqa: F401

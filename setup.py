@@ -2,7 +2,7 @@ from setuptools import find_packages, setup
 
 setup(
     name="deepspeed_tpu",
-    version="0.1.0",
+    version="0.5.0",
     description="TPU-native large-model training & inference framework (DeepSpeed-capability, JAX/XLA/Pallas)",
     packages=find_packages(include=["deepspeed_tpu", "deepspeed_tpu.*"]),
     python_requires=">=3.10",
