@@ -80,7 +80,7 @@ while true; do
   if [ "$probe_ok" = 1 ]; then
     log "tunnel alive"
     # --- 1. headline -----------------------------------------------------
-    run_step bench_tuned20 2400 env BENCH_STEPS=20 python bench.py || continue
+    run_step bench_tuned20 3600 env BENCH_STEPS=20 python bench.py || continue
     collect
     # --- 2. kernel CI ----------------------------------------------------
     run_step tb_flashbwd2 2400 env DS_TPU_TESTS=1 python -m pytest \
@@ -121,12 +121,12 @@ while true; do
     # --- 5. micro-bench recaptures + suite + final -----------------------
     run_step offload2 2400 python benchmarks/offload_bench.py offload || continue
     run_step fused_adam2 1800 python benchmarks/fused_adam_bench.py || continue
-    run_step flash_sweep2 2400 python benchmarks/flash_sweep.py || continue
+    run_step flash_sweep2 3600 python benchmarks/flash_sweep.py || continue
     run_step inf_bert2 1800 python benchmarks/inference_bench.py bert || continue
     run_step inf_decode_prof 1800 env BENCH_PROFILE=.prof_dec python benchmarks/inference_bench.py decode || continue
     run_step profile_attr_dec 300 python benchmarks/profile_attr.py .prof_dec || continue
     run_step tpu_suite2 3600 env DS_TPU_TESTS=1 python -m pytest tests/ -m tpu -q --tb=short || continue
-    run_step bench_final 2400 python bench.py || continue
+    run_step bench_final 3600 python bench.py || continue
     run_step bench_profile2 2400 env BENCH_PROFILE=.prof_r5 python bench.py || continue
     run_step profile_attr2 300 python benchmarks/profile_attr.py .prof_r5 || continue
     collect
