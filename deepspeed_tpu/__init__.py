@@ -22,6 +22,7 @@ __version__ = "0.5.0"
 __git_branch__ = "main"
 
 from . import comm  # noqa: F401
+from . import telemetry  # noqa: F401
 from .comm.comm import init_distributed  # noqa: F401
 from .module_inject import (  # noqa: F401
     replace_transformer_layer,
@@ -107,6 +108,9 @@ def initialize(
         engine.monitor = monitor if monitor.enabled else None
     except Exception:
         engine.monitor = None
+    if engine.monitor is not None and engine.telemetry is not None:
+        # registry gauges fan out to every Monitor backend at steps_per_print
+        engine.telemetry.attach_monitor(engine.monitor)
 
     return engine, engine.optimizer, engine.training_dataloader, engine.lr_schedule
 

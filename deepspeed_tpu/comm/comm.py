@@ -75,6 +75,16 @@ class CommsLogger:
         )
         rec["count"] += 1
         rec["bytes"] += nbytes
+        if rec["world"] is None:
+            # called at trace time with the mesh axis in scope: psum of a
+            # literal constant folds to the axis size (no HLO emitted), so
+            # the summary's world/busbw columns are right without measure()
+            try:
+                from jax import lax
+
+                rec["world"] = int(lax.psum(1, axis))
+            except Exception:
+                pass
         if self.verbose:
             log_dist(f"comm op: {op_name} | axis: {axis} | bytes: {nbytes}")
 
@@ -163,27 +173,57 @@ class CommsLogger:
         finally:
             self.enabled = prev_enabled
 
+    # nominal per-chip interconnect bus bandwidth (GB/s) by TPU generation,
+    # used to ESTIMATE latency/bandwidth for rows recorded at trace time but
+    # never measured ("~"-prefixed columns); override with
+    # DS_COMM_ASSUMED_BUSBW_GBPS. ICI per-chip order-of-magnitude figures.
+    ASSUMED_BUSBW_GBPS = {"v4": 90.0, "v5e": 45.0, "v5p": 180.0, "v6e": 180.0}
+
+    @classmethod
+    def _assumed_busbw_gbps(cls) -> float:
+        env = os.environ.get("DS_COMM_ASSUMED_BUSBW_GBPS")
+        if env:
+            return float(env)
+        gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+        return cls.ASSUMED_BUSBW_GBPS.get(gen, 45.0)
+
     def log_summary(self) -> str:
         """Reference-style per-op table (utils/comms_logging.py:56 columns:
-        op, size, count, avg latency, algbw, busbw). Returns the rendered
-        text (also logged)."""
+        op, size, count, world, avg latency, algbw, busbw). Measured rows
+        (after :meth:`measure`) show exact numbers; trace-time-only rows show
+        "~"-prefixed estimates from the nominal interconnect bandwidth so the
+        table always matches the reference output shape. Returns the
+        rendered text (also logged)."""
         lines = ["Communication summary (per traced step):"]
         header = (
-            f"  {'op':<16s}{'axis':<8s}{'count':>6s}{'msg size':>12s}"
+            f"  {'op':<16s}{'axis':<10s}{'count':>6s}{'world':>7s}{'msg size':>12s}"
             f"{'avg lat(ms)':>13s}{'algbw(GB/s)':>13s}{'busbw(GB/s)':>13s}"
         )
         lines.append(header)
         for (op, axis), rec in sorted(self.comms_dict.items()):
             per_call = rec["bytes"] / max(1, rec["count"])
             lat = rec.get("time_ms")
+            world = rec.get("world")
+            factor = self._bus_factor(op, world or 1)
             if lat:
                 algbw = per_call / (lat / 1e3) / 1e9
-                busbw = algbw * self._bus_factor(op, rec.get("world") or 1)
+                busbw = algbw * factor
                 lat_s, alg_s, bus_s = f"{lat:.3f}", f"{algbw:.2f}", f"{busbw:.2f}"
+            elif per_call > 0:
+                # estimate from the nominal bus bandwidth: on-wire bytes are
+                # per_call * busbw-factor, so est busbw == the assumed figure
+                # and algbw/latency follow from it
+                bw = self._assumed_busbw_gbps() * 1e9
+                est_lat_s = max(per_call * factor / bw, 1e-9)
+                algbw = per_call / est_lat_s / 1e9
+                lat_s = f"~{est_lat_s * 1e3:.3f}"
+                alg_s = f"~{algbw:.2f}"
+                bus_s = f"~{algbw * factor:.2f}"
             else:
                 lat_s = alg_s = bus_s = "-"
             lines.append(
-                f"  {op:<16s}{axis:<8s}{rec['count']:>6d}{per_call / 1e6:>10.2f}MB"
+                f"  {op:<16s}{axis:<10s}{rec['count']:>6d}"
+                f"{world if world else '-':>7}{per_call / 1e6:>10.2f}MB"
                 f"{lat_s:>13s}{alg_s:>13s}{bus_s:>13s}"
             )
         text = "\n".join(lines)
@@ -222,6 +262,22 @@ _HLO_COLLECTIVES = (
     "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
     "collective-permute",
 )
+
+
+def _replica_group_size(line: str) -> Optional[int]:
+    """Participant count of a collective from its HLO ``replica_groups``
+    attribute — both the explicit ``{{0,1},{2,3}}`` form and the iota
+    ``[groups,size]<=[n]`` form (group size is the second dim)."""
+    import re
+
+    m = re.search(r"replica_groups=\[(\d+),(\d+)\]<=", line)
+    if m:
+        return int(m.group(2))
+    m = re.search(r"replica_groups=\{\{([^}]*)\}", line)
+    if m:
+        ids = [t for t in m.group(1).split(",") if t.strip()]
+        return len(ids) or None
+    return None
 
 
 def record_from_compiled(compiled, reset: bool = False) -> dict:
@@ -287,6 +343,9 @@ def record_from_compiled(compiled, reset: bool = False) -> dict:
         rec = found.setdefault(key, {"count": 0, "bytes": 0})
         rec["count"] += 1
         rec["bytes"] += nbytes
+        world = _replica_group_size(line)
+        if world:
+            rec["world"] = max(world, rec.get("world") or 0)
     was_enabled = comms_logger.enabled
     comms_logger.enabled = True
     for (op, axis), rec in found.items():
@@ -295,6 +354,8 @@ def record_from_compiled(compiled, reset: bool = False) -> dict:
         )
         entry["count"] += rec["count"]
         entry["bytes"] += rec["bytes"]
+        if entry["world"] is None and rec.get("world"):
+            entry["world"] = rec["world"]
     comms_logger.enabled = was_enabled
     return found
 

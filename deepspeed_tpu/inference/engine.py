@@ -31,6 +31,7 @@ from our own checkpoints, like the reference serving DeepSpeed-MoE ckpts.)
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Dict, Optional
 
 import jax
@@ -110,6 +111,7 @@ class InferenceEngine:
         cfg_max = c.pop("max_out_tokens", c.pop("max_tokens", None))
         cfg_ckpt = c.pop("checkpoint", None)
         q = c.pop("quantization_setting", None)
+        cfg_tel = c.pop("telemetry", None)
 
         mp_size = int(mp_size if mp_size is not _UNSET else (cfg_mp or 1))
         ep_size = int(ep_size if ep_size is not _UNSET else (cfg_ep or 1))
@@ -167,6 +169,19 @@ class InferenceEngine:
         self.policy = ZeroShardingPolicy(mesh, stage=0)  # TP-only weight sharding
         self.model_config = None
         self._generate_cache: Dict = {}
+        # unified telemetry plane (same TelemetryConfig schema as training;
+        # config={"telemetry": {...}} — per-request JSONL records + registry)
+        self.telemetry = None
+        self._infer_steps = 0
+        if cfg_tel is not None:
+            from ..runtime.config import TelemetryConfig
+            from ..telemetry import from_config as _tel_from_config
+
+            tcfg = (
+                TelemetryConfig.from_dict(cfg_tel)
+                if isinstance(cfg_tel, dict) else cfg_tel
+            )
+            self.telemetry = _tel_from_config(tcfg)
 
         kind = None
         if checkpoint is not None and (model is not None or params is not None):
@@ -279,9 +294,41 @@ class InferenceEngine:
 
     def forward(self, batch: PyTree):
         """Compiled forward (reference engine.forward:515)."""
+        if self.telemetry is not None:
+            # count only — no sync, so the serving hot path stays async
+            self.telemetry.registry.counter(
+                "inference_forward_total", "compiled forward calls"
+            ).inc()
         return self._forward(self.params, batch)
 
     __call__ = forward
+
+    def _telemetry_generate(self, duration_s: float, batch: int, prompt_len: int, new_tokens: int, cached: Optional[bool]) -> None:
+        """One JSONL record + registry fold per generate() call (generate
+        already blocks on its output, so sampling adds no extra sync).
+        ``cached`` is None on the full-prefix-recompute fallback, which has
+        no compiled-generate cache to hit."""
+        self._infer_steps += 1
+        tel = self.telemetry
+        if not tel.should_sample(self._infer_steps):
+            return
+        tok_s = batch * new_tokens / duration_s if duration_s > 0 else 0.0
+        from ..telemetry import device_hbm_stats
+
+        tel.record_step(
+            "inference",
+            step=self._infer_steps,
+            duration_s=duration_s,
+            scalars={
+                "batch": batch,
+                "prompt_tokens": prompt_len,
+                "new_tokens": new_tokens,
+                "tokens_per_sec": round(tok_s, 3),
+            },
+            spans=[("generate", duration_s * 1e3)],
+            hbm=device_hbm_stats(),
+            extra={} if cached is None else {"compiled_cache_hit": bool(cached)},
+        )
 
     def generate(
         self,
@@ -298,6 +345,7 @@ class InferenceEngine:
         (prefill + compiled lax.scan single-token steps); full-prefix
         recompute fallback otherwise. Returns prompt + new tokens."""
         ids = jnp.asarray(input_ids)
+        t_gen0 = time.perf_counter() if self.telemetry is not None else 0.0
         rng = jax.random.PRNGKey(seed)
         from ..models.decoder import DecoderConfig
         from ..models.gpt2 import GPT2Config
@@ -311,6 +359,7 @@ class InferenceEngine:
         if gen_mod is not None:
             key = (ids.shape, max_new_tokens, float(temperature), int(top_k), float(top_p))
             gen = self._generate_cache.get(key)
+            was_cached = gen is not None
             if gen is None:
                 cfg = self.model_config
                 cache_dtype = self.dtype
@@ -327,15 +376,28 @@ class InferenceEngine:
                 self._generate_cache[key] = gen
             new = gen(self.params, ids, rng)
             out = jnp.concatenate([ids, new.astype(ids.dtype)], axis=1)
-            return np.asarray(jax.device_get(out))
+            result = np.asarray(jax.device_get(out))
+            if self.telemetry is not None:
+                self._telemetry_generate(
+                    time.perf_counter() - t_gen0, int(ids.shape[0]),
+                    int(ids.shape[1]), int(max_new_tokens), was_cached,
+                )
+            return result
 
         # fallback: full-prefix recompute each token
         from ..ops.sampling import sample_logits
 
+        prompt_len = int(ids.shape[1])
         for _ in range(max_new_tokens):
             logits = self._forward(self.params, {"input_ids": ids})
             last = logits[:, -1, :].astype(jnp.float32)
             rng, k = jax.random.split(rng)
             nxt = sample_logits(last, k, temperature, top_k, top_p)
             ids = jnp.concatenate([ids, nxt[:, None].astype(ids.dtype)], axis=1)
-        return np.asarray(jax.device_get(ids))
+        result = np.asarray(jax.device_get(ids))
+        if self.telemetry is not None:
+            self._telemetry_generate(
+                time.perf_counter() - t_gen0, int(ids.shape[0]),
+                prompt_len, int(max_new_tokens), None,
+            )
+        return result

@@ -331,6 +331,29 @@ class DataTypesConfig(DSConfigModel):
 
 
 @dataclass
+class TelemetryConfig(DSConfigModel):
+    """telemetry section (TPU-native; no reference analog — subsumes the
+    reference's scattered observability: timer log lines, flops-profiler
+    stdout, comms_logging summaries, Monitor events all report through one
+    registry + step tracer, telemetry/__init__.py).
+
+    ``trace_path`` receives one JSONL record per sampled step per host;
+    ``prometheus_path`` (optional) an atomically-replaced ``.prom`` snapshot
+    for a node-exporter textfile collector. ``sample_every`` thins records —
+    each one blocks on the step's outputs to read scalars, so 1 serializes
+    the host loop with the device (fine for debugging, use 10-100 in
+    production). ``flush_interval`` is records per file append / Prometheus
+    rewrite. Disabled ⇒ nothing is constructed and ``train_batch`` adds no
+    host callbacks."""
+
+    enabled: bool = False
+    trace_path: str = "./telemetry"
+    prometheus_path: str = ""  # "" = no Prometheus snapshot
+    flush_interval: int = 20
+    sample_every: int = 1
+
+
+@dataclass
 class DebugConfig(DSConfigModel):
     """First-class debug modes (reference stage3.py safe_mode,
     zero/utils.py assert_ints_same_as_other_ranks, coordinator trace checks;
@@ -382,6 +405,7 @@ class DeepSpeedConfig(DSConfigModel):
     mesh: MeshConfig = field(default_factory=MeshConfig)
     tpu: TPUConfig = field(default_factory=TPUConfig)
     debug: DebugConfig = field(default_factory=DebugConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
 
     gradient_clipping: float = 0.0
     prescale_gradients: bool = False

@@ -24,6 +24,7 @@ surface: ``train_batch``, ``eval_batch``, ``save_checkpoint``,
 from __future__ import annotations
 
 import os
+import time
 from typing import Any, Callable, Dict, Iterable, Iterator, NamedTuple, Optional, Tuple
 
 import jax
@@ -205,6 +206,11 @@ class DeepSpeedEngine:
         self.wall_clock_breakdown = config.wall_clock_breakdown
         self.global_steps = 0  # host-side count of train_batch calls
         self.monitor = None  # wired by deepspeed_tpu.initialize when configured
+        # unified telemetry plane (registry + step tracer + exporters);
+        # None when disabled — train_batch pays one None check, no callbacks
+        from .. import telemetry as _telemetry
+
+        self.telemetry = _telemetry.from_config(config.telemetry)
         self._finish_init(model, config, training_data, collate_fn)
 
     def _init_param_offload(self, model, config, zcfg, seed, params) -> None:
@@ -502,14 +508,9 @@ class DeepSpeedEngine:
         torch.cuda.memory_allocated/cached printout). Returns the first
         addressable device's stats; logged each ``steps_per_print`` when
         config ``memory_breakdown`` is on."""
-        try:
-            stats = jax.local_devices()[0].memory_stats() or {}
-        except Exception:
-            stats = {}
-        return {
-            k: int(stats.get(k, 0))
-            for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
-        }
+        from ..telemetry import device_hbm_stats
+
+        return device_hbm_stats()
 
     # ------------------------------------------------------------------
     # 1-bit optimizer path (explicit compressed collectives via shard_map)
@@ -1230,11 +1231,15 @@ class DeepSpeedEngine:
                     self._data_iterator = iter(RepeatingLoader(self.training_dataloader))
                 data_iter = self._data_iterator
             batch = next(data_iter)
+        tel = self.telemetry
+        sampled = tel is not None and tel.should_sample(self.global_steps + 1)
+        t_start = time.perf_counter() if sampled else 0.0
         if self.wall_clock_breakdown:
             self.timers(TRAIN_BATCH_TIMER).start()
         self.tput_timer.start()
         batch = self._prepare_batch(batch)
         device_batch = self.shard_batch(batch)
+        t_prepared = time.perf_counter() if sampled else 0.0
         # the standard jitted step folds global_step into the key in-graph;
         # the host-driven paths (offload/onebit/infinity) still need a fresh
         # key per call
@@ -1242,17 +1247,26 @@ class DeepSpeedEngine:
             step_rng = self._rng
         else:
             self._rng, step_rng = jax.random.split(self._rng)
-        if self._step_arg_structs is None:
+        if self._step_arg_structs is None or (
+            sampled
+            and getattr(self, "_step_structs_key", -1) != self._jit_step_programs()
+        ):
             # abstract arg specs kept for HLO-level comms accounting
-            # (comms_summary) without holding real buffers alive
+            # (comms_summary) without holding real buffers alive; recaptured
+            # on the sampled step after a retrace (curriculum seqlen change,
+            # new batch shape) so comm bytes re-derive from the CURRENT
+            # program — and only then, so steady-state sampled steps skip
+            # the tree_map
             self._step_arg_structs = jax.tree.map(
                 lambda x: jax.ShapeDtypeStruct(
                     x.shape, x.dtype, sharding=getattr(x, "sharding", None)
                 ),
                 (self.state, device_batch, step_rng),
             )
+            self._step_structs_key = self._jit_step_programs()
         self.state, metrics = self._train_step(self.state, device_batch, step_rng)
         self.global_steps += 1
+        t_dispatched = time.perf_counter() if sampled else 0.0
         nan_flag = metrics.pop("nan_in_grads", None) if isinstance(metrics, dict) else None
         if nan_flag is not None and bool(jax.device_get(nan_flag)):
             raise RuntimeError(
@@ -1266,7 +1280,12 @@ class DeepSpeedEngine:
             )
         if self.wall_clock_breakdown:
             self.timers(TRAIN_BATCH_TIMER).stop(sync_tree=metrics)
-        self.tput_timer.stop(sync_tree=None)
+        # block on the step's outputs before stopping the throughput clock:
+        # XLA dispatches asynchronously, so stopping on dispatch-return would
+        # inflate samples/sec by the whole device step time
+        self.tput_timer.stop(sync_tree=metrics)
+        if sampled:
+            self._telemetry_step(tel, metrics, t_start, t_prepared, t_dispatched)
 
         if self.global_steps % self.steps_per_print == 0:
             host = {k: float(v) for k, v in jax.device_get(metrics).items()}
@@ -1276,12 +1295,22 @@ class DeepSpeedEngine:
                 f"lr={host['lr']:.3e} gnorm={host['grad_norm']:.3f} scale={host['loss_scale']:.0f}"
             )
             if self.monitor is not None:
+                # legacy pair kept unconditionally: existing dashboards key
+                # on these tags
                 self.monitor.write_events(
                     [
                         ("Train/Samples/train_loss", host["loss"], self.global_steps),
                         ("Train/Samples/lr", host["lr"], self.global_steps),
                     ]
                 )
+                if tel is not None and tel.monitor_bridge is not None:
+                    # full registry fan-out to the TB/W&B/CSV backends;
+                    # refresh the step gauges from THIS step's values first —
+                    # with sample_every > steps_per_print the last sampled
+                    # values could be arbitrarily stale
+                    for k, v in host.items():
+                        tel.registry.gauge(f"train_{k}", f"last sampled {k}").set(v)
+                    tel.export_monitor(self.global_steps)
             if self.wall_clock_breakdown:
                 self.timers.log([TRAIN_BATCH_TIMER])
             if self.config.memory_breakdown:
@@ -1294,6 +1323,122 @@ class DeepSpeedEngine:
                     )
                 )
         return metrics
+
+    # ------------------------------------------------------------------
+    # telemetry (ISSUE 1 tentpole: registry + step tracer + exporters)
+    # ------------------------------------------------------------------
+    def _telemetry_step(self, tel, metrics, t_start, t_prepared, t_dispatched) -> None:
+        """Assemble and emit one telemetry step record (sampled steps only).
+
+        The ``device_get`` blocks on the step's outputs to read the scalars —
+        that sync is the cost of sampling; ``telemetry.sample_every``
+        amortizes it over unsampled steps, which add zero host callbacks."""
+        host = jax.device_get(metrics) if isinstance(metrics, dict) else {}
+        t_synced = time.perf_counter()
+        scalars = {}
+        for k, v in host.items():
+            try:
+                scalars[k] = float(v)
+            except (TypeError, ValueError):
+                pass
+        spans = [
+            ("prepare", (t_prepared - t_start) * 1e3),
+            ("dispatch", (t_dispatched - t_prepared) * 1e3),
+            ("sync", (t_synced - t_dispatched) * 1e3),
+        ]
+        self.timers.export_telemetry(tel.registry)
+        self.tput_timer.export_telemetry(tel.registry)
+        cache_size = getattr(self._train_step, "_cache_size", None)
+        if callable(cache_size):
+            try:
+                tel.registry.gauge(
+                    "jit_step_cache_size", "entries in the train step's jit cache"
+                ).set(cache_size())
+            except Exception:
+                pass
+        tel.record_step(
+            "train",
+            step=self.global_steps,
+            duration_s=t_synced - t_start,
+            scalars=scalars,
+            spans=spans,
+            hbm=self.memory_breakdown(),
+            comm_bytes=self._comm_bytes_by_axis(),
+            extra={"samples_per_sec": round(self.tput_timer.avg_samples_per_sec(), 3)},
+        )
+
+    def _jit_step_programs(self) -> int:
+        """Invalidation key for program-derived caches: the jitted step's
+        cache size grows exactly when a retrace compiles a new program."""
+        fn = getattr(self._train_step, "_cache_size", None)
+        try:
+            return fn() if callable(fn) else 0
+        except Exception:
+            return 0
+
+    def _record_step_comms(self) -> Dict:
+        """Merge the compiled train step's HLO collective mix into the comms
+        logger ONCE per program (repeat calls would double-count; a retrace
+        backs out the superseded program's rows and re-derives); returns the
+        current program's {(op, axis): {count, bytes}} mix."""
+        key = self._jit_step_programs()
+        found = getattr(self, "_step_comms_found", None)
+        if found is not None and getattr(self, "_step_comms_key", None) == key:
+            return found
+        assert self._step_arg_structs is not None, (
+            "comms accounting requires at least one train_batch() call"
+        )
+        if not hasattr(self._train_step, "lower"):
+            raise ValueError(
+                "comms accounting supports the standard jitted train step only "
+                "(offload/onebit/infinity paths run multiple programs per step)"
+            )
+        from ..comm import comm as dscomm
+
+        compiled = self._train_step.lower(*self._step_arg_structs).compile()
+        if found:
+            # back out the superseded program's contribution before merging
+            # the new one, keeping the shared logger's per-step semantics
+            for (op, axis), rec in found.items():
+                entry = dscomm.comms_logger.comms_dict.get((op, axis))
+                if entry is None:
+                    continue
+                entry["count"] -= rec["count"]
+                entry["bytes"] -= rec["bytes"]
+                if entry["count"] <= 0:
+                    del dscomm.comms_logger.comms_dict[(op, axis)]
+        found = dscomm.record_from_compiled(compiled)
+        self._step_comms_found = found
+        self._step_comms_key = key
+        self._comms_hlo_recorded = True
+        return found
+
+    def _comm_bytes_by_axis(self) -> Dict[str, int]:
+        """Per-axis collective byte totals of the compiled train step for the
+        telemetry record. Axes are mesh names where recoverable, else the
+        HLO buckets ``xla`` (sharding-inserted) / ``xla-loop`` (inside a
+        scan/while body, per-iteration counts) — see record_from_compiled.
+        Empty on the multi-program paths (offload/onebit/infinity).
+
+        Deriving the mix lowers + compiles the step program once per DISTINCT
+        program (the jit cache size is the invalidation key, so a retrace
+        re-derives); with the persistent compilation cache on, that re-lower
+        is cheap. The cost lands on the first sampled step of each program.
+        """
+        key = self._jit_step_programs()
+        cached = getattr(self, "_comm_bytes_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        out: Dict[str, int] = {}
+        try:
+            found = self._record_step_comms()
+        except Exception:
+            self._comm_bytes_cache = (key, out)
+            return out
+        for (_, axis), rec in found.items():
+            out[axis] = out.get(axis, 0) + int(rec["bytes"])
+        self._comm_bytes_cache = (key, out)
+        return out
 
     def profile_step(self, batch: PyTree, trace_dir: str, steps: int = 3) -> str:
         """Capture a ``jax.profiler`` trace (xplane/perfetto) around ``steps``
@@ -1391,22 +1536,9 @@ class DeepSpeedEngine:
         mesh (latency + algbw/busbw columns). Requires ≥1 train_batch call;
         with a persistent compilation cache the re-lower is cheap.
         """
-        assert self._step_arg_structs is not None, (
-            "comms_summary requires at least one train_batch() call"
-        )
-        if not hasattr(self._train_step, "lower"):
-            raise ValueError(
-                "comms_summary supports the standard jitted train step only "
-                "(offload/onebit/infinity paths run multiple programs per step)"
-            )
         from ..comm import comm as dscomm
 
-        if not getattr(self, "_comms_hlo_recorded", False):
-            # merge the compiled step's op mix once; repeat calls would
-            # double-count an unchanged program
-            compiled = self._train_step.lower(*self._step_arg_structs).compile()
-            dscomm.record_from_compiled(compiled)
-            self._comms_hlo_recorded = True
+        self._record_step_comms()
         if measure:
             dscomm.comms_logger.measure(self.mesh)
         return dscomm.log_summary()
@@ -1532,6 +1664,7 @@ class DeepSpeedEngine:
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None, client_state: Optional[Dict] = None, save_latest: bool = True):
         from ..checkpoint.engine import save_train_state
 
+        t_ckpt0 = time.perf_counter()
         tag = tag or f"global_step{self.get_global_step()}"
         self._checkpoint_tag_validation(tag)
         path = save_train_state(
@@ -1554,6 +1687,11 @@ class DeepSpeedEngine:
                     "checkpoint already holds the full weights)"
                 )
         log_dist(f"saved checkpoint: {path}")
+        if self.telemetry is not None:
+            self.telemetry.record_event(
+                "checkpoint_save", time.perf_counter() - t_ckpt0,
+                {"step": self.global_steps, "tag": tag, "path": str(path)},
+            )
         return path
 
     def save_16bit_model(self, save_dir: str, output_file: str = "pytorch_model.npz"):
@@ -1593,6 +1731,7 @@ class DeepSpeedEngine:
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None, load_optimizer_states: bool = True, load_lr_scheduler_states: bool = True):
         from ..checkpoint.engine import load_train_state
 
+        t_ckpt0 = time.perf_counter()
         state, client_state = load_train_state(
             load_dir, tag, self.state, self.state_shardings,
             load_optimizer_states=load_optimizer_states,
@@ -1608,6 +1747,11 @@ class DeepSpeedEngine:
             if npz is not None:
                 self._offload.load_state_dict(dict(np.load(npz)))
         log_dist(f"loaded checkpoint from {load_dir} (tag={tag or 'latest'})")
+        if self.telemetry is not None:
+            self.telemetry.record_event(
+                "checkpoint_load", time.perf_counter() - t_ckpt0,
+                {"step": self.global_steps, "tag": tag or "latest", "path": load_dir},
+            )
         return load_dir, client_state
 
     def load_megatron_checkpoint(self, shards) -> None:

@@ -1,0 +1,234 @@
+"""Unified telemetry plane: metrics registry + step tracer + exporters.
+
+The observability spine of the runtime (ISSUE 1 tentpole). One
+:class:`Telemetry` object per engine bundles:
+
+- :class:`~.registry.MetricsRegistry` — named counters/gauges/histograms fed
+  by the wall-clock/throughput timers, ``memory_breakdown()`` HBM stats,
+  trace-time ``CommsLogger`` totals and jax compile events;
+- :class:`~.tracer.StepTracer` — one structured JSONL record per sampled
+  train/inference step (span tree, loss/lr/gnorm, HBM, per-axis comm bytes);
+- exporters — Prometheus textfile snapshots and the MonitorBridge fan-out to
+  TensorBoard/W&B/CSV.
+
+Everything is opt-in via the ``telemetry`` config section
+(:class:`~deepspeed_tpu.runtime.config.TelemetryConfig`); a disabled config
+constructs nothing — the engine holds ``telemetry=None`` and pays only a
+None check per step.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from . import compile_stats
+from .exporters import MonitorBridge, PrometheusTextfileExporter
+from .registry import Counter, Gauge, Histogram, MetricsRegistry
+from .tracer import Span, StepTracer, aggregate_scalars, spans_to_tree
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "MonitorBridge", "PrometheusTextfileExporter",
+    "Span", "StepTracer", "Telemetry",
+    "aggregate_scalars", "device_hbm_stats", "from_config", "spans_to_tree",
+]
+
+# histogram buckets for step latency (seconds): tighter than the generic
+# defaults around the 10ms-10s band where train/decode steps live
+STEP_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+def device_hbm_stats() -> Dict[str, int]:
+    """First addressable device's HBM stats (zeros on backends without
+    memory_stats, e.g. CPU) — the ``memory_breakdown()`` source."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats() or {}
+    except Exception:
+        stats = {}
+    return {
+        k: int(stats.get(k, 0))
+        for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+    }
+
+
+class Telemetry:
+    """Per-engine telemetry bundle; construct via :func:`from_config`."""
+
+    def __init__(self, config, process_index: Optional[int] = None):
+        self.config = config
+        self.registry = MetricsRegistry()
+        self.tracer = (
+            StepTracer(
+                config.trace_path,
+                flush_interval=config.flush_interval,
+                sample_every=config.sample_every,
+                process_index=process_index,
+            )
+            if config.trace_path
+            else None
+        )
+        self.prometheus = (
+            PrometheusTextfileExporter(self.registry, config.prometheus_path)
+            if config.prometheus_path
+            else None
+        )
+        self.monitor_bridge: Optional[MonitorBridge] = None
+        self._records_since_export = 0
+        compile_stats.install(self.registry)
+
+    # -- wiring --------------------------------------------------------
+    def attach_monitor(self, monitor) -> None:
+        """Route the full registry through MonitorMaster's backends."""
+        self.monitor_bridge = MonitorBridge(self.registry, monitor)
+
+    # -- sampling ------------------------------------------------------
+    def should_sample(self, step: int) -> bool:
+        if self.tracer is not None:
+            return self.tracer.should_sample(step)
+        return step % max(1, self.config.sample_every) == 0
+
+    def force_sample(self) -> None:
+        if self.tracer is not None:
+            self.tracer.force_next()
+
+    # -- recording -----------------------------------------------------
+    def record_step(
+        self,
+        kind: str,
+        step: int,
+        duration_s: float,
+        scalars: Optional[Dict[str, float]] = None,
+        spans: Optional[List[Span]] = None,
+        hbm: Optional[Dict[str, int]] = None,
+        comm_bytes: Optional[Dict[str, float]] = None,
+        extra: Optional[Dict[str, Any]] = None,
+        aggregate: bool = False,
+    ) -> Dict[str, Any]:
+        """Fold one step into the registry and append its JSONL record.
+
+        ``kind`` labels the step family (``train`` / ``inference``);
+        ``scalars`` are step-level floats (loss, lr, …); ``spans`` a flat
+        (name, ms) list of host-side phases; ``comm_bytes`` per-mesh-axis
+        collective byte totals of the compiled step.
+        """
+        scalars = scalars or {}
+        self.registry.counter(
+            "steps_total", "executed steps", labelnames=("kind",)
+        ).inc(kind=kind)
+        self.registry.histogram(
+            "step_seconds", "end-to-end step latency", labelnames=("kind",),
+            buckets=STEP_BUCKETS,
+        ).observe(duration_s, kind=kind)
+        for k, v in scalars.items():
+            try:
+                self.registry.gauge(f"{kind}_{k}", f"last sampled {k}").set(float(v))
+            except (TypeError, ValueError):
+                pass
+        if hbm:
+            for k, v in hbm.items():
+                self.registry.gauge(f"hbm_{k}", "device 0 HBM (memory_stats)").set(v)
+        if comm_bytes:
+            g = self.registry.gauge(
+                "comm_bytes_per_step",
+                "collective payload per compiled step, by mesh axis",
+                labelnames=("axis",),
+            )
+            for axis, b in comm_bytes.items():
+                g.set(b, axis=axis)
+
+        dur_ms = duration_s * 1e3
+        record: Dict[str, Any] = {
+            "kind": f"{kind}_step",
+            "step": int(step),
+            "dur_ms": round(dur_ms, 3),
+            **{k: _as_float(v) for k, v in scalars.items()},
+            "spans": spans_to_tree(spans or [], dur_ms),
+            "hbm": hbm or {},
+            "comm_bytes": comm_bytes or {},
+        }
+        if extra:
+            record.update(extra)
+        if self.tracer is not None:
+            self.tracer.emit(record)
+            if aggregate:
+                agg = aggregate_scalars(
+                    {k: v for k, v in scalars.items() if _is_num(v)}
+                )
+                if agg is not None:
+                    self.tracer.emit_aggregate(
+                        {"kind": f"{kind}_step_aggregate", "step": int(step), **agg}
+                    )
+        self._maybe_export()
+        return record
+
+    def record_event(
+        self, kind: str, duration_s: float, extra: Optional[Dict[str, Any]] = None
+    ) -> None:
+        """Non-step events (checkpoint save/load, comms measurement, …):
+        a counter + summed-duration counter + one JSONL record."""
+        self.registry.counter(f"{kind}_total", f"{kind} events").inc()
+        self.registry.counter(
+            f"{kind}_seconds_total", f"summed {kind} wall time"
+        ).inc(duration_s)
+        if self.tracer is not None:
+            self.tracer.emit(
+                {"kind": kind, "dur_ms": round(duration_s * 1e3, 3), **(extra or {})}
+            )
+
+    # -- export --------------------------------------------------------
+    def _maybe_export(self) -> None:
+        self._records_since_export += 1
+        if self._records_since_export >= max(1, self.config.flush_interval):
+            self._records_since_export = 0
+            if self.prometheus is not None:
+                self.prometheus.export()
+
+    def export_monitor(self, step: int) -> int:
+        """Fan the registry's scalar samples to the Monitor backends; returns
+        the event count (0 when no monitor attached)."""
+        if self.monitor_bridge is None:
+            return 0
+        return self.monitor_bridge.export(step)
+
+    def flush(self) -> None:
+        if self.tracer is not None:
+            self.tracer.flush()
+        if self.prometheus is not None:
+            self.prometheus.export()
+
+    def close(self) -> None:
+        self.flush()
+        if self.tracer is not None:
+            self.tracer.close()
+
+
+def _is_num(v) -> bool:
+    try:
+        float(v)
+        return True
+    except (TypeError, ValueError):
+        return False
+
+
+def _as_float(v):
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return v
+
+
+def from_config(config, monitor=None, process_index: Optional[int] = None) -> Optional[Telemetry]:
+    """``TelemetryConfig`` → :class:`Telemetry`, or None when disabled (the
+    zero-overhead contract: nothing is constructed, no listener installed,
+    no file touched)."""
+    if config is None or not getattr(config, "enabled", False):
+        return None
+    tel = Telemetry(config, process_index=process_index)
+    if monitor is not None and getattr(monitor, "enabled", False):
+        tel.attach_monitor(monitor)
+    return tel

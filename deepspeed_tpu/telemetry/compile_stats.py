@@ -1,0 +1,84 @@
+"""Compile-pipeline statistics via ``jax.monitoring`` listeners.
+
+XLA compiles are the TPU analog of the reference's CUDA-extension JIT builds:
+invisible until they eat minutes of wall clock. jax publishes them on its
+monitoring bus (``/jax/core/compile/backend_compile_duration``,
+``/jax/compilation_cache/cache_hit|miss`` with the persistent cache on);
+this module subscribes once per process and forwards into whatever
+:class:`~deepspeed_tpu.telemetry.registry.MetricsRegistry` is currently
+installed — counters:
+
+- ``jit_compiles_total``            backend-compile events
+- ``jit_compile_seconds_total``     summed backend-compile wall time
+- ``jit_trace_seconds_total``       summed jaxpr-trace wall time
+- ``jit_cache_hits_total`` / ``jit_cache_misses_total``  persistent-cache outcome
+
+Listeners cannot be unregistered in jax (only globally cleared), so they are
+installed once and fan out to every live installed registry (a WeakSet —
+compiles are process-global, so a training and an inference engine in one
+process both see them, and a dropped engine's registry just falls out). With
+no sink installed the callbacks are a substring check and an empty loop —
+effectively free — and a disabled-telemetry process never installs them.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+
+from .registry import MetricsRegistry
+
+_lock = threading.Lock()
+_sinks: "weakref.WeakSet[MetricsRegistry]" = weakref.WeakSet()
+_listeners_registered = False
+
+
+def _on_event(event: str, **kw) -> None:
+    if "cache_hit" in event:
+        name = "jit_cache_hits_total"
+    elif "cache_miss" in event:
+        name = "jit_cache_misses_total"
+    else:
+        return
+    for reg in list(_sinks):
+        reg.counter(name).inc()
+
+
+def _on_duration(event: str, duration: float, **kw) -> None:
+    if "backend_compile" in event:
+        for reg in list(_sinks):
+            reg.counter("jit_compiles_total").inc()
+            reg.counter("jit_compile_seconds_total").inc(duration)
+    elif "trace" in event:
+        for reg in list(_sinks):
+            reg.counter("jit_trace_seconds_total").inc(duration)
+
+
+def install(registry: MetricsRegistry) -> None:
+    """Subscribe ``registry`` to the monitoring listeners (registering them
+    on first call). Declares the counters eagerly so a scrape before the
+    first compile still sees the families at 0."""
+    global _listeners_registered
+    with _lock:
+        for name, help in (
+            ("jit_compiles_total", "XLA backend compile events"),
+            ("jit_compile_seconds_total", "summed XLA backend compile wall time"),
+            ("jit_trace_seconds_total", "summed jaxpr trace wall time"),
+            ("jit_cache_hits_total", "persistent compilation cache hits"),
+            ("jit_cache_misses_total", "persistent compilation cache misses"),
+        ):
+            registry.counter(name, help)
+        _sinks.add(registry)
+        if not _listeners_registered:
+            import jax.monitoring as monitoring
+
+            monitoring.register_event_listener(_on_event)
+            monitoring.register_event_duration_secs_listener(_on_duration)
+            _listeners_registered = True
+
+
+def uninstall() -> None:
+    """Detach every sink (listeners stay registered but become no-ops;
+    jax.monitoring offers no targeted deregistration)."""
+    with _lock:
+        _sinks.clear()

@@ -1,0 +1,164 @@
+"""Structured step traces: one JSONL record per train/inference step.
+
+Each record is a self-contained JSON object (span tree + scalars + HBM +
+per-axis comm bytes) appended to a per-host file under ``trace_path``.
+Buffered writes (``flush_interval`` records per fsync-able append) keep the
+hot loop free of per-step filesystem syscalls; ``sample_every`` thins the
+record stream (and the device sync each record implies) for long runs.
+
+Rank-0 aggregation: on multi-host runs every host writes its own file;
+:func:`aggregate_scalars` all-gathers a record's scalar fields over
+``deepspeed_tpu.comm``'s process set and returns the cross-host mean on
+rank 0 (None elsewhere), which the tracer appends to ``trace-aggregate.jsonl``.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+Span = Tuple[str, float]  # (name, duration_ms); flat span list, parents first
+
+
+def _jsonable(v: Any) -> Any:
+    """Scalars only: device arrays / numpy types → python floats/ints."""
+    try:
+        import numpy as np
+
+        if isinstance(v, (np.generic,)):
+            return v.item()
+        if hasattr(v, "item") and getattr(v, "ndim", 1) == 0:
+            return v.item()
+    except Exception:
+        pass
+    return v
+
+
+def spans_to_tree(spans: List[Span], total_ms: float) -> Dict[str, Any]:
+    """Flat (name, ms) list → {name: ms} child map under a root span, with the
+    unattributed remainder reported as ``other`` (the span tree is one level
+    deep: the fused XLA step leaves no host-visible fwd/bwd boundary, so the
+    host-side phases — prepare/dispatch/sync — are the children)."""
+    children = {name: round(ms, 3) for name, ms in spans}
+    accounted = sum(ms for _, ms in spans)
+    if total_ms > accounted:
+        children["other"] = round(total_ms - accounted, 3)
+    return {"total_ms": round(total_ms, 3), "children": children}
+
+
+def aggregate_scalars(scalars: Dict[str, float]) -> Optional[Dict[str, float]]:
+    """Cross-host mean of a record's scalar fields (rank-0 aggregation over
+    the jax process set). Returns the aggregate on process 0, None on other
+    processes, and the input unchanged on single-host runs."""
+    import jax
+
+    if jax.process_count() == 1:
+        return dict(scalars)
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    keys = sorted(scalars)
+    vec = np.asarray([float(scalars[k]) for k in keys], np.float64)
+    gathered = multihost_utils.process_allgather(vec)
+    if jax.process_index() != 0:
+        return None
+    return {k: float(np.asarray(gathered)[:, i].mean()) for i, k in enumerate(keys)}
+
+
+class StepTracer:
+    """Append-only JSONL step-trace writer (per-host file)."""
+
+    def __init__(
+        self,
+        trace_path: str,
+        flush_interval: int = 20,
+        sample_every: int = 1,
+        process_index: Optional[int] = None,
+    ):
+        self.trace_path = trace_path
+        self.flush_interval = max(1, int(flush_interval))
+        self.sample_every = max(1, int(sample_every))
+        self._buffer: List[str] = []
+        self._force_next = False
+        self._closed = False
+        if process_index is None:
+            try:
+                import jax
+
+                process_index = jax.process_index()
+            except Exception:
+                process_index = 0
+        self.process_index = process_index
+        if trace_path.endswith(".jsonl"):
+            root, name = os.path.split(trace_path)
+            self._dir = root or "."
+            # explicit file: keep the name on host 0, suffix other hosts
+            self._file = (
+                os.path.join(self._dir, name)
+                if process_index == 0
+                else os.path.join(self._dir, f"{name[:-6]}-{process_index:05d}.jsonl")
+            )
+        else:
+            self._dir = trace_path
+            self._file = os.path.join(trace_path, f"trace-{process_index:05d}.jsonl")
+        self._agg_file = os.path.join(self._dir, "trace-aggregate.jsonl")
+        self._dir_made = False  # lazily: a tracer that never emits writes nothing
+        atexit.register(self.close)
+
+    # -- sampling ------------------------------------------------------
+    def should_sample(self, step: int) -> bool:
+        if self._force_next:
+            return True
+        return step % self.sample_every == 0
+
+    def force_next(self) -> None:
+        """Make the next step emit a record regardless of ``sample_every``
+        (bench.py uses this: zero-overhead timed loop, one recorded step)."""
+        self._force_next = True
+
+    # -- emission ------------------------------------------------------
+    def emit(self, record: Dict[str, Any]) -> None:
+        if str(record.get("kind", "")).endswith("_step"):
+            # only a step record consumes a pending force_next — an
+            # interleaved event (checkpoint save, …) must not cancel it
+            self._force_next = False
+        record.setdefault("ts", time.time())
+        record.setdefault("host", self.process_index)
+        clean = {k: _jsonable(v) for k, v in record.items()}
+        self._buffer.append(json.dumps(clean, default=str))
+        if len(self._buffer) >= self.flush_interval:
+            self.flush()
+
+    def emit_aggregate(self, record: Dict[str, Any]) -> None:
+        """Rank-0-only aggregated record (caller runs aggregate_scalars)."""
+        clean = {k: _jsonable(v) for k, v in record.items()}
+        self._ensure_dir()
+        with open(self._agg_file, "a") as fh:
+            fh.write(json.dumps(clean, default=str) + "\n")
+
+    def _ensure_dir(self) -> None:
+        if not self._dir_made:
+            os.makedirs(self._dir, exist_ok=True)
+            self._dir_made = True
+
+    def flush(self) -> None:
+        if not self._buffer:
+            return
+        self._ensure_dir()
+        with open(self._file, "a") as fh:
+            fh.write("\n".join(self._buffer) + "\n")
+        self._buffer = []
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self.flush()
+        self._closed = True
+        atexit.unregister(self.close)  # don't pin closed tracers for life
+
+    @property
+    def file_path(self) -> str:
+        return self._file

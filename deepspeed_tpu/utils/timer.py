@@ -36,6 +36,7 @@ class _Timer:
         self.started = False
         self.start_time = 0.0
         self.elapsed_ = 0.0
+        self.last_elapsed_ = 0.0
         self.count = 0
 
     def start(self, sync_tree: Any = None) -> None:
@@ -48,9 +49,14 @@ class _Timer:
             return
         _sync(sync_tree)
         if record:
-            self.elapsed_ += time.perf_counter() - self.start_time
+            self.last_elapsed_ = time.perf_counter() - self.start_time
+            self.elapsed_ += self.last_elapsed_
             self.count += 1
         self.started = False
+
+    def last(self) -> float:
+        """Duration of the most recent recorded interval (seconds)."""
+        return self.last_elapsed_
 
     def elapsed(self, reset: bool = True) -> float:
         value = self.elapsed_
@@ -104,6 +110,22 @@ class SynchronizedWallClockTimer:
             if name in self.timers
         }
 
+    def export_telemetry(self, registry) -> None:
+        """Feed every timer's running mean + last interval into a
+        MetricsRegistry (gauges ``timer_mean_ms`` / ``timer_last_ms``,
+        labeled by timer name). Non-destructive: nothing is reset, so the
+        periodic ``log()`` output is unchanged."""
+        mean_g = registry.gauge(
+            "timer_mean_ms", "wall-clock timer running mean", labelnames=("name",)
+        )
+        last_g = registry.gauge(
+            "timer_last_ms", "wall-clock timer last interval", labelnames=("name",)
+        )
+        for name, t in self.timers.items():
+            if t.count:
+                mean_g.set(t.mean() * 1e3, name=name)
+                last_g.set(t.last() * 1e3, name=name)
+
 
 class ThroughputTimer:
     """Samples/sec + tokens/sec meter; analog of reference ``timer.py:135``."""
@@ -152,3 +174,13 @@ class ThroughputTimer:
             steps = self.global_step_count - self.start_step
             return steps * self.batch_size / self.total_elapsed_time
         return 0.0
+
+    def export_telemetry(self, registry) -> None:
+        """Feed the throughput meter into a MetricsRegistry (gauge
+        ``throughput_samples_per_sec`` + counter-backed step count)."""
+        registry.gauge(
+            "throughput_samples_per_sec", "running average samples/sec"
+        ).set(self.avg_samples_per_sec())
+        registry.gauge(
+            "throughput_steps", "steps seen by the throughput meter"
+        ).set(self.global_step_count)

@@ -1,0 +1,72 @@
+"""Monitor backend tests (ISSUE 1 satellite): CSV event appends across
+multiple write_events calls, output directory creation, and the
+disabled-monitor never-touches-the-filesystem contract."""
+
+import csv
+import os
+
+from deepspeed_tpu.monitor.monitor import CsvMonitor, MonitorMaster
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, MonitorSubConfig
+
+
+def _read_csv(path):
+    with open(path, newline="") as fh:
+        return list(csv.reader(fh))
+
+
+def test_csv_monitor_appends_across_calls(tmp_path):
+    cfg = MonitorSubConfig(enabled=True, output_path=str(tmp_path), job_name="job")
+    mon = CsvMonitor(cfg)
+    mon.write_events([("Train/loss", 2.5, 1), ("Train/lr", 1e-3, 1)])
+    mon.write_events([("Train/loss", 2.0, 2)])
+    rows = _read_csv(os.path.join(str(tmp_path), "job", "Train_loss.csv"))
+    # header written once, rows appended in call order
+    assert rows[0] == ["step", "Train/loss"]
+    assert rows[1:] == [["1", "2.5"], ["2", "2.0"]]
+    lr_rows = _read_csv(os.path.join(str(tmp_path), "job", "Train_lr.csv"))
+    assert len(lr_rows) == 2  # header + one event
+
+
+def test_csv_monitor_creates_nested_output_dir(tmp_path):
+    nested = tmp_path / "a" / "b" / "c"
+    cfg = MonitorSubConfig(enabled=True, output_path=str(nested), job_name="run")
+    mon = CsvMonitor(cfg)
+    assert (nested / "run").is_dir()
+    mon.write_events([("m", 1.0, 0)])
+    assert (nested / "run" / "m.csv").exists()
+
+
+def test_disabled_csv_monitor_never_touches_filesystem(tmp_path):
+    target = tmp_path / "never"
+    cfg = MonitorSubConfig(enabled=False, output_path=str(target), job_name="job")
+    mon = CsvMonitor(cfg)
+    mon.write_events([("Train/loss", 1.0, 1)])
+    assert not mon.enabled
+    assert list(tmp_path.iterdir()) == []  # no dir, no file
+
+
+def test_monitor_master_all_disabled_is_noop(tmp_path):
+    ds = DeepSpeedConfig.load(
+        {"train_micro_batch_size_per_gpu": 1}, dp_world_size=1
+    )
+    master = MonitorMaster(ds)
+    assert not master.enabled
+    master.write_events([("x", 1.0, 0)])  # must not raise or write
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_monitor_master_csv_only(tmp_path):
+    ds = DeepSpeedConfig.load(
+        {
+            "train_micro_batch_size_per_gpu": 1,
+            "csv_monitor": {
+                "enabled": True, "output_path": str(tmp_path), "job_name": "j",
+            },
+        },
+        dp_world_size=1,
+    )
+    master = MonitorMaster(ds)
+    assert master.enabled and master.csv_monitor.enabled
+    master.write_events([("loss", 3.0, 7)])
+    rows = _read_csv(os.path.join(str(tmp_path), "j", "loss.csv"))
+    assert rows[-1] == ["7", "3.0"]

@@ -1,0 +1,287 @@
+"""Telemetry subsystem tests (ISSUE 1): registry counter/gauge/histogram
+semantics, Prometheus text round-trip through a minimal parser, JSONL step
+records from a real engine step, and the disabled-config short-circuit."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.telemetry import (
+    MetricsRegistry,
+    MonitorBridge,
+    StepTracer,
+    from_config,
+    spans_to_tree,
+)
+from deepspeed_tpu.runtime.config import TelemetryConfig
+
+
+def parse_prometheus(text):
+    """Minimal text-exposition parser: {'name{labels}': value} + type map."""
+    values, types = {}, {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# TYPE"):
+            _, _, name, kind = line.split()
+            types[name] = kind
+            continue
+        if line.startswith("#"):
+            continue
+        name, val = line.rsplit(" ", 1)
+        values[name] = float(val)
+    return values, types
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+def test_counter_semantics():
+    reg = MetricsRegistry()
+    c = reg.counter("requests_total", "total requests", labelnames=("kind",))
+    c.inc(kind="train")
+    c.inc(2, kind="train")
+    c.inc(kind="eval")
+    assert c.value(kind="train") == 3
+    assert c.value(kind="eval") == 1
+    assert c.value(kind="never") == 0
+    with pytest.raises(ValueError):
+        c.inc(-1, kind="train")  # counters only go up
+    with pytest.raises(ValueError):
+        c.inc(wrong_label="x")
+    # redeclaration returns the same family; kind clash raises
+    assert reg.counter("requests_total", labelnames=("kind",)) is c
+    with pytest.raises(ValueError):
+        reg.gauge("requests_total", labelnames=("kind",))
+
+
+def test_gauge_semantics():
+    reg = MetricsRegistry()
+    g = reg.gauge("hbm_bytes_in_use")
+    g.set(100)
+    g.set(42.5)
+    assert g.value() == 42.5
+    g.inc(7.5)
+    assert g.value() == 50.0
+
+
+def test_histogram_and_prometheus_roundtrip():
+    reg = MetricsRegistry()
+    h = reg.histogram("step_seconds", "latency", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 5.0, 50.0):
+        h.observe(v)
+    total, n = h.stats()
+    assert n == 4 and abs(total - 55.55) < 1e-9
+    reg.counter("steps_total").inc(4)
+    reg.gauge("loss").set(2.5)
+
+    values, types = parse_prometheus(reg.to_prometheus())
+    assert types == {
+        "loss": "gauge", "step_seconds": "histogram", "steps_total": "counter",
+    }
+    assert values["steps_total"] == 4
+    assert values["loss"] == 2.5
+    # cumulative buckets: 0.1 holds 1, 1.0 holds 2, 10.0 holds 3, +Inf all 4
+    assert values['step_seconds_bucket{le="0.1"}'] == 1
+    assert values['step_seconds_bucket{le="1.0"}'] == 2
+    assert values['step_seconds_bucket{le="10.0"}'] == 3
+    assert values['step_seconds_bucket{le="+Inf"}'] == 4
+    assert values["step_seconds_count"] == 4
+    assert abs(values["step_seconds_sum"] - 55.55) < 1e-9
+
+
+def test_prometheus_survives_nonfinite_values():
+    # a diverged loss (NaN/Inf gauge) must not crash the exporter
+    reg = MetricsRegistry()
+    reg.gauge("train_loss").set(float("nan"))
+    reg.gauge("g_inf").set(float("inf"))
+    text = reg.to_prometheus()
+    assert "train_loss nan" in text and "g_inf inf" in text
+
+
+def test_prometheus_label_escaping_and_textfile(tmp_path):
+    reg = MetricsRegistry()
+    reg.gauge("g", labelnames=("path",)).set(1, path='a"b\\c')
+    out = tmp_path / "nested" / "dir" / "metrics.prom"
+    reg.write_textfile(str(out))
+    text = out.read_text()
+    values, _ = parse_prometheus(text)
+    assert len(values) == 1 and list(values.values()) == [1.0]
+    # atomic write leaves no temp litter
+    assert os.listdir(out.parent) == ["metrics.prom"]
+
+
+# ---------------------------------------------------------------------------
+# tracer
+# ---------------------------------------------------------------------------
+
+def test_tracer_jsonl_and_flush(tmp_path):
+    tr = StepTracer(str(tmp_path / "traces"), flush_interval=2, sample_every=1)
+    tr.emit({"kind": "train_step", "step": 1, "loss": 1.0})
+    assert not os.path.exists(tr.file_path)  # buffered
+    tr.emit({"kind": "train_step", "step": 2, "loss": np.float32(0.5)})
+    recs = [json.loads(line) for line in open(tr.file_path)]
+    assert [r["step"] for r in recs] == [1, 2]
+    assert recs[1]["loss"] == 0.5  # numpy scalar serialized as a float
+    assert all("ts" in r and "host" in r for r in recs)
+    tr.emit({"kind": "train_step", "step": 3})
+    tr.close()  # close flushes the odd record
+    recs = [json.loads(line) for line in open(tr.file_path)]
+    assert len(recs) == 3
+
+
+def test_tracer_sampling_and_force(tmp_path):
+    tr = StepTracer(str(tmp_path), flush_interval=1, sample_every=10)
+    assert tr.should_sample(10) and tr.should_sample(20)
+    assert not tr.should_sample(1) and not tr.should_sample(11)
+    tr.force_next()
+    assert tr.should_sample(11)  # forced overrides the modulus
+    tr.emit({"kind": "train_step", "step": 11})
+    assert not tr.should_sample(11)  # force is one-shot
+
+
+def test_spans_to_tree():
+    tree = spans_to_tree([("prepare", 1.0), ("dispatch", 2.0)], total_ms=5.0)
+    assert tree["total_ms"] == 5.0
+    assert tree["children"]["prepare"] == 1.0
+    assert tree["children"]["other"] == 2.0  # unattributed remainder
+
+
+# ---------------------------------------------------------------------------
+# facade + exporters
+# ---------------------------------------------------------------------------
+
+def test_from_config_disabled_constructs_nothing(tmp_path):
+    cfg = TelemetryConfig(enabled=False, trace_path=str(tmp_path / "t"))
+    assert from_config(cfg) is None
+    assert from_config(None) is None
+    assert not (tmp_path / "t").exists()
+
+
+def test_record_step_and_monitor_bridge(tmp_path):
+    cfg = TelemetryConfig(
+        enabled=True, trace_path=str(tmp_path / "tr"),
+        prometheus_path=str(tmp_path / "m.prom"), flush_interval=1,
+    )
+    tel = from_config(cfg)
+    tel.record_step(
+        "train", step=1, duration_s=0.25,
+        scalars={"loss": 2.0, "lr": 1e-3},
+        spans=[("prepare", 10.0), ("dispatch", 200.0)],
+        hbm={"bytes_in_use": 100, "peak_bytes_in_use": 200},
+        comm_bytes={"dp": 4096},
+    )
+    tel.flush()
+    rec = json.loads(open(tel.tracer.file_path).readline())
+    assert rec["kind"] == "train_step" and rec["loss"] == 2.0
+    assert rec["comm_bytes"] == {"dp": 4096}
+    assert rec["hbm"]["peak_bytes_in_use"] == 200
+    values, _ = parse_prometheus(open(str(tmp_path / "m.prom")).read())
+    assert values['steps_total{kind="train"}'] == 1
+    assert values["train_loss"] == 2.0
+    assert values['comm_bytes_per_step{axis="dp"}'] == 4096
+
+    class FakeMonitor:
+        enabled = True
+
+        def __init__(self):
+            self.events = []
+
+        def write_events(self, ev):
+            self.events.extend(ev)
+
+    mon = FakeMonitor()
+    tel.attach_monitor(mon)
+    n = tel.export_monitor(step=1)
+    assert n == len(mon.events) > 0
+    tags = {t for t, _, _ in mon.events}
+    # full registry fan-out with monitor-safe tags (no braces/quotes)
+    assert "Telemetry/train_loss" in tags
+    assert "Telemetry/comm_bytes_per_step/axis=dp" in tags
+    assert all("{" not in t and '"' not in t for t in tags)
+    assert all(s == 1 for _, _, s in mon.events)
+
+
+def test_compile_stats_listener():
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.telemetry import compile_stats
+
+    reg = MetricsRegistry()
+    compile_stats.install(reg)
+    try:
+        jax.jit(lambda x: x * 3 + 41)(jnp.ones((8,)))  # fresh program
+        assert reg.counter("jit_compiles_total").value() >= 1
+        assert reg.counter("jit_compile_seconds_total").value() > 0
+    finally:
+        compile_stats.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# engine end-to-end (acceptance criteria)
+# ---------------------------------------------------------------------------
+
+def _build_engine(mesh, tmp_path, enabled):
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+    from .simple_model import base_config, make_simple_model, random_batches
+
+    ds = DeepSpeedConfig.load(
+        base_config(
+            stage=2, micro=2, gas=1,
+            telemetry={
+                "enabled": enabled,
+                "trace_path": str(tmp_path / "traces"),
+                "prometheus_path": str(tmp_path / "metrics.prom"),
+                "flush_interval": 1,
+                "sample_every": 1,
+            },
+        ),
+        dp_world_size=8,
+    )
+    engine = DeepSpeedEngine(make_simple_model(), ds, mesh=mesh, seed=0)
+    return engine, random_batches(1, engine.train_batch_size)[0]
+
+
+def test_engine_step_emits_record_and_prometheus(mesh_dp8, tmp_path):
+    """Acceptance: one train_batch with telemetry on emits a parseable JSONL
+    record with step latency, loss, HBM in-use/peak, and per-axis comm byte
+    totals; to_prometheus() renders the same registry."""
+    engine, batch = _build_engine(mesh_dp8, tmp_path, enabled=True)
+    engine.train_batch(batch)
+    engine.telemetry.flush()
+    recs = [json.loads(l) for l in open(engine.telemetry.tracer.file_path)]
+    assert len(recs) == 1
+    r = recs[0]
+    assert r["kind"] == "train_step" and r["step"] == 1
+    assert r["dur_ms"] > 0
+    assert isinstance(r["loss"], float) and r["loss"] > 0
+    assert "lr" in r and "grad_norm" in r
+    assert "bytes_in_use" in r["hbm"] and "peak_bytes_in_use" in r["hbm"]
+    # ZeRO-2 on dp=8: XLA inserts collectives; the HLO-derived per-axis
+    # totals must be non-empty and positive
+    assert r["comm_bytes"] and all(v > 0 for v in r["comm_bytes"].values())
+    assert r["spans"]["total_ms"] >= sum(r["spans"]["children"].values()) - 1e-6
+
+    values, types = parse_prometheus(engine.telemetry.registry.to_prometheus())
+    assert values['steps_total{kind="train"}'] == 1
+    assert types["step_seconds"] == "histogram"
+    assert values['step_seconds_count{kind="train"}'] == 1
+    assert "train_loss" in values
+    assert any(k.startswith("comm_bytes_per_step") for k in values)
+    assert os.path.exists(str(tmp_path / "metrics.prom"))
+
+
+def test_engine_disabled_no_files_no_telemetry(mesh_dp8, tmp_path):
+    """Acceptance: telemetry disabled → engine.telemetry is None, no trace
+    or exporter file is ever created."""
+    engine, batch = _build_engine(mesh_dp8, tmp_path, enabled=False)
+    assert engine.telemetry is None
+    engine.train_batch(batch)
+    assert not (tmp_path / "traces").exists()
+    assert not (tmp_path / "metrics.prom").exists()
