@@ -127,6 +127,17 @@ def build_engine(model_name: str, seq: int, micro: int, n_dev: int, zero_stage: 
     # chunked CE: the [B,S,V] logits are the peak activation at GPT-2 vocab;
     # computing the loss in 256-position chunks (grads exact, logits
     # rematerialized) frees ~GBs of HBM for batch/model size
+    # PR 2 comm knobs: BENCH_COMM_COMPRESSION=int8|fp8 turns on compressed
+    # grad collectives (dp-only mesh, stage <= 2); BENCH_GRAD_BUCKETING=1
+    # buckets the grad reduce into independent per-bucket collectives
+    comm_method = os.environ.get("BENCH_COMM_COMPRESSION", "")
+    if comm_method and zero_stage > 2:
+        sys.stderr.write(
+            "[bench] BENCH_COMM_COMPRESSION needs ZeRO stage <= 2 "
+            f"(BENCH_ZERO={zero_stage}); running uncompressed\n"
+        )
+        comm_method = ""
+    grad_bucketing = os.environ.get("BENCH_GRAD_BUCKETING", "0") == "1"
     cfg = gpt2.get_config(
         model_name, n_positions=seq, remat=remat,
         # Megatron-style vocab padding: BENCH_PAD_VOCAB=128 aligns the head
@@ -148,7 +159,17 @@ def build_engine(model_name: str, seq: int, micro: int, n_dev: int, zero_stage: 
             "train_micro_batch_size_per_gpu": micro,
             "gradient_accumulation_steps": 1,
             "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.01}},
-            "zero_optimization": {"stage": zero_stage},
+            "zero_optimization": {
+                "stage": zero_stage,
+                "reduce_bucket_size": int(
+                    os.environ.get("BENCH_BUCKET_BYTES", str(50_000_000))
+                ),
+            },
+            "comm_compression": {
+                "enabled": bool(comm_method),
+                "method": comm_method or "int8",
+                "bucketing": grad_bucketing,
+            },
             "gradient_clipping": 1.0,
             "bf16": {"enabled": True},
             "steps_per_print": 10**9,
@@ -341,9 +362,22 @@ def main():
 
     import jax
 
-    from deepspeed_tpu.utils.jax_env import honor_jax_platforms
+    from deepspeed_tpu.utils.jax_env import (
+        ensure_xla_flags,
+        honor_jax_platforms,
+        overlap_xla_flags,
+    )
 
     honor_jax_platforms()  # lets JAX_PLATFORMS=cpu smoke-run on TPU hosts
+
+    # overlap-aware compiler config (PR 2): latency-hiding scheduler +
+    # collective-combining thresholds pinned to the grad bucket size, BEFORE
+    # the first jax.devices() initializes the backend. TPU-only flags — the
+    # CPU backend aborts on unknown XLA_FLAGS, so gate on the probe's
+    # platform answer. BENCH_OVERLAP_FLAGS=0 opts out (A/B experiments).
+    bucket_bytes = int(os.environ.get("BENCH_BUCKET_BYTES", str(50_000_000)))
+    if platform != "cpu" and os.environ.get("BENCH_OVERLAP_FLAGS", "1") == "1":
+        ensure_xla_flags(overlap_xla_flags(bucket_bytes))
 
     n_dev = len(jax.devices())
     on_tpu = jax.default_backend() not in ("cpu",)
@@ -546,7 +580,7 @@ def main():
     try:
         import jax.numpy as jnp
 
-        step_fn = engine._make_train_step()
+        step_fn = engine._step_builder()
         device_batch = engine.shard_batch(batch)
         base_rng = jax.random.PRNGKey(7)
 
@@ -682,6 +716,38 @@ def main():
                 }
     except Exception as e:  # telemetry must never sink the one-JSON-line contract
         result["telemetry_error"] = f"{type(e).__name__}: {e}"
+    # --- BENCH_pr2.json (PR 2 satellite): the comm-efficiency artifact that
+    # seeds the bench trajectory — step latency plus wire-vs-logical comm
+    # bytes and compression ratio, in one standalone file the next session
+    # can diff against
+    try:
+        comp = engine._compression_stats()
+        compressing = getattr(engine, "_compress_grads", False)
+        logical = {a: r["logical_bytes"] for a, r in comp.items()}
+        wire = {a: r["wire_bytes"] for a, r in comp.items()}
+        tel_comm = result.get("telemetry", {}).get("comm_bytes_by_axis", {})
+        tot_logical = sum(logical.values()) or sum(tel_comm.values())
+        tot_wire = sum(wire.values()) or sum(tel_comm.values())
+        pr2 = {
+            "schema": "bench_pr2_comm_v1",
+            "metric": result["metric"],
+            "tokens_per_sec_chip": result["value"],
+            "step_latency_ms": result["step_ms"],
+            "comm_compression_method": (
+                engine.comm_compression.method if compressing else "off"
+            ),
+            "grad_bucketing": bool(getattr(engine, "_grad_bucketing", False)),
+            "reduce_bucket_size": bucket_bytes,
+            "comm_bytes_by_axis": tel_comm,  # HLO-derived, wire precision
+            "comm_logical_bytes_by_axis": logical,
+            "comm_wire_bytes_by_axis": wire,
+            "compression_ratio": round(tot_logical / tot_wire, 3) if tot_wire else 1.0,
+        }
+        with open(os.path.join(_BENCH_DIR, "BENCH_pr2.json"), "w") as fh:
+            json.dump(pr2, fh, indent=1)
+        result["pr2_artifact"] = "BENCH_pr2.json"
+    except Exception as e:
+        result["pr2_error"] = f"{type(e).__name__}: {e}"
     disarm_watchdog()  # measurements done: nothing left that can wedge
     print(json.dumps(result))
 

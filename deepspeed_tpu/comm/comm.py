@@ -66,15 +66,20 @@ class CommsLogger:
         if debug is not None:
             self.debug = debug
 
-    def append(self, op_name: str, axis, nbytes: int):
+    def append(self, op_name: str, axis, nbytes: int, wire_bytes: Optional[int] = None):
+        """Record one collective: ``nbytes`` is the LOGICAL payload (what the
+        op carries at its source precision); ``wire_bytes`` the actual
+        on-wire volume when a compressed layer shrank it (defaults to
+        ``nbytes`` — uncompressed ops have ratio 1)."""
         if not self.enabled:
             return
         key = (op_name, str(axis))
         rec = self.comms_dict.setdefault(
-            key, {"count": 0, "bytes": 0, "time_ms": None, "world": None}
+            key, {"count": 0, "bytes": 0, "wire_bytes": 0, "time_ms": None, "world": None}
         )
         rec["count"] += 1
         rec["bytes"] += nbytes
+        rec["wire_bytes"] += wire_bytes if wire_bytes is not None else nbytes
         if rec["world"] is None:
             # called at trace time with the mesh axis in scope: psum of a
             # literal constant folds to the axis size (no HLO emitted), so
@@ -116,7 +121,7 @@ class CommsLogger:
         import time
 
         import jax
-        from jax import shard_map
+        from ..utils.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         from . import xla as _xla
@@ -148,7 +153,12 @@ class CommsLogger:
                 if fn is None or ax is None:
                     continue
                 n = mesh.shape[ax]
-                per_call = max(4, rec["bytes"] // max(1, rec["count"]))
+                # replay at the WIRE size (what actually moved): log_summary
+                # divides wire bytes by this latency, so sizing the replay
+                # from logical bytes would understate compressed rows ~4x
+                per_call = max(
+                    4, (rec.get("wire_bytes") or rec["bytes"]) // max(1, rec["count"])
+                )
                 nelem = max(1, per_call // 4)
                 nelem = -(-nelem // n) * n  # pad to axis-divisible (scatter dims)
                 x = jnp.zeros((nelem,), jnp.float32)
@@ -189,33 +199,50 @@ class CommsLogger:
 
     def log_summary(self) -> str:
         """Reference-style per-op table (utils/comms_logging.py:56 columns:
-        op, size, count, world, avg latency, algbw, busbw). Measured rows
-        (after :meth:`measure`) show exact numbers; trace-time-only rows show
-        "~"-prefixed estimates from the nominal interconnect bandwidth so the
-        table always matches the reference output shape. Returns the
-        rendered text (also logged)."""
+        op, size, count, world, avg latency, algbw, busbw) extended with
+        wire-bytes and compression-ratio columns: ``msg size`` is the logical
+        payload, ``wire size`` the actual on-wire volume (they differ only
+        for ops issued through the compressed layer, comm/compressed.py),
+        ``ratio`` their quotient. Measured rows (after :meth:`measure`) show
+        exact numbers; trace-time-only rows show "~"-prefixed estimates from
+        the nominal interconnect bandwidth so the table always matches the
+        reference output shape. Latency/bandwidth are computed from the WIRE
+        volume — what actually moves.
+
+        The table mixes two accounting sources that are NOT additive: rows
+        keyed by a mesh-axis name come from trace-time wrapper/compressed-
+        layer records, rows keyed ``xla``/``xla-loop`` from compiled HLO
+        (``record_from_compiled``). A compressed step's all_to_all/all_gather
+        appear in BOTH — the ``dp`` rows carry the logical-vs-wire split,
+        the ``xla`` rows the compiler's physical op mix (payload and scale
+        transfers counted separately). Do not sum across sources. Returns
+        the rendered text (also logged)."""
         lines = ["Communication summary (per traced step):"]
         header = (
             f"  {'op':<16s}{'axis':<10s}{'count':>6s}{'world':>7s}{'msg size':>12s}"
+            f"{'wire size':>12s}{'ratio':>7s}"
             f"{'avg lat(ms)':>13s}{'algbw(GB/s)':>13s}{'busbw(GB/s)':>13s}"
         )
         lines.append(header)
         for (op, axis), rec in sorted(self.comms_dict.items()):
             per_call = rec["bytes"] / max(1, rec["count"])
+            wire_total = rec.get("wire_bytes") or rec["bytes"]
+            wire_call = wire_total / max(1, rec["count"])
+            ratio = rec["bytes"] / wire_total if wire_total else 1.0
             lat = rec.get("time_ms")
             world = rec.get("world")
             factor = self._bus_factor(op, world or 1)
             if lat:
-                algbw = per_call / (lat / 1e3) / 1e9
+                algbw = wire_call / (lat / 1e3) / 1e9
                 busbw = algbw * factor
                 lat_s, alg_s, bus_s = f"{lat:.3f}", f"{algbw:.2f}", f"{busbw:.2f}"
-            elif per_call > 0:
+            elif wire_call > 0:
                 # estimate from the nominal bus bandwidth: on-wire bytes are
-                # per_call * busbw-factor, so est busbw == the assumed figure
+                # wire_call * busbw-factor, so est busbw == the assumed figure
                 # and algbw/latency follow from it
                 bw = self._assumed_busbw_gbps() * 1e9
-                est_lat_s = max(per_call * factor / bw, 1e-9)
-                algbw = per_call / est_lat_s / 1e9
+                est_lat_s = max(wire_call * factor / bw, 1e-9)
+                algbw = wire_call / est_lat_s / 1e9
                 lat_s = f"~{est_lat_s * 1e3:.3f}"
                 alg_s = f"~{algbw:.2f}"
                 bus_s = f"~{algbw * factor:.2f}"
@@ -224,6 +251,7 @@ class CommsLogger:
             lines.append(
                 f"  {op:<16s}{axis:<10s}{rec['count']:>6d}"
                 f"{world if world else '-':>7}{per_call / 1e6:>10.2f}MB"
+                f"{wire_call / 1e6:>10.2f}MB{ratio:>6.2f}x"
                 f"{lat_s:>13s}{alg_s:>13s}{bus_s:>13s}"
             )
         text = "\n".join(lines)
@@ -350,10 +378,14 @@ def record_from_compiled(compiled, reset: bool = False) -> dict:
     comms_logger.enabled = True
     for (op, axis), rec in found.items():
         entry = comms_logger.comms_dict.setdefault(
-            (op, axis), {"count": 0, "bytes": 0, "time_ms": None, "world": None}
+            (op, axis),
+            {"count": 0, "bytes": 0, "wire_bytes": 0, "time_ms": None, "world": None},
         )
         entry["count"] += rec["count"]
         entry["bytes"] += rec["bytes"]
+        # post-optimization HLO shapes carry the op's real dtype, so these
+        # bytes are already on-wire volume (an int8 collective reads int8)
+        entry["wire_bytes"] += rec["bytes"]
         if entry["world"] is None and rec.get("world"):
             entry["world"] = rec["world"]
     comms_logger.enabled = was_enabled

@@ -112,6 +112,7 @@ class InferenceEngine:
         cfg_ckpt = c.pop("checkpoint", None)
         q = c.pop("quantization_setting", None)
         cfg_tel = c.pop("telemetry", None)
+        cfg_cache = c.pop("generate_cache_size", None)
 
         mp_size = int(mp_size if mp_size is not _UNSET else (cfg_mp or 1))
         ep_size = int(ep_size if ep_size is not _UNSET else (cfg_ep or 1))
@@ -168,7 +169,16 @@ class InferenceEngine:
         self.mesh = mesh
         self.policy = ZeroShardingPolicy(mesh, stage=0)  # TP-only weight sharding
         self.model_config = None
-        self._generate_cache: Dict = {}
+        # compiled-generate cache, LRU-bounded: every distinct
+        # (batch, prompt_len, max_new_tokens, sampling) shape holds a full
+        # compiled XLA executable — unbounded growth across shapes leaks
+        # device memory on long-lived servers. Cap via config
+        # {"generate_cache_size": N}; evictions surface in telemetry.
+        from collections import OrderedDict
+
+        self._generate_cache: "OrderedDict" = OrderedDict()
+        self._generate_cache_cap = max(1, int(cfg_cache if cfg_cache is not None else 16))
+        self.generate_cache_evictions = 0
         # unified telemetry plane (same TelemetryConfig schema as training;
         # config={"telemetry": {...}} — per-request JSONL records + registry)
         self.telemetry = None
@@ -360,6 +370,8 @@ class InferenceEngine:
             key = (ids.shape, max_new_tokens, float(temperature), int(top_k), float(top_p))
             gen = self._generate_cache.get(key)
             was_cached = gen is not None
+            if was_cached:
+                self._generate_cache.move_to_end(key)  # LRU freshness
             if gen is None:
                 cfg = self.model_config
                 cache_dtype = self.dtype
@@ -374,6 +386,18 @@ class InferenceEngine:
 
                 gen = jax.jit(gen_fn)
                 self._generate_cache[key] = gen
+                while len(self._generate_cache) > self._generate_cache_cap:
+                    self._generate_cache.popitem(last=False)  # evict LRU entry
+                    self.generate_cache_evictions += 1
+                    if self.telemetry is not None:
+                        self.telemetry.registry.counter(
+                            "generate_cache_evictions_total",
+                            "compiled-generate executables evicted by the LRU cap",
+                        ).inc()
+                if self.telemetry is not None:
+                    self.telemetry.registry.gauge(
+                        "generate_cache_size", "live compiled-generate executables"
+                    ).set(len(self._generate_cache))
             new = gen(self.params, ids, rng)
             out = jnp.concatenate([ids, new.astype(ids.dtype)], axis=1)
             result = np.asarray(jax.device_get(out))

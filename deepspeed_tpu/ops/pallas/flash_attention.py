@@ -568,7 +568,9 @@ def _bwd(q3, k3, v3, o3, lse, do3, sm_scale: float, causal: bool, interpret: boo
 # itself gets large past ~256k tokens per device.
 GRID_KERNEL_MAX_SEQ = 128 * 2048
 
-_GRID_PARAMS = pltpu.CompilerParams(
+# jax version compat: the params class was renamed TPUCompilerParams ->
+# CompilerParams; older jaxlib pins only carry the old name
+_GRID_PARAMS = getattr(pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None))(
     dimension_semantics=("parallel", "parallel", "arbitrary")
 )
 

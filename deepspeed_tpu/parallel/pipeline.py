@@ -33,6 +33,8 @@ from typing import Any, Callable, Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from ..utils.compat import pcast as _pcast, shard_map as _shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 PyTree = Any
@@ -108,7 +110,7 @@ def pipeline_apply(
             shifted = lax.ppermute(out, "pp", [(i, (i + 1) % Pn) for i in range(Pn)])
             return shifted, out
 
-        carry0 = lax.pcast(jnp.zeros_like(x_micro[0]), ("pp",), to="varying")
+        carry0 = _pcast(jnp.zeros_like(x_micro[0]), ("pp",), to="varying")
         _, outs = lax.scan(tick, carry0, jnp.arange(T))  # [T, mb, ...]
         # last stage's outputs for ticks P-1..T-1 are microbatches 0..M-1
         results = lax.dynamic_slice_in_dim(outs, Pn - 1, M, axis=0)
@@ -116,7 +118,7 @@ def pipeline_apply(
         is_last = (p == Pn - 1).astype(results.dtype)
         return lax.psum(results * is_last, "pp")
 
-    sharded = jax.shard_map(
+    sharded = _shard_map(
         pipe,
         mesh=mesh,
         in_specs=(layer_axis_specs, P()),
@@ -241,7 +243,7 @@ def pipeline_train_1f1b(
             def skip_head(_):
                 # pcast: branch outputs must match do_head's varying-over-pp
                 # type (its results depend on the stage-local ``out``)
-                vary = lambda x: lax.pcast(x, ("pp",), to="varying")
+                vary = lambda x: _pcast(x, ("pp",), to="varying")
                 return (
                     vary(jnp.float32(0.0)),
                     jax.tree.map(lambda x: vary(jnp.zeros_like(x)), head_p),
@@ -278,7 +280,7 @@ def pipeline_train_1f1b(
             return (ring, next_act, next_dh, gL, gH, loss_sum, dx_buf), None
 
         mb_shape = xm.shape[1:]
-        varying = lambda x: lax.pcast(x, ("pp",), to="varying")
+        varying = lambda x: _pcast(x, ("pp",), to="varying")
         carry0 = (
             varying(jnp.zeros((R,) + mb_shape, xm.dtype)),  # ring
             varying(jnp.zeros(mb_shape, xm.dtype)),  # recv_act
@@ -297,7 +299,7 @@ def pipeline_train_1f1b(
         dx = lax.psum(dx_buf, "pp")
         return loss, gL, gH, dx
 
-    sharded = jax.shard_map(
+    sharded = _shard_map(
         pipe,
         mesh=mesh,
         in_specs=(layer_axis_specs, P(), P(), P()),

@@ -97,10 +97,16 @@ class OffloadDeviceConfig(DSConfigModel):
 class ZeroConfig(DSConfigModel):
     """zero_optimization section (reference zero/config.py).
 
+    ``reduce_bucket_size`` IS consumed here: it caps the flat gradient
+    buckets of the bucketed/compressed reduce paths (``comm_compression``
+    section + ``comm/compressed.py``) — each bucket becomes an independent
+    collective XLA's latency-hiding scheduler can overlap with backward
+    compute.
+
     Accepted-for-compatibility, subsumed-by-XLA keys (reference tunes its
     hand-rolled NCCL pipeline with them; here sharding constraints make XLA
     emit and schedule the collectives, so they have no effect):
-    ``contiguous_gradients``, ``reduce_scatter``, ``reduce_bucket_size``,
+    ``contiguous_gradients``, ``reduce_scatter``,
     ``allgather_partitions``, ``allgather_bucket_size``, ``overlap_comm``,
     ``stage3_max_live_parameters``, ``stage3_max_reuse_distance``,
     ``stage3_prefetch_bucket_size`` (XLA latency-hiding scheduler decides
@@ -153,6 +159,46 @@ class ActivationCheckpointingConfig(DSConfigModel):
     number_checkpoints: Optional[int] = None
     synchronize_checkpoint_boundary: bool = False
     profile: bool = False
+
+
+@dataclass
+class CommCompressionConfig(DSConfigModel):
+    """comm_compression section (TPU-native; the EQuARX-style quantized
+    collective layer, ``comm/compressed.py``). With ``enabled`` the gradient
+    dp-reduction runs as explicit block-scaled int8/fp8 collectives under
+    ``shard_map`` (quantize → all_to_all → fp32 reduce → requantize →
+    all_gather), with per-leaf error-feedback residuals carried in
+    ``TrainState.comm_error`` so quantization error feeds back into the next
+    step instead of biasing convergence.
+
+    ``method``: ``int8`` (block-scaled symmetric, ~3.9x wire reduction at
+    block 256, the robust default) or ``fp8`` (e4m3 — wider dynamic range
+    within a block, slightly higher rounding error). ``axes`` selects which
+    mesh axes compress (only ``dp`` — the grad reduce — is implemented;
+    other names are ignored with a warning). ``bucketing`` (also available
+    with compression off) reworks the grad accumulation to reduce in
+    size-capped flat buckets (``zero_optimization.reduce_bucket_size``)
+    emitted as INDEPENDENT collectives, giving XLA's latency-hiding
+    scheduler separate ops to overlap with backward compute; ``None``
+    keeps the legacy fused per-leaf path. Compression requires a dp-only
+    mesh, ZeRO stage <= 2, and bf16/fp32 (no fp16 dynamic loss scale)."""
+
+    enabled: bool = False
+    method: str = "int8"  # int8 | fp8
+    block_size: int = 256
+    error_feedback: bool = True
+    axes: List[str] = field(default_factory=lambda: ["dp"])
+    bucketing: Optional[bool] = None  # None = legacy fused path when not compressing
+
+    def __post_init__(self):
+        if self.method not in ("int8", "fp8"):
+            raise DeepSpeedConfigError(
+                f"comm_compression.method must be 'int8' or 'fp8', got {self.method!r}"
+            )
+        if self.block_size <= 0:
+            raise DeepSpeedConfigError(
+                f"comm_compression.block_size must be positive, got {self.block_size}"
+            )
 
 
 @dataclass
@@ -388,6 +434,7 @@ class DeepSpeedConfig(DSConfigModel):
     fp16: FP16Config = field(default_factory=FP16Config)
     bf16: BF16Config = field(default_factory=BF16Config)
     zero_optimization: ZeroConfig = field(default_factory=ZeroConfig)
+    comm_compression: CommCompressionConfig = field(default_factory=CommCompressionConfig)
     activation_checkpointing: ActivationCheckpointingConfig = field(default_factory=ActivationCheckpointingConfig)
     comms_logger: CommsLoggerConfig = field(default_factory=CommsLoggerConfig)
     tensorboard: MonitorSubConfig = field(default_factory=MonitorSubConfig)

@@ -60,6 +60,11 @@ class TrainState(NamedTuple):
     loss_scale: ls.LossScaleState
     global_step: jnp.ndarray  # i32
     skipped_steps: jnp.ndarray  # i32
+    # error-feedback residuals of the compressed grad collectives
+    # (comm_compression section): per-param [dp, ...] buffers sharded over
+    # dp — each rank's shard is its rank-local quantization error, fed back
+    # into the next step's reduction. () when compression is off.
+    comm_error: PyTree = ()
 
 
 def _tree_select(pred, a: PyTree, b: PyTree) -> PyTree:
@@ -168,6 +173,63 @@ class DeepSpeedEngine:
                 opt_cfg.params if opt_cfg else {"lr": base_lr},
                 learning_rate=lr_schedule,
             )
+
+        # --- compressed grad collectives + bucketed reduce (comm_compression)
+        cc = config.comm_compression
+        self.comm_compression = cc
+        self._grad_bucketing = bool(cc.bucketing)
+        self._compress_grads = bool(
+            cc.enabled and "dp" in cc.axes and self.dp_world_size > 1
+        )
+        if cc.enabled:
+            from ..utils.logging import warning_once
+
+            unknown_axes = [a for a in cc.axes if a != "dp"]
+            if unknown_axes:
+                warning_once(
+                    f"comm_compression.axes {unknown_axes} are not implemented "
+                    "(only the 'dp' grad reduce compresses); ignoring them"
+                )
+            if not self._compress_grads:
+                warning_once(
+                    "comm_compression.enabled has no effect: the grad reduce "
+                    "axis is dp and "
+                    + ("dp=1 on this mesh" if self.dp_world_size <= 1 else "'dp' is not in comm_compression.axes")
+                )
+        if self._compress_grads:
+            if self.onebit:
+                raise ValueError(
+                    "comm_compression cannot combine with 1-bit optimizers — "
+                    "they carry their own compressed-allreduce backend"
+                )
+            if config.fp16.enabled:
+                raise ValueError(
+                    "comm_compression does not support fp16 dynamic loss "
+                    "scaling (overflow handling would need the scale inside "
+                    "the mapped region); use bf16"
+                )
+            if not self.policy.supports_compressed_grads():
+                raise ValueError(
+                    "comm_compression requires ZeRO stage <= 2 (stage 3's "
+                    "dp-sharded params would need an uncompressed allgather "
+                    "inside the mapped grad region)"
+                )
+            if (
+                self.tp_world_size > 1
+                or self.sp_world_size > 1
+                or mesh_axis_size(mesh, "pp") > 1
+                or mesh_axis_size(mesh, "ep") > 1
+            ):
+                raise ValueError(
+                    "comm_compression supports a dp-only mesh (the grad "
+                    "reduction runs under shard_map over dp, like the 1-bit "
+                    "optimizer path)"
+                )
+            if zcfg.offload_param.device in ("cpu", "nvme") or zcfg.offload_optimizer.device in ("cpu", "nvme", "hybrid"):
+                raise ValueError(
+                    "comm_compression is not supported with optimizer/param "
+                    "offload (those paths run host-driven multi-program steps)"
+                )
 
         # --- ZeRO-Infinity parameter tier (offload_param; stage3.py:465 analog)
         offp = zcfg.offload_param
@@ -345,6 +407,26 @@ class DeepSpeedEngine:
             self.opt_shardings = self.policy.opt_state_shardings(abstract_opt, abstract_params, model.logical_axes)
             opt_state = jax.jit(self.optimizer.init, out_shardings=self.opt_shardings)(params)
 
+        # --- error-feedback residuals of the compressed grad collectives:
+        # one [dp, ...] fp32 buffer per param leaf, sharded over dp (each
+        # rank's shard is its rank-local quantization error — replicating
+        # divergent buffers would be UB, see _init_onebit_opt_state). The
+        # jitted sharded-out zeros create each shard on its own device.
+        # error_feedback=false keeps comm_error=() — no grad-sized HBM
+        # buffer is allocated or carried for a feature that is off.
+        if self._compress_grads and config.comm_compression.error_feedback:
+            world = self.dp_world_size
+            res_shardings = self.policy.residual_shardings(abstract_params)
+            comm_error = jax.jit(
+                lambda: jax.tree.map(
+                    lambda p: jnp.zeros((world,) + tuple(p.shape), jnp.float32),
+                    abstract_params,
+                ),
+                out_shardings=res_shardings,
+            )()
+        else:
+            comm_error, res_shardings = (), ()
+
         scale_state = ls.from_config(config.fp16)
         replicated = NamedSharding(mesh, PartitionSpec())
         self.state = TrainState(
@@ -353,6 +435,7 @@ class DeepSpeedEngine:
             loss_scale=jax.device_put(scale_state, replicated),
             global_step=jax.device_put(jnp.int32(0), replicated),
             skipped_steps=jax.device_put(jnp.int32(0), replicated),
+            comm_error=comm_error,
         )
         self.state_shardings = TrainState(
             params=self.param_shardings,
@@ -360,6 +443,7 @@ class DeepSpeedEngine:
             loss_scale=jax.tree.map(lambda _: replicated, scale_state),
             global_step=replicated,
             skipped_steps=replicated,
+            comm_error=res_shardings,
         )
         self._replicated = replicated
 
@@ -420,12 +504,24 @@ class DeepSpeedEngine:
             self._train_step = self._offload_dispatch
         else:
             self._train_step = jax.jit(
-                self._make_train_step(),
+                self._step_builder(),
                 donate_argnums=donate,
                 out_shardings=(self.state_shardings, None),
             )
             self._train_step_folds_rng = True
         self._eval_step = jax.jit(self._make_eval_step())
+
+    def _step_builder(self):
+        """The (state, batch, rng) -> (state, metrics) step function for the
+        standard device path: the compressed-collective variant when
+        ``comm_compression`` engages, the pjit path otherwise. bench.py's
+        device-only K-step loop compiles this too, so its numbers measure
+        the same program the engine runs."""
+        return (
+            self._make_compressed_train_step()
+            if self._compress_grads
+            else self._make_train_step()
+        )
 
     def _finish_init(self, model, config, training_data, collate_fn) -> None:
         # --- curriculum learning (reference engine.py:1643-1649 hook)
@@ -438,6 +534,7 @@ class DeepSpeedEngine:
         self.progressive_layer_drop = None
         if config.progressive_layer_drop.enabled and (
             self.onebit or self.offload_enabled or self.param_offload_enabled
+            or self._compress_grads
         ):
             # only _make_train_step threads theta into the model; failing loud
             # beats a schedule that decays while no layer ever drops
@@ -618,7 +715,7 @@ class DeepSpeedEngine:
         return fn(state, batch, rng)
 
     def _make_onebit_train_step(self, **opt_flags):
-        from jax import shard_map
+        from ..utils.compat import shard_map
 
         model = self.module
         opt = self.optimizer
@@ -958,6 +1055,46 @@ class DeepSpeedEngine:
             )
         mesh = self.mesh
 
+        # --- bucketed grad reduce (comm_compression.bucketing): accumulate
+        # into size-capped flat buckets instead of per-leaf buffers, so the
+        # dp-reduction lands as ONE independent collective per bucket
+        # (reduce_bucket_size semantics) that XLA's latency-hiding scheduler
+        # can overlap with backward compute, instead of a combiner-fused
+        # tree-allreduce walling the step tail. Concat/pad/split are exact
+        # and the dp-sum runs over the same addends: bit-identical to the
+        # per-leaf path when the state is replicated (stage 0); with
+        # dp-sharded opt/grad state the partitioner may re-associate the
+        # reduction (all-reduce+slice vs reduce-scatter), 1-2 ulp — both
+        # pinned by test_comm_compression.py.
+        bucketing = self._grad_bucketing and not pipeline_mode
+        if bucketing:
+            from ..comm import compressed as cco
+
+            bleaves = jax.tree.leaves(self.state.params)
+            btreedef = jax.tree.structure(self.state.params)
+            bshapes = [tuple(l.shape) for l in bleaves]
+            bspec = self.policy.bucket_spec()
+            bucket_plan = cco.build_bucket_plan(
+                cco.leaf_sizes(self.state.params),
+                int(cfg.zero_optimization.reduce_bucket_size),
+                itemsize=jnp.dtype(acc_dtype).itemsize,
+                multiple=self.dp_world_size if len(bspec) else 1,
+            )
+            bucket_sharding = NamedSharding(mesh, bspec)
+
+            def to_buckets(g):
+                return cco.flatten_to_buckets(jax.tree.leaves(g), bucket_plan, dtype=acc_dtype)
+
+            def constrain_buckets(bs):
+                return [
+                    jax.lax.with_sharding_constraint(b, bucket_sharding) for b in bs
+                ]
+
+            def from_buckets(bs):
+                return jax.tree.unflatten(
+                    btreedef, cco.unflatten_from_buckets(bs, bucket_plan, bshapes)
+                )
+
         # progressive layer drop: theta(t) computed IN-GRAPH from global_step
         # (reference recomputes on host each step, engine.py:1643; here the
         # schedule is a traced function so the compiled program is
@@ -1028,10 +1165,35 @@ class DeepSpeedEngine:
                 )
                 if predivide:
                     grads = jax.tree.map(lambda g: g / predivide_factor, grads)
-                grads = jax.lax.with_sharding_constraint(
-                    jax.tree.map(lambda g: g.astype(acc_dtype), grads), grad_shardings
-                )
+                if bucketing:
+                    grads = from_buckets(constrain_buckets(to_buckets(grads)))
+                else:
+                    grads = jax.lax.with_sharding_constraint(
+                        jax.tree.map(lambda g: g.astype(acc_dtype), grads), grad_shardings
+                    )
                 loss_sum = loss.astype(jnp.float32)
+            elif bucketing:
+
+                def micro_step(carry, xs):
+                    buckets, loss_acc, i = carry
+                    micro = jax.tree.map(lambda x: x[i], batch)
+                    mrng = jax.random.fold_in(rng, i)
+                    (_, (loss, _metrics)), grads = grad_fn(cparams, micro, mrng, scale, theta)
+                    if predivide:
+                        grads = jax.tree.map(lambda g: g / predivide_factor, grads)
+                    gb = to_buckets(grads)
+                    # per-bucket constraint: the dp-reduction of each bucket
+                    # materializes as its own collective, every iteration
+                    buckets = constrain_buckets([a + b for a, b in zip(buckets, gb)])
+                    return (buckets, loss_acc + loss.astype(jnp.float32), i + 1), None
+
+                zero_buckets = constrain_buckets(
+                    [jnp.zeros((n,), acc_dtype) for n in bucket_plan.padded]
+                )
+                (buckets, loss_sum, _), _ = jax.lax.scan(
+                    micro_step, (zero_buckets, jnp.float32(0.0), 0), None, length=gas
+                )
+                grads = from_buckets(buckets)
             else:
 
                 def micro_step(carry, xs):
@@ -1106,6 +1268,181 @@ class DeepSpeedEngine:
 
                 # cross-device reduced NaN/Inf flag over the final grads
                 # (reference has_overflow allreduce, stage3.py:2000)
+                metrics["nan_in_grads"] = tree_nan_scan(grads)
+            return new_state, metrics
+
+        return train_step
+
+    def _make_compressed_train_step(self):
+        """Train step with the gradient dp-reduction as explicit block-scaled
+        int8/fp8 collectives (comm_compression tentpole; comm/compressed.py).
+
+        Generalizes the 1-bit shard_map precedent (_make_onebit_train_step):
+        the grad-accumulation scan runs per-rank under ``shard_map`` over dp
+        (params replicated, batch dp-sharded), then each size-capped flat
+        bucket (``reduce_bucket_size``) is reduced by an INDEPENDENT
+        quantize → all_to_all → fp32-reduce → requantize → all_gather
+        pipeline, ~3.9x less wire volume than the dense fp32 reduction at
+        int8/block-256. Quantization error is carried per-leaf in
+        ``TrainState.comm_error`` (rank-local ``[dp, ...]`` buffers sharded
+        over dp) and fed back into the next step's reduction — compensated
+        compression, so convergence tracks the uncompressed path. Exiting
+        the mapped region the grads are rank-identical (the all-gather
+        broadcasts one served chunk per rank), so the clip + optimizer
+        update run in ordinary pjit-land with the ZeRO opt-state shardings
+        untouched.
+
+        Why stage B (the compressed all-gather) runs even at ZeRO stage 2,
+        where the grad layout is dp-sharded anyway: dropping it
+        (``comm.compressed.compressed_reduce_scatter``) leaves each rank a
+        flat chunk of the CONCATENATED bucket, which does not align with the
+        per-leaf dp sharding the optimizer state lives in — rebuilding the
+        leaves would make XLA insert an fp32 all-gather (4 B/elem) where
+        stage B pays ~1 B/elem. Skipping stage B only wins if the optimizer
+        update itself is reorganized to run on flat bucket shards; until
+        then the reduce-scatter primitive stays a tested building block."""
+        from ..utils.compat import shard_map
+
+        from ..comm import compressed as cco
+
+        model = self.module
+        tx = self.optimizer
+        cfg = self.config
+        cc = cfg.comm_compression
+        compute_dtype = self.compute_dtype
+        acc_dtype = self.grad_accum_dtype
+        grad_shardings = self.grad_shardings
+        clip = cfg.gradient_clipping
+        gas = self.gradient_accumulation_steps_value
+        # prescale_gradients nets out on this path: the pjit path divides
+        # per-micro and re-multiplies after unscale purely for fp16 headroom,
+        # and this path accumulates in fp32 with fp16 rejected at init
+        mesh = self.mesh
+        world = self.dp_world_size
+        method, block = cc.method, int(cc.block_size)
+        use_ef = cc.error_feedback
+        debug_nan = self._debug_nan_check
+
+        btreedef = jax.tree.structure(self.state.params)
+        bshapes = [tuple(l.shape) for l in jax.tree.leaves(self.state.params)]
+        plan = cco.build_bucket_plan(
+            cco.leaf_sizes(self.state.params),
+            int(cfg.zero_optimization.reduce_bucket_size),
+            itemsize=4,  # buckets quantize from fp32
+            multiple=world * block,  # chunk-per-rank stays block-aligned
+        )
+        # static shapes → the per-step collective mix is known here, exactly
+        # (the basis for _compression_stats; trace-time registries would
+        # over-count when bench/telemetry re-lower the same program)
+        self._compression_plan = (plan, world, method, block)
+
+        def scaled_loss(cp, micro, mrng):
+            loss, _metrics = model.loss_fn(cp, micro, mrng, True)
+            return loss.astype(jnp.float32)
+
+        grad_fn = jax.value_and_grad(scaled_loss)
+
+        def per_rank(params, residual, batch, rng):
+            rank = jax.lax.axis_index("dp")
+            cparams = _cast_params(params, compute_dtype)  # hoisted out of scan
+
+            def micro_grads(i):
+                micro = jax.tree.map(lambda x: x[i], batch)
+                mrng = jax.random.fold_in(jax.random.fold_in(rng, i), rank)
+                return grad_fn(cparams, micro, mrng)
+
+            if gas == 1:
+                loss_sum, grads = micro_grads(0)
+                grads = jax.tree.map(lambda g: g.astype(acc_dtype), grads)
+            else:
+
+                def micro_step(carry, i):
+                    grads_acc, loss_acc = carry
+                    loss, grads = micro_grads(i)
+                    grads_acc = jax.tree.map(
+                        lambda a, g: a + g.astype(acc_dtype), grads_acc, grads
+                    )
+                    return (grads_acc, loss_acc + loss), None
+
+                zero = jax.tree.map(lambda p: jnp.zeros(p.shape, acc_dtype), params)
+                (grads, loss_sum), _ = jax.lax.scan(
+                    micro_step, (zero, jnp.float32(0.0)), jnp.arange(gas)
+                )
+            # LOCAL mean over gas in fp32; the compressed collective takes
+            # the mean over dp
+            grads = jax.tree.map(lambda g: g.astype(jnp.float32) / gas, grads)
+            comp = (
+                jax.tree.map(lambda g, r: g + r[0], grads, residual)
+                if use_ef
+                else grads
+            )
+            buckets = cco.flatten_to_buckets(jax.tree.leaves(comp), plan, dtype=jnp.float32)
+            means, errs = [], []
+            for fb in buckets:  # one independent compressed collective per bucket
+                m, e = cco.compressed_all_reduce(fb, "dp", world, method, block)
+                means.append(m)
+                errs.append(e)
+            mean_tree = jax.tree.unflatten(
+                btreedef, cco.unflatten_from_buckets(means, plan, bshapes)
+            )
+            if use_ef:
+                err_leaves = cco.unflatten_from_buckets(errs, plan, bshapes)
+                new_residual = jax.tree.unflatten(
+                    btreedef, [e[None] for e in err_leaves]
+                )
+            else:
+                # unused errs dead-code-eliminate; nothing is carried
+                new_residual = ()
+            loss_mean = jax.lax.pmean(loss_sum / gas, "dp")
+            return mean_tree, new_residual, loss_mean
+
+        replicated_spec = PartitionSpec()
+
+        def train_step(state: TrainState, batch: PyTree, rng) -> Tuple[TrainState, Dict[str, Any]]:
+            rng = jax.random.fold_in(rng, state.global_step + state.skipped_steps)
+            param_specs = jax.tree.map(lambda _: replicated_spec, state.params)
+            res_specs = jax.tree.map(lambda _: PartitionSpec("dp"), state.comm_error)
+            in_batch_specs = jax.tree.map(
+                lambda x: PartitionSpec(None, "dp", *([None] * (x.ndim - 2))), batch
+            )
+            mapped = shard_map(
+                per_rank,
+                mesh=mesh,
+                in_specs=(param_specs, res_specs, in_batch_specs, replicated_spec),
+                out_specs=(param_specs, res_specs, replicated_spec),
+                check_vma=False,
+            )
+            grads, new_residual, loss = mapped(
+                state.params, state.comm_error, batch, rng
+            )
+            # ZeRO >= 2: settle the (rank-identical) grads onto the sharded
+            # layout the opt state lives in — a local slice, no collective
+            grads = jax.lax.with_sharding_constraint(grads, grad_shardings)
+            gnorm = global_norm(grads)
+            if clip > 0.0:
+                coef = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+                grads = jax.tree.map(lambda g: g * coef, grads)
+            updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
+            new_state = TrainState(
+                params=new_params,
+                opt_state=new_opt_state,
+                loss_scale=state.loss_scale,
+                global_step=state.global_step + 1,
+                skipped_steps=state.skipped_steps,
+                comm_error=new_residual,
+            )
+            metrics = {
+                "loss": loss,
+                "grad_norm": gnorm,
+                "loss_scale": jnp.float32(1.0),
+                "overflow": jnp.bool_(False),
+                "lr": jnp.asarray(self.lr_schedule(state.global_step), jnp.float32),
+                "global_step": new_state.global_step,
+            }
+            if debug_nan:
+                from .debug import tree_nan_scan
+
                 metrics["nan_in_grads"] = tree_nan_scan(grads)
             return new_state, metrics
 
@@ -1356,6 +1693,12 @@ class DeepSpeedEngine:
                 ).set(cache_size())
             except Exception:
                 pass
+        comp = self._compression_stats()
+        extra: Dict[str, Any] = {
+            "samples_per_sec": round(self.tput_timer.avg_samples_per_sec(), 3)
+        }
+        if comp:
+            extra["comm_compression"] = comp
         tel.record_step(
             "train",
             step=self.global_steps,
@@ -1364,8 +1707,40 @@ class DeepSpeedEngine:
             spans=spans,
             hbm=self.memory_breakdown(),
             comm_bytes=self._comm_bytes_by_axis(),
-            extra={"samples_per_sec": round(self.tput_timer.avg_samples_per_sec(), 3)},
+            comm_wire_bytes={a: r["wire_bytes"] for a, r in comp.items()} or None,
+            extra=extra,
         )
+
+    def _compression_stats(self) -> Dict[str, Dict[str, float]]:
+        """Per-axis {logical_bytes, wire_bytes, ratio} of ONE compressed
+        train step, derived analytically from the bucket plan (shapes are
+        static, so the per-step collective mix is exact). Not read from the
+        trace-time registry in comm/compressed.py — that one grows on every
+        re-trace/lower of the same program (bench's device-only loop, the
+        comms-accounting ``.lower()``) and would over-count. Empty when
+        comm_compression never engaged."""
+        if not getattr(self, "_compress_grads", False):
+            return {}
+        plan_info = getattr(self, "_compression_plan", None)
+        if plan_info is None:
+            return {}
+        from ..comm.compressed import wire_bytes as _wire
+
+        plan, world, method, block = plan_info
+        logical = wire = 0
+        for n in plan.padded:
+            chunk = n // world
+            # stage A all_to_all over the full bucket + stage B all_gather
+            # of the served chunk (see compressed_all_reduce)
+            logical += 4 * n + 4 * chunk
+            wire += _wire(n, method, block) + _wire(chunk, method, block)
+        return {
+            "dp": {
+                "logical_bytes": logical,
+                "wire_bytes": wire,
+                "ratio": logical / wire if wire else 1.0,
+            }
+        }
 
     def _jit_step_programs(self) -> int:
         """Invalidation key for program-derived caches: the jitted step's
@@ -1394,8 +1769,13 @@ class DeepSpeedEngine:
                 "(offload/onebit/infinity paths run multiple programs per step)"
             )
         from ..comm import comm as dscomm
+        from ..comm.compressed import suspend_records
 
-        compiled = self._train_step.lower(*self._step_arg_structs).compile()
+        # re-lowering re-traces the step; the compressed layer's trace-time
+        # records were already taken on the first (real) trace — appending
+        # them again here would double the compressed rows in the logger
+        with suspend_records():
+            compiled = self._train_step.lower(*self._step_arg_structs).compile()
         if found:
             # back out the superseded program's contribution before merging
             # the new one, keeping the shared logger's per-step semantics
@@ -1405,6 +1785,7 @@ class DeepSpeedEngine:
                     continue
                 entry["count"] -= rec["count"]
                 entry["bytes"] -= rec["bytes"]
+                entry["wire_bytes"] = entry.get("wire_bytes", 0) - rec["bytes"]
                 if entry["count"] <= 0:
                     del dscomm.comms_logger.comms_dict[(op, axis)]
         found = dscomm.record_from_compiled(compiled)
@@ -1732,10 +2113,19 @@ class DeepSpeedEngine:
         from ..checkpoint.engine import load_train_state
 
         t_ckpt0 = time.perf_counter()
-        state, client_state = load_train_state(
-            load_dir, tag, self.state, self.state_shardings,
-            load_optimizer_states=load_optimizer_states,
-        )
+        try:
+            state, client_state = load_train_state(
+                load_dir, tag, self.state, self.state_shardings,
+                load_optimizer_states=load_optimizer_states,
+            )
+        except Exception as first_err:
+            # structure mismatch when comm_compression/error_feedback changed
+            # between save and resume: retry with the complementary
+            # comm_error template, then reconcile — residuals are a
+            # best-effort accelerant, never worth failing a resume over
+            state, client_state = self._load_with_comm_error_fallback(
+                load_dir, tag, load_optimizer_states, first_err
+            )
         self.state = state
         self.global_steps = int(client_state.get("global_steps", self.get_global_step()))
         # applied-step counter drives the offload path's LR schedule
@@ -1753,6 +2143,49 @@ class DeepSpeedEngine:
                 {"step": self.global_steps, "tag": tag or "latest", "path": load_dir},
             )
         return load_dir, client_state
+
+    def _load_with_comm_error_fallback(self, load_dir, tag, load_optimizer_states, first_err):
+        """Retry a failed restore assuming the checkpoint's ``comm_error``
+        structure differs from this engine's (compression toggled between
+        save and resume). Saved-without/resume-with: restore sans residuals
+        and keep this engine's zeros (error feedback restarts clean).
+        Saved-with/resume-without: restore via a synthetic residual template
+        and drop the buffers. Any other failure re-raises the original."""
+        from ..checkpoint.engine import load_train_state
+
+        if self.state.comm_error != ():
+            template = self.state._replace(comm_error=())
+            shardings = self.state_shardings._replace(comm_error=())
+            keep = self.state.comm_error
+            note = (
+                "checkpoint has no comm_error residuals (saved without "
+                "comm_compression error feedback); restarting them from zero"
+            )
+        else:
+            world = self.dp_world_size
+            template = self.state._replace(
+                comm_error=jax.tree.map(
+                    lambda p: jax.ShapeDtypeStruct((world,) + tuple(p.shape), jnp.float32),
+                    self.state.params,
+                )
+            )
+            shardings = self.state_shardings._replace(
+                comm_error=self.policy.residual_shardings(self.state.params)
+            )
+            keep = ()
+            note = (
+                "checkpoint carries comm_error residuals but comm_compression "
+                "is off in this engine; dropping them"
+            )
+        try:
+            state, client_state = load_train_state(
+                load_dir, tag, template, shardings,
+                load_optimizer_states=load_optimizer_states,
+            )
+        except Exception:
+            raise first_err
+        logger.warning(note)
+        return state._replace(comm_error=keep), client_state
 
     def load_megatron_checkpoint(self, shards) -> None:
         """Load a TP/PP-sharded Megatron-style training checkpoint into THIS

@@ -179,6 +179,48 @@ class ZeroShardingPolicy:
             spec = add_zero_axis(spec, shape, self.mesh, self.zero_axis, self.grad_min_size_to_shard)
         return spec
 
+    # -- compressed / bucketed grad-reduce wiring ---------------------------
+    # (comm_compression section → comm/compressed.py; the engine consumes
+    # bucket_spec / residual_shardings / supports_compressed_grads, so the
+    # ZeRO stage stays the single source of truth for HOW the gradient
+    # dp-reduction is implemented)
+    def grad_reduce_op(self) -> str:
+        """The collective implementing the grad reduction at this stage:
+        stage >= 2 shards the accumulation buffer over ``zero_axis`` so XLA
+        emits reduce-scatter (stage3.py:1145 analog); below that the grads
+        stay replicated and the reduction is an all-reduce. ``bucket_spec``
+        derives the bucketed path's sharding from this decision."""
+        return "reduce_scatter" if self.stage >= 2 else "all_reduce"
+
+    def bucket_spec(self) -> PartitionSpec:
+        """Sharding of a flat gradient bucket on the bucketed reduce path:
+        dp-sharded (flat reduce-scatter) when :meth:`grad_reduce_op` says
+        this stage reduce-scatters, replicated (all-reduce per bucket)
+        otherwise."""
+        if (
+            self.grad_reduce_op() == "reduce_scatter"
+            and self.mesh.shape.get(self.zero_axis, 1) > 1
+        ):
+            return PartitionSpec(self.zero_axis)
+        return PartitionSpec()
+
+    def supports_compressed_grads(self) -> bool:
+        """Compressed grad collectives run under ``shard_map`` with params
+        replicated over ``zero_axis`` — stage 3's dp-sharded params would
+        need an (uncompressed) allgather inside the mapped region, defeating
+        the wire savings. Stage <= 2 with a nontrivial axis qualifies."""
+        return self.stage <= 2 and self.mesh.shape.get(self.zero_axis, 1) > 1
+
+    def residual_shardings(self, abstract_params: PyTree) -> PyTree:
+        """Shardings for the error-feedback residuals
+        (``TrainState.comm_error``): one ``[world, ...]``-leading buffer per
+        param leaf, sharded over ``zero_axis`` so each rank's shard IS its
+        rank-local residual (same rationale as the 1-bit optimizer's
+        PER_RANK_STATE_FIELDS — claiming divergent buffers replicated is
+        undefined behaviour under reshard/donation)."""
+        sh = NamedSharding(self.mesh, PartitionSpec(self.zero_axis))
+        return jax.tree.map(lambda _: sh, abstract_params)
+
     # -- pytree-level -------------------------------------------------------
     def param_shardings(self, abstract_params: PyTree, logical_axes: Optional[PyTree] = None) -> PyTree:
         return self._tree_shardings(abstract_params, logical_axes, self.param_spec)

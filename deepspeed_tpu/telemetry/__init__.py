@@ -106,6 +106,7 @@ class Telemetry:
         spans: Optional[List[Span]] = None,
         hbm: Optional[Dict[str, int]] = None,
         comm_bytes: Optional[Dict[str, float]] = None,
+        comm_wire_bytes: Optional[Dict[str, float]] = None,
         extra: Optional[Dict[str, Any]] = None,
         aggregate: bool = False,
     ) -> Dict[str, Any]:
@@ -114,7 +115,11 @@ class Telemetry:
         ``kind`` labels the step family (``train`` / ``inference``);
         ``scalars`` are step-level floats (loss, lr, …); ``spans`` a flat
         (name, ms) list of host-side phases; ``comm_bytes`` per-mesh-axis
-        collective byte totals of the compiled step.
+        collective byte totals of the compiled step (HLO-derived — already
+        wire precision); ``comm_wire_bytes`` the compressed layer's own
+        on-wire totals, whose quotient against
+        ``extra["comm_compression"][axis]["logical_bytes"]`` is exported as
+        the ``comm_compression_ratio`` gauge.
         """
         scalars = scalars or {}
         self.registry.counter(
@@ -140,6 +145,29 @@ class Telemetry:
             )
             for axis, b in comm_bytes.items():
                 g.set(b, axis=axis)
+        if comm_wire_bytes:
+            gw = self.registry.gauge(
+                "comm_wire_bytes_per_step",
+                "actual on-wire collective bytes per compiled step (compressed "
+                "collectives), by mesh axis",
+                labelnames=("axis",),
+            )
+            gr = self.registry.gauge(
+                "comm_compression_ratio",
+                "logical/wire byte ratio of compressed collectives, by mesh axis",
+                labelnames=("axis",),
+            )
+            for axis, w in comm_wire_bytes.items():
+                gw.set(w, axis=axis)
+                # logical comes ONLY from the compressed layer's own stats
+                # (extra["comm_compression"]) — comm_bytes is HLO-derived and
+                # already wire precision (an int8 collective counts 1 B/elem),
+                # so dividing by it would report ~1x for compressed runs
+                logical = (
+                    (extra or {}).get("comm_compression", {}).get(axis, {}).get("logical_bytes")
+                )
+                if logical and w:
+                    gr.set(logical / w, axis=axis)
 
         dur_ms = duration_s * 1e3
         record: Dict[str, Any] = {
@@ -151,6 +179,8 @@ class Telemetry:
             "hbm": hbm or {},
             "comm_bytes": comm_bytes or {},
         }
+        if comm_wire_bytes:
+            record["comm_wire_bytes"] = comm_wire_bytes
         if extra:
             record.update(extra)
         if self.tracer is not None:

@@ -1,0 +1,59 @@
+"""jax version-compat shims.
+
+The codebase targets the current jax API surface; environments pin older
+jaxlib builds where two things moved:
+
+- ``shard_map`` graduated from ``jax.experimental.shard_map`` to ``jax``;
+- its replication-check kwarg was renamed ``check_rep`` -> ``check_vma``.
+
+:func:`shard_map` resolves whichever is installed and translates the kwarg,
+so call sites write the modern spelling once and run on both.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+try:  # modern jax: top-level export, check_vma kwarg
+    from jax import shard_map as _shard_map
+
+    _MODERN = True
+except ImportError:  # older jax: experimental module, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _MODERN = False
+
+
+def shard_map(
+    f,
+    mesh=None,
+    in_specs: Any = None,
+    out_specs: Any = None,
+    check_vma: Optional[bool] = None,
+    axis_names=None,
+    **kwargs,
+):
+    """``jax.shard_map`` with the ``check_vma`` / ``axis_names`` kwargs, on
+    any jax version. ``axis_names`` (modern partial-manual selection) maps to
+    the old API's complementary ``auto=`` frozenset."""
+    if check_vma is not None:
+        kwargs["check_vma" if _MODERN else "check_rep"] = check_vma
+    if axis_names is not None:
+        if _MODERN:
+            kwargs["axis_names"] = set(axis_names)
+        else:
+            kwargs["auto"] = frozenset(mesh.axis_names) - set(axis_names)
+            # partial-manual under the old API cannot track replication
+            kwargs.setdefault("check_rep", False)
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs)
+
+
+def pcast(x, axis_names, to: str = "varying"):
+    """``lax.pcast`` (varying-manual-axes marker of the modern check_vma
+    machinery), an identity on jax versions that predate it."""
+    from jax import lax
+
+    fn = getattr(lax, "pcast", None)
+    if fn is None:
+        return x
+    return fn(x, tuple(axis_names), to=to)

@@ -272,7 +272,7 @@ class TestRingFlashHardware:
         """Single-chip sp=1 ring: one diagonal step — compiles the flash
         fwd/bwd kernels inside the ring scan + switch on hardware (the
         multi-device ring path itself is covered by the CPU-mesh tests)."""
-        from jax import shard_map
+        from deepspeed_tpu.utils.compat import shard_map
         from jax.sharding import Mesh, PartitionSpec as P
 
         from deepspeed_tpu.ops.pallas.ring_flash_attention import ring_flash_attention

@@ -65,6 +65,37 @@ class TestKVCacheDecode:
         assert np.array_equal(np.asarray(out), np.asarray(ref[:, 6:]))
 
 
+class TestGenerateCacheLRU:
+    def test_cap_evictions_and_reuse(self, tiny_cfg, tiny_params):
+        """ISSUE 2 satellite: the compiled-generate cache is LRU-bounded
+        (each entry is a full XLA executable; unbounded growth across
+        (batch, prompt_len, max_new_tokens) shapes leaks device memory on
+        long-lived servers), with evictions counted."""
+        from deepspeed_tpu.inference.engine import InferenceEngine
+
+        eng = InferenceEngine(
+            gpt2.make_module(tiny_cfg), params=tiny_params, dtype=jnp.float32,
+            config={"generate_cache_size": 2},
+        )
+        ids = np.random.RandomState(0).randint(
+            0, tiny_cfg.vocab_size, (1, 4)
+        ).astype(np.int32)
+        eng.generate(ids, max_new_tokens=1)
+        eng.generate(ids, max_new_tokens=2)
+        assert len(eng._generate_cache) == 2
+        assert eng.generate_cache_evictions == 0
+        eng.generate(ids, max_new_tokens=1)  # hit: 1 becomes most-recent
+        eng.generate(ids, max_new_tokens=3)  # insert: evicts 2 (the LRU)
+        assert len(eng._generate_cache) == 2
+        assert eng.generate_cache_evictions == 1
+        live = {k[1] for k in eng._generate_cache}
+        assert live == {1, 3}
+        # the evicted shape still generates correctly (recompiles)
+        out = eng.generate(ids, max_new_tokens=2)
+        assert out.shape == (1, 6)
+        assert eng.generate_cache_evictions == 2
+
+
 class TestQuantizer:
     def test_roundtrip_error_bounded(self):
         rs = np.random.RandomState(0)

@@ -8,7 +8,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from deepspeed_tpu.utils.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.runtime.comm.compressed import (
