@@ -353,6 +353,114 @@ def _arm_inproc_watchdog(attempts: int, budget: float = None):
     return disarm
 
 
+def run_serving_bench():
+    """Offered-load sweep through the continuous-batching ServingEngine
+    (ISSUE 3): TTFT p50/p99, sustained tokens/s, and slot utilization at
+    under-/at-/over-capacity arrival rates. Emits BENCH_pr3.json.
+
+    Scale-aware: gpt2-tiny on CPU (the simulation harness the unit tests
+    use), the real gpt2 preset on TPU. BENCH_SERVING_MODEL / BENCH_SERVING_*
+    env knobs override."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.models import gpt2
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    model_name = os.environ.get(
+        "BENCH_SERVING_MODEL", "gpt2" if on_tpu else "gpt2-tiny"
+    )
+    cfg = gpt2.get_config(model_name)
+    params = jax.jit(lambda r: gpt2.init_params(cfg, r))(jax.random.PRNGKey(0))
+    eng = InferenceEngine(
+        gpt2.make_module(cfg), params=params,
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+    )
+    scfg = {
+        "max_slots": int(os.environ.get("BENCH_SERVING_SLOTS", "8" if on_tpu else "4")),
+        "page_size": 16 if on_tpu else 4,
+        "num_pages": 2048 if on_tpu else 128,
+        "max_prompt_len": 128 if on_tpu else 12,
+        "max_new_tokens": 64 if on_tpu else 8,
+        "max_queue_depth": 256,
+    }
+    srv = eng.serve(scfg)
+    rs = np.random.RandomState(0)
+    n_new = scfg["max_new_tokens"]
+
+    def mk_prompt():
+        plen = int(rs.randint(max(1, scfg["max_prompt_len"] // 4), scfg["max_prompt_len"] + 1))
+        return rs.randint(0, cfg.vocab_size, (plen,)).astype(np.int32)
+
+    # warmup: compile both executables + one full request lifecycle
+    srv.submit(mk_prompt(), max_new_tokens=n_new)
+    srv.run()
+    # warm decode-step latency (the service rate the sweep is scaled by)
+    t0 = _time.monotonic()
+    r = srv.submit(mk_prompt(), max_new_tokens=n_new)
+    srv.run()
+    step_s = max((_time.monotonic() - t0 - (r.ttft_s or 0)) / max(1, n_new - 1), 1e-5)
+
+    # request-service capacity: max_slots concurrent sequences, each holding a
+    # slot for ~n_new decode steps
+    cap_rps = scfg["max_slots"] / (n_new * step_s)
+    n_req = int(os.environ.get("BENCH_SERVING_REQUESTS", "32" if on_tpu else "24"))
+    sweep = []
+    for load in (0.5, 1.0, 2.0):
+        offered_rps = cap_rps * load
+        interarrival = 1.0 / offered_rps
+        prompts = [mk_prompt() for _ in range(n_req)]
+        reqs, utils = [], []
+        t_start = _time.monotonic()
+        i = 0
+        while i < len(prompts) or srv.queue or any(
+            s.request is not None for s in srv.slots
+        ):
+            now = _time.monotonic()
+            while i < len(prompts) and now >= t_start + i * interarrival:
+                reqs.append(srv.submit(prompts[i], max_new_tokens=n_new, seed=i))
+                i += 1
+            active = srv.step()
+            utils.append(active / srv.max_slots)
+            if active == 0 and not srv.queue and i < len(prompts):
+                _time.sleep(min(0.002, max(0.0, t_start + i * interarrival - now)))
+        t_total = _time.monotonic() - t_start
+        ttfts = sorted(r.ttft_s for r in reqs if r.ttft_s is not None)
+        toks = sum(len(r.tokens) for r in reqs)
+        statuses = {}
+        for r in reqs:
+            statuses[r.status] = statuses.get(r.status, 0) + 1
+        srv.check_no_leaks()
+        sweep.append({
+            "offered_load": load,
+            "offered_rps": round(offered_rps, 3),
+            "ttft_p50_ms": round(ttfts[len(ttfts) // 2] * 1e3, 3) if ttfts else None,
+            "ttft_p99_ms": round(
+                ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.99))] * 1e3, 3
+            ) if ttfts else None,
+            "tokens_per_sec": round(toks / t_total, 1) if t_total > 0 else None,
+            "slot_utilization_mean": round(float(np.mean(utils)), 3) if utils else 0.0,
+            "requests": statuses,
+        })
+    pr3 = {
+        "schema": "bench_pr3_serving_v1",
+        "model": model_name,
+        "backend": jax.default_backend(),
+        "serving_config": scfg,
+        "decode_step_ms_warm": round(step_s * 1e3, 3),
+        "capacity_rps_estimate": round(cap_rps, 3),
+        "requests_per_level": n_req,
+        "sweep": sweep,
+        "executables": len(srv.executables),
+    }
+    with open(os.path.join(_BENCH_DIR, "BENCH_pr3.json"), "w") as fh:
+        json.dump(pr3, fh, indent=1)
+    return pr3
+
+
 def main():
     ok, platform, attempts = _await_backend()
     if not ok:
@@ -748,9 +856,27 @@ def main():
         result["pr2_artifact"] = "BENCH_pr2.json"
     except Exception as e:
         result["pr2_error"] = f"{type(e).__name__}: {e}"
+    # --- BENCH_pr3.json (ISSUE 3): continuous-batching serving sweep —
+    # offered-load levels → TTFT p50/p99, tokens/s, slot utilization.
+    # BENCH_SERVING=0 opts out (it compiles two extra executables).
+    if os.environ.get("BENCH_SERVING", "1") == "1":
+        try:
+            pr3 = run_serving_bench()
+            result["pr3_artifact"] = "BENCH_pr3.json"
+            result["serving_tokens_per_sec_at_capacity"] = next(
+                (s["tokens_per_sec"] for s in pr3["sweep"] if s["offered_load"] == 1.0),
+                None,
+            )
+        except Exception as e:
+            result["pr3_error"] = f"{type(e).__name__}: {e}"
     disarm_watchdog()  # measurements done: nothing left that can wedge
     print(json.dumps(result))
 
 
 if __name__ == "__main__":
-    main()
+    # BENCH_SERVING_ONLY=1: just the serving sweep (CPU-friendly; no backend
+    # probe/training) — prints the BENCH_pr3.json content as the one JSON line
+    if os.environ.get("BENCH_SERVING_ONLY", "0") == "1":
+        print(json.dumps(run_serving_bench()))
+    else:
+        main()

@@ -22,6 +22,7 @@ __version__ = "0.5.0"
 __git_branch__ = "main"
 
 from . import comm  # noqa: F401
+from . import serving  # noqa: F401
 from . import telemetry  # noqa: F401
 from .comm.comm import init_distributed  # noqa: F401
 from .module_inject import (  # noqa: F401

@@ -113,6 +113,8 @@ class InferenceEngine:
         q = c.pop("quantization_setting", None)
         cfg_tel = c.pop("telemetry", None)
         cfg_cache = c.pop("generate_cache_size", None)
+        cfg_serving = c.pop("serving", None)
+        cfg_buckets = c.pop("prompt_bucket_sizes", None)
 
         mp_size = int(mp_size if mp_size is not _UNSET else (cfg_mp or 1))
         ep_size = int(ep_size if ep_size is not _UNSET else (cfg_ep or 1))
@@ -179,6 +181,13 @@ class InferenceEngine:
         self._generate_cache: "OrderedDict" = OrderedDict()
         self._generate_cache_cap = max(1, int(cfg_cache if cfg_cache is not None else 16))
         self.generate_cache_evictions = 0
+        # prompt-length bucketing for generate(): pad prompts up to the next
+        # bucket before the compile-cache lookup so the LRU stops holding one
+        # executable per unique prompt length. None = power-of-two buckets
+        # (default); a list pins explicit sizes; []/False disables.
+        self._prompt_buckets = cfg_buckets
+        # serving section ({"serving": {...}}): defaults for .serve()
+        self._serving_config = cfg_serving
         # unified telemetry plane (same TelemetryConfig schema as training;
         # config={"telemetry": {...}} — per-request JSONL records + registry)
         self.telemetry = None
@@ -313,6 +322,37 @@ class InferenceEngine:
 
     __call__ = forward
 
+    def _prompt_bucket(self, S: int, max_new_tokens: int) -> Optional[int]:
+        """Bucketed prompt length for the compile cache, or None when
+        bucketing is disabled (``prompt_bucket_sizes: []``/``false``).
+        Default (None/true): next power of two. A list pins explicit sizes
+        (next pow2 past the largest). Capped so bucket + max_new_tokens still
+        fits n_positions; never below the true length."""
+        b = self._prompt_buckets
+        if b is False or (isinstance(b, (list, tuple)) and len(b) == 0):
+            return None
+        cap = int(self.model_config.n_positions) - int(max_new_tokens)
+        if isinstance(b, (list, tuple)):
+            fits = sorted(int(x) for x in b if int(x) >= S)
+            bucket = fits[0] if fits else 1 << max(0, S - 1).bit_length()
+        else:
+            bucket = 1 << max(0, S - 1).bit_length()
+        return max(S, min(bucket, cap))
+
+    def serve(self, serving_config=None, clock=None):
+        """Continuous-batching server over this engine (serving/scheduler.py):
+        a paged KV pool + slot-based decode loop compiled exactly twice.
+        ``serving_config`` (dict or :class:`~deepspeed_tpu.runtime.config.ServingConfig`)
+        overrides the ``serving`` section passed to ``init_inference``."""
+        import time as _time
+
+        from ..serving import ServingEngine
+
+        cfg = serving_config if serving_config is not None else self._serving_config
+        return ServingEngine(
+            self, cfg, clock=clock if clock is not None else _time.monotonic
+        )
+
     def _telemetry_generate(self, duration_s: float, batch: int, prompt_len: int, new_tokens: int, cached: Optional[bool]) -> None:
         """One JSONL record + registry fold per generate() call (generate
         already blocks on its output, so sampling adds no extra sync).
@@ -367,7 +407,19 @@ class InferenceEngine:
             from ..models import decoder as gen_mod
 
         if gen_mod is not None:
-            key = (ids.shape, max_new_tokens, float(temperature), int(top_k), float(top_p))
+            S = int(ids.shape[1])
+            # prompt-length bucketing (gpt2 family): pad to the bucket and
+            # trace the true length, so every length in a bucket shares ONE
+            # compiled executable instead of one per unique prompt length
+            bucket = (
+                self._prompt_bucket(S, max_new_tokens)
+                if isinstance(self.model_config, GPT2Config) else None
+            )
+            shape_key = (
+                (int(ids.shape[0]), bucket) if bucket is not None
+                else tuple(ids.shape)
+            )
+            key = (shape_key, max_new_tokens, float(temperature), int(top_k), float(top_p))
             gen = self._generate_cache.get(key)
             was_cached = gen is not None
             if was_cached:
@@ -377,12 +429,23 @@ class InferenceEngine:
                 cache_dtype = self.dtype
                 mod = gen_mod
 
-                def gen_fn(params, ids, rng):
-                    return mod.generate(
-                        cfg, params, ids, max_new_tokens,
-                        temperature=temperature, rng=rng, cache_dtype=cache_dtype,
-                        top_k=top_k, top_p=top_p,
-                    )
+                if bucket is not None:
+                    from ..serving.model import generate_padded
+
+                    def gen_fn(params, ids_padded, plen, rng):
+                        return generate_padded(
+                            cfg, params, ids_padded, plen, max_new_tokens,
+                            temperature=temperature, rng=rng,
+                            cache_dtype=cache_dtype, top_k=top_k, top_p=top_p,
+                        )
+                else:
+
+                    def gen_fn(params, ids, rng):
+                        return mod.generate(
+                            cfg, params, ids, max_new_tokens,
+                            temperature=temperature, rng=rng, cache_dtype=cache_dtype,
+                            top_k=top_k, top_p=top_p,
+                        )
 
                 gen = jax.jit(gen_fn)
                 self._generate_cache[key] = gen
@@ -398,7 +461,14 @@ class InferenceEngine:
                     self.telemetry.registry.gauge(
                         "generate_cache_size", "live compiled-generate executables"
                     ).set(len(self._generate_cache))
-            new = gen(self.params, ids, rng)
+            if bucket is not None:
+                padded = (
+                    jnp.zeros((ids.shape[0], bucket), ids.dtype).at[:, :S].set(ids)
+                    if bucket > S else ids
+                )
+                new = gen(self.params, padded, jnp.int32(S), rng)
+            else:
+                new = gen(self.params, ids, rng)
             out = jnp.concatenate([ids, new.astype(ids.dtype)], axis=1)
             result = np.asarray(jax.device_get(out))
             if self.telemetry is not None:

@@ -641,11 +641,17 @@ def _attention_cached(cfg: GPT2Config, lp, h, k_cache, v_cache, pos):
 
 
 def forward_cached(
-    cfg: GPT2Config, params: PyTree, input_ids: jnp.ndarray, cache: KVCache
+    cfg: GPT2Config, params: PyTree, input_ids: jnp.ndarray, cache: KVCache,
+    logits_at=None,
 ) -> Tuple[jnp.ndarray, KVCache]:
     """input_ids [B,S] (S tokens starting at cache.pos) → (last-token logits
     [B,V], updated cache). One function serves prefill (S=prompt) and decode
     (S=1) — the reference splits these across qkv_gemm/softmax_context kernels.
+
+    ``logits_at`` (optional traced i32): read the head at this in-chunk
+    position instead of the last one — the bucket-padded prefill
+    (serving/model.generate_padded) feeds a right-padded chunk and needs the
+    logits of the true last prompt token.
     """
     B, S = input_ids.shape
     pos = cache.pos
@@ -664,7 +670,8 @@ def forward_cached(
         return h + m, (k_c, v_c)
 
     h, (new_k, new_v) = lax.scan(body, h, (params["blocks"], cache.k, cache.v))
-    h = _layer_norm(h[:, -1], params["ln_f"]["scale"], params["ln_f"]["bias"], eps)
+    h = h[:, -1] if logits_at is None else jnp.take(h, logits_at, axis=1)
+    h = _layer_norm(h, params["ln_f"]["scale"], params["ln_f"]["bias"], eps)
     # [B, V] logical vocab: padded head columns sliced off (see forward_with_aux)
     logits = (h @ params["wte"].T)[..., : cfg.vocab_size]
     return logits, KVCache(k=new_k, v=new_v, pos=pos + S)

@@ -93,6 +93,58 @@ def cached_attention(q, k_cache, v_cache, pos, impl: str = "auto", sm_scale: Opt
     return o.reshape(B, H, D).astype(q.dtype)
 
 
+def paged_cached_attention(
+    q, k_pool, v_pool, block_tables, pos, impl: str = "auto",
+    sm_scale: Optional[float] = None,
+):
+    """Single-token decode attention against a PAGED KV cache (the serving
+    subsystem's layout): q [B,H,D], pools [P,KV,page,D] (KV == H or
+    H % KV == 0), block_tables [B,n] i32 pool-page ids per slot, pos [B] i32
+    per-slot highest valid index (inclusive) → [B,H,D].
+
+    Dispatch mirrors :func:`cached_attention`: the Pallas paged kernel on TPU
+    (the block-table gather IS the kernel's index map — no dense copy), and a
+    pure-jnp fallback that gathers the slot's pages into a dense view and
+    runs the exact grouped einsum of :func:`cached_attention` with a per-slot
+    mask, so the two paths agree bit-for-bit with the dense cache."""
+    B, H, D = q.shape
+    P, KV, page, _ = k_pool.shape
+    if H % KV != 0:
+        raise ValueError(f"q heads {H} must divide by KV heads {KV}")
+    if impl in ("auto", "pallas"):
+        from .pallas.decode_attention import (
+            paged_decode_attention,
+            paged_decode_attention_ok,
+        )
+
+        if impl == "pallas" or paged_decode_attention_ok(page, D, k_pool.dtype.itemsize):
+            try:
+                return paged_decode_attention(
+                    q, k_pool, v_pool, block_tables, pos, sm_scale=sm_scale
+                )
+            except Exception as e:  # pragma: no cover
+                if impl == "pallas":
+                    raise
+                warning_once(f"pallas paged attention unavailable ({e}); using jnp path")
+    elif impl != "jnp":
+        raise ValueError(f"unknown attention impl {impl}")
+    # gather [B,n,KV,page,D] → logical [B,T,KV,D] per slot (pure data
+    # movement), then the same grouped math as cached_attention's fallback
+    kd = jnp.swapaxes(k_pool[block_tables], 2, 3).reshape(B, -1, KV, D)
+    vd = jnp.swapaxes(v_pool[block_tables], 2, 3).reshape(B, -1, KV, D)
+    scale = sm_scale if sm_scale is not None else 1.0 / (D**0.5)
+    S = kd.shape[1]
+    mask = jnp.arange(S)[None, None, :] <= pos[:, None, None]  # [B,1,S]
+    rep = H // KV
+    qg = q.reshape(B, KV, rep, D)
+    scores = jnp.einsum(
+        "bgrd,bsgd->bgrs", qg.astype(jnp.float32), kd.astype(jnp.float32)
+    ) * scale
+    probs = jax.nn.softmax(jnp.where(mask[:, :, None], scores, -1e30), axis=-1)
+    o = jnp.einsum("bgrs,bsgd->bgrd", probs, vd.astype(jnp.float32))
+    return o.reshape(B, H, D).astype(q.dtype)
+
+
 def windowed_attention_ok(q) -> bool:
     """Whether sliding-window causal attention will ride the Pallas kernels
     for this shape: the ordinary dispatch gate plus the resident-kernel

@@ -112,6 +112,138 @@ def decode_attention(
     return out.reshape(B, H, D)
 
 
+# ---------------------------------------------------------------------------
+# paged variant: K/V live in a shared page pool, gathered through a per-slot
+# block table (the serving subsystem's cache layout, serving/kv_cache.py).
+# Reference analog: vLLM's paged_attention kernel — but expressed TPU-natively:
+# the gather IS the BlockSpec index map (scalar-prefetched block table drives
+# which pool page each grid step DMAs into VMEM), so no dense copy of the
+# cache ever materializes.
+# ---------------------------------------------------------------------------
+
+
+def _paged_kernel(bt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_ref, l_ref, acc_ref, *, sm_scale: float, page: int):
+    """Online-softmax accumulation over one slot's pages.
+
+    Grid (B, H, n_pages): TPU grids run sequentially, so the (m, l, acc)
+    scratch persists across the innermost page dimension — reset at page 0,
+    emitted at the last page. Pages wholly past ``pos`` skip their compute
+    (their DMA still runs; block-table rows pad with the scratch page, so the
+    wasted bandwidth is one page per padded entry)."""
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    D = q_ref.shape[-1]
+
+    @pl.when(j == 0)
+    def _reset():
+        m_ref[0] = jnp.float32(-1e30)
+        l_ref[0] = jnp.float32(0.0)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    pos = pos_ref[b]
+
+    @pl.when(j * page <= pos)
+    def _update():
+        q = q_ref[...].reshape(1, D)
+        k = k_ref[0, 0]  # [page, D]
+        v = v_ref[0, 0]
+        s = jnp.dot(k, q.T, preferred_element_type=jnp.float32) * sm_scale  # [page,1]
+        idx = jax.lax.broadcasted_iota(jnp.int32, (page, 1), 0) + j * page
+        s = jnp.where(idx <= pos, s, -1e30)
+        m_prev, l_prev = m_ref[0], l_ref[0]
+        m_cur = jnp.maximum(m_prev, jnp.max(s))
+        corr = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur)
+        m_ref[0] = m_cur
+        l_ref[0] = l_prev * corr + jnp.sum(p)
+        acc_ref[...] = acc_ref[...] * corr + jnp.dot(
+            p.astype(v.dtype).T, v, preferred_element_type=jnp.float32
+        )
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _emit():
+        o_ref[...] = (
+            acc_ref[...] / jnp.maximum(l_ref[0], 1e-30)
+        ).reshape(o_ref.shape).astype(o_ref.dtype)
+
+
+def paged_decode_attention(
+    q: jnp.ndarray,  # [B, H, D] current-step queries (one per serving slot)
+    k_pool: jnp.ndarray,  # [P, KV, page, D] shared page pool
+    v_pool: jnp.ndarray,  # [P, KV, page, D]
+    block_tables: jnp.ndarray,  # [B, n_pages] i32 pool-page ids per slot
+    pos: jnp.ndarray,  # [B] i32: highest valid cache index per slot (inclusive)
+    sm_scale: Optional[float] = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Single-token attention against a PAGED cache → [B, H, D].
+
+    Each slot's logical cache is ``block_tables[b]``'s pages concatenated;
+    the index map gathers page ``j`` of slot ``b`` straight from the pool
+    (scalar-prefetched table), streaming one page per grid step through VMEM
+    with an online softmax. GQA as in :func:`decode_attention` (KV < H reads
+    the group's pool column)."""
+    B, H, D = q.shape
+    P, KV, page, _ = k_pool.shape
+    n_pages = block_tables.shape[1]
+    if H % KV != 0:
+        raise ValueError(f"q heads {H} must divide by KV heads {KV}")
+    rep = H // KV
+    scale = sm_scale if sm_scale is not None else 1.0 / (D**0.5)
+
+    kernel = functools.partial(_paged_kernel, sm_scale=float(scale), page=page)
+    q4 = q.reshape(B, H, 1, D)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,  # block table + per-slot positions
+            grid=(B, H, n_pages),
+            in_specs=[
+                pl.BlockSpec((1, 1, 1, D), lambda b, h, j, bt, pos: (b, h, 0, 0)),
+                pl.BlockSpec(
+                    (1, 1, page, D),
+                    lambda b, h, j, bt, pos: (bt[b, j], h // rep, 0, 0),
+                ),
+                pl.BlockSpec(
+                    (1, 1, page, D),
+                    lambda b, h, j, bt, pos: (bt[b, j], h // rep, 0, 0),
+                ),
+            ],
+            out_specs=pl.BlockSpec((1, 1, 1, D), lambda b, h, j, bt, pos: (b, h, 0, 0)),
+            scratch_shapes=[
+                pltpu.SMEM((1,), jnp.float32),  # running max
+                pltpu.SMEM((1,), jnp.float32),  # running denominator
+                pltpu.VMEM((1, D), jnp.float32),  # output accumulator
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, H, 1, D), q.dtype),
+        interpret=interpret,
+    )(
+        jnp.asarray(block_tables, jnp.int32),
+        jnp.asarray(pos, jnp.int32),
+        q4,
+        k_pool,
+        v_pool,
+    )
+    return out.reshape(B, H, D)
+
+
+def paged_decode_attention_ok(page: int, D: int, itemsize: int = 2) -> bool:
+    """Trace-time gate for the paged kernel: TPU backend, lane-friendly head
+    dim, sublane-aligned page length, and one page's K+V fitting VMEM (per-
+    program cost is pool/B/H independent — that's the point of paging)."""
+    from .flash_attention import VMEM_RESIDENT_BYTES
+
+    sublane = max(1, 32 // max(1, itemsize))
+    return (
+        jax.default_backend() == "tpu"
+        and D % 64 == 0
+        and page % sublane == 0
+        and 2 * page * D * itemsize <= VMEM_RESIDENT_BYTES
+    )
+
+
 def decode_attention_ok(S: int, D: int, itemsize: int = 2) -> bool:
     """Trace-time gate mirroring ops.attention._pallas_ok: TPU backend,
     lane-friendly head dim, and the K+V slabs of one (batch, head) program

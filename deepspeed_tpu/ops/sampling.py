@@ -19,11 +19,18 @@ NEG = -1e30
 
 
 def top_k_mask(logits: jnp.ndarray, k: int) -> jnp.ndarray:
-    """Keep the k highest logits per row, mask the rest to -inf."""
+    """Keep exactly the k highest logits per row, mask the rest to -inf.
+
+    Rank-based (scatter of ``top_k`` indices), not threshold-based: a
+    ``logits < kth`` comparison keeps EVERY token tied with the k-th logit,
+    so ties would let more than k tokens survive — ``lax.top_k`` breaks ties
+    deterministically by index, and the mask inherits that tie-break."""
     if k <= 0 or k >= logits.shape[-1]:
         return logits
-    kth = jax.lax.top_k(logits, k)[0][..., -1:]  # [.., 1] k-th largest
-    return jnp.where(logits < kth, NEG, logits)
+    idx = jax.lax.top_k(logits, k)[1]  # [.., k] winner indices, ties → lowest index
+    # one-hot over the vocab, folded over the k winners: [.., k, V] -> [.., V]
+    keep = jax.nn.one_hot(idx, logits.shape[-1], dtype=jnp.bool_).any(axis=-2)
+    return jnp.where(keep, logits, NEG)
 
 
 def top_p_mask(logits: jnp.ndarray, p: float) -> jnp.ndarray:
@@ -34,9 +41,12 @@ def top_p_mask(logits: jnp.ndarray, p: float) -> jnp.ndarray:
     sort_idx = jnp.argsort(-logits, axis=-1)
     sorted_logits = jnp.take_along_axis(logits, sort_idx, axis=-1)
     probs = jax.nn.softmax(sorted_logits, axis=-1)
-    # exclusive cumulative mass: the first token always survives
+    # exclusive cumulative mass: the first token always survives. The
+    # threshold backs off by one ulp-ish relative epsilon so a prefix whose
+    # true mass EQUALS p doesn't leak an extra token when cumsum rounds down
+    # (e.g. 0.5 + 0.3 -> 0.79999995 < 0.8).
     cum = jnp.cumsum(probs, axis=-1) - probs
-    keep_sorted = cum < p
+    keep_sorted = cum < p * (1.0 - 1e-6)
     masked_sorted = jnp.where(keep_sorted, sorted_logits, NEG)
     inv = jnp.argsort(sort_idx, axis=-1)
     return jnp.take_along_axis(masked_sorted, inv, axis=-1)

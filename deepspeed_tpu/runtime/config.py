@@ -400,6 +400,50 @@ class TelemetryConfig(DSConfigModel):
 
 
 @dataclass
+class ServingConfig(DSConfigModel):
+    """serving section (TPU-native; no reference analog — the reference serves
+    one static batch per ``InferenceEngine.forward`` call). Drives the
+    continuous-batching scheduler + paged KV cache (``serving/``): a slot-based
+    decode loop compiled EXACTLY TWICE (one prefill program, one decode-step
+    program, both shaped by this section alone), a shared KV page pool with a
+    free-list allocator, and admission control.
+
+    Sizing: the pool holds ``num_pages`` pages of ``page_size`` tokens (page 0
+    is reserved scratch); one request reserves
+    ``ceil((prompt_len + max_new_tokens) / page_size)`` pages at admission and
+    frees them when it finishes/evicts. ``max_prompt_len`` fixes the static
+    prefill width (rounded up to a page multiple). ``temperature``/``top_k``/
+    ``top_p`` are compiled into the decode program (static sampling — per-
+    request SEEDS vary freely, per-request sampling params would retrace).
+    ``default_deadline_s`` > 0 gives every request a deadline; a request past
+    its deadline degrades to a truncated response and its slot/pages are
+    reclaimed — a stuck request never wedges the batch."""
+
+    enabled: bool = False
+    max_slots: int = 8
+    page_size: int = 16
+    num_pages: int = 512
+    max_prompt_len: int = 128
+    max_new_tokens: int = 64
+    max_queue_depth: int = 64
+    default_deadline_s: float = 0.0  # 0 = no deadline
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    kv_cache_dtype: str = ""  # "" = the inference engine's dtype
+
+    def __post_init__(self):
+        for key in ("max_slots", "page_size", "num_pages", "max_prompt_len",
+                    "max_new_tokens", "max_queue_depth"):
+            if int(getattr(self, key)) <= 0:
+                raise DeepSpeedConfigError(f"serving.{key} must be positive")
+        if self.num_pages < 2:
+            raise DeepSpeedConfigError(
+                "serving.num_pages must be >= 2 (page 0 is reserved scratch)"
+            )
+
+
+@dataclass
 class DebugConfig(DSConfigModel):
     """First-class debug modes (reference stage3.py safe_mode,
     zero/utils.py assert_ints_same_as_other_ranks, coordinator trace checks;
@@ -453,6 +497,7 @@ class DeepSpeedConfig(DSConfigModel):
     tpu: TPUConfig = field(default_factory=TPUConfig)
     debug: DebugConfig = field(default_factory=DebugConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
+    serving: ServingConfig = field(default_factory=ServingConfig)
 
     gradient_clipping: float = 0.0
     prescale_gradients: bool = False

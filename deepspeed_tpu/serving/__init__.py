@@ -1,0 +1,30 @@
+"""Serving subsystem: continuous-batching scheduler + paged KV cache.
+
+The layer above the kernels that wins serving throughput at scale (PAPERS.md
+2207.00032), designed TPU-natively around XLA's static shapes (2605.25645):
+
+- :mod:`~deepspeed_tpu.serving.kv_cache` — page-pool allocator + block tables
+- :mod:`~deepspeed_tpu.serving.model` — the two compiled-once model programs
+  (paged prefill, batched paged decode step) + the bucket-padded offline
+  ``generate``
+- :mod:`~deepspeed_tpu.serving.scheduler` — :class:`ServingEngine`: slots,
+  admission control, deadlines, telemetry
+- :mod:`~deepspeed_tpu.serving.request` — request lifecycle
+
+Entry point: ``deepspeed_tpu.init_inference(...).serve(serving_config)``, or
+the ``serving`` section of the engine config. See docs/SERVING.md.
+"""
+
+from .kv_cache import PageAllocator, PageAllocatorError, SlotTable, pages_for
+from .request import Request, RequestStatus
+from .scheduler import ServingEngine
+
+__all__ = [
+    "PageAllocator",
+    "PageAllocatorError",
+    "Request",
+    "RequestStatus",
+    "ServingEngine",
+    "SlotTable",
+    "pages_for",
+]

@@ -1,0 +1,147 @@
+"""Paged KV cache: a fixed pool of fixed-size pages + a free-list allocator.
+
+The serving-side answer to XLA's static-shape constraint (PAPERS.md
+2605.25645): a dense per-request cache ``[B, prompt+new, H, D]`` either
+recompiles per length or pads every sequence to the worst case. Here ONE
+preallocated HBM pool ``[L, P, H, page, D]`` is carved into pages; each
+in-flight sequence owns a list of pages (its *block table* row), so wildly
+different lengths share the pool with at most ``page_size - 1`` wasted slots
+per sequence — the vLLM PagedAttention idea, expressed with TPU-native
+layouts (the page dim sits where Mosaic wants its sublane axis, see
+``ops/pallas/decode_attention.paged_decode_attention``).
+
+Page 0 is a permanently-reserved scratch page: inactive slots and the padded
+tail of block-table rows point at it, so every compiled gather/scatter index
+is valid without masking, and garbage writes land somewhere no active slot
+ever reads.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import jax.numpy as jnp
+import numpy as np
+
+SCRATCH_PAGE = 0  # reserved: never allocated, absorbs inactive-slot writes
+
+
+class PageAllocatorError(RuntimeError):
+    pass
+
+
+class PageAllocator:
+    """Free-list allocator over pages ``1..num_pages-1`` (0 = scratch).
+
+    LIFO reuse (a freshly-freed page is the next handed out) keeps the hot
+    working set small. ``alloc`` is all-or-nothing; ``free`` rejects
+    double-frees and foreign ids — the invariants the drain test asserts.
+    """
+
+    def __init__(self, num_pages: int):
+        if num_pages < 2:
+            raise ValueError(f"num_pages must be >= 2 (page 0 is scratch), got {num_pages}")
+        self.num_pages = int(num_pages)
+        self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
+        self._in_use: set = set()
+
+    @property
+    def capacity(self) -> int:
+        """Allocatable pages (excludes the scratch page)."""
+        return self.num_pages - 1
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        return len(self._in_use)
+
+    def alloc(self, n: int) -> List[int]:
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            raise PageAllocatorError(
+                f"KV pool exhausted: need {n} pages, {len(self._free)} free "
+                f"of {self.capacity}"
+            )
+        pages = [self._free.pop() for _ in range(n)]
+        self._in_use.update(pages)
+        return pages
+
+    def free(self, pages: List[int]) -> None:
+        for p in pages:
+            if p == SCRATCH_PAGE:
+                raise PageAllocatorError("cannot free the scratch page")
+            if p not in self._in_use:
+                raise PageAllocatorError(f"double free / foreign page {p}")
+            self._in_use.remove(p)
+            self._free.append(p)
+
+    def check_no_leaks(self) -> None:
+        if self._in_use:
+            raise PageAllocatorError(f"leaked pages: {sorted(self._in_use)}")
+
+
+class SlotTable:
+    """Host-side view of the per-slot block tables + sequence lengths.
+
+    The np arrays are the EXACT inputs of the compiled decode step — the
+    scheduler mutates them in place (admission writes a row, finish clears
+    it) and hands them to the executable each step; shapes never change, so
+    the step never retraces.
+    """
+
+    def __init__(self, max_slots: int, pages_per_slot: int):
+        self.max_slots = int(max_slots)
+        self.pages_per_slot = int(pages_per_slot)
+        self.block_tables = np.full((max_slots, pages_per_slot), SCRATCH_PAGE, np.int32)
+        self.seq_lens = np.zeros((max_slots,), np.int32)
+        self.tokens = np.zeros((max_slots,), np.int32)
+        self.keys = np.zeros((max_slots, 2), np.uint32)
+
+    def assign(self, slot: int, pages: List[int]) -> None:
+        if len(pages) > self.pages_per_slot:
+            raise ValueError(
+                f"slot {slot}: {len(pages)} pages > table width {self.pages_per_slot}"
+            )
+        row = self.block_tables[slot]
+        row[:] = SCRATCH_PAGE
+        row[: len(pages)] = pages
+
+    def clear(self, slot: int) -> None:
+        self.block_tables[slot, :] = SCRATCH_PAGE
+        self.seq_lens[slot] = 0
+        self.tokens[slot] = 0
+        self.keys[slot, :] = 0
+
+
+def pages_for(tokens: int, page_size: int) -> int:
+    """Pages needed to hold ``tokens`` cache entries."""
+    return -(-int(tokens) // int(page_size))
+
+
+def init_pools(
+    n_layer: int,
+    num_pages: int,
+    n_kv_head: int,
+    page_size: int,
+    head_dim: int,
+    dtype: Any = jnp.bfloat16,
+):
+    """The shared K and V pools, ``[L, P, KV, page, D]`` zeros.
+
+    Layout is kernel-native: per layer the pool is ``[P, KV, page, D]``, whose
+    trailing ``(page, D)`` dims are exactly one Mosaic block — the paged
+    kernel DMAs page ``block_table[b, j]`` without any transpose."""
+    shape = (n_layer, num_pages, n_kv_head, page_size, head_dim)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def pool_bytes(
+    n_layer: int, num_pages: int, n_kv_head: int, page_size: int, head_dim: int,
+    itemsize: int = 2,
+) -> int:
+    """HBM footprint of K+V pools (sizing aid for the ``serving`` config)."""
+    return 2 * n_layer * num_pages * n_kv_head * page_size * head_dim * itemsize

@@ -1,0 +1,301 @@
+"""Static-shape serving programs over the paged KV pool.
+
+Three compiled-once programs built from the gpt2 family's own building
+blocks (``models/gpt2``) so serving is BIT-IDENTICAL to per-request
+``generate``:
+
+- :func:`paged_prefill` — one request's prompt (right-padded to the static
+  prefill width) through the model, K/V written page-granularly into the
+  slot's pool pages, first token sampled at the true last prompt position.
+- :func:`paged_decode_step` — one token for EVERY slot: scatter the new K/V
+  into each slot's current page, attend through the block table
+  (``ops.attention.paged_cached_attention``), sample per-slot with per-slot
+  keys. All shapes are functions of the serving config only — finished
+  sequences vacating slots and new prompts arriving never retrace.
+- :func:`generate_padded` — the bucket-padded analog of ``gpt2.generate``
+  for the offline ``InferenceEngine.generate`` path: prompt length is a
+  TRACED scalar, so every length in a bucket reuses one executable.
+
+Why bit-identical: every op is row-independent across batch/slots, padded
+key positions contribute exact zeros through the masked softmax
+(``exp(-1e30 - m)`` underflows to 0.0), and garbage K/V at positions beyond
+a slot's length is either masked or overwritten by the decode write before
+that position is ever attended. The attention lines below deliberately
+mirror ``gpt2._attention_cached`` (same einsums, same casts, same mask
+compare) so the two paths cannot drift.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..models import gpt2
+from ..models.gpt2 import GPT2Config, KVCache, _layer_norm, _mlp
+from ..ops.quantizer import maybe_dequantize as _deq
+from ..ops.sampling import sample_logits
+
+PyTree = Any
+
+
+# ---------------------------------------------------------------------------
+# paged prefill (one request into one slot's pages)
+# ---------------------------------------------------------------------------
+
+def _attention_prefill_paged(cfg, lp, h, k_pool_l, v_pool_l, page_ids):
+    """Causal self-attention over the prompt chunk; K/V written to pages.
+
+    The chunk starts at position 0 of a fresh slot, so "the cache" IS the
+    chunk — the dense causal einsum here is exactly ``_attention_cached``'s
+    prefill path with ``pos = 0`` and ``Smax = Sp``."""
+    B, Sp, E = h.shape
+    H, D = cfg.n_head, cfg.head_dim
+    page = k_pool_l.shape[2]
+    qkv = h @ _deq(lp["c_attn_w"], h.dtype) + lp["c_attn_b"]
+    q, k_, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, Sp, H, D)
+    k_c = k_.reshape(B, Sp, H, D).astype(k_pool_l.dtype)
+    v_c = v.reshape(B, Sp, H, D).astype(v_pool_l.dtype)
+
+    # page-granular scatter: [Sp,H,D] → [n_pp, H, page, D] rows of the pool.
+    # Whole pages are overwritten — a slot's pages are fresh at admission and
+    # padded/garbage positions are masked until the decode write claims them;
+    # padded page_ids point at the scratch page.
+    n_pp = Sp // page
+    chunks = jnp.swapaxes(k_c[0].reshape(n_pp, page, H, D), 1, 2)
+    k_pool_l = k_pool_l.at[page_ids].set(chunks)
+    chunks_v = jnp.swapaxes(v_c[0].reshape(n_pp, page, H, D), 1, 2)
+    v_pool_l = v_pool_l.at[page_ids].set(chunks_v)
+
+    scale = 1.0 / np.sqrt(D)
+    scores = jnp.einsum(
+        "bshd,bthd->bhst", q.astype(jnp.float32), k_c.astype(jnp.float32)
+    ) * scale
+    j_idx = jnp.arange(Sp)
+    i_idx = jnp.arange(Sp)
+    mask = j_idx[None, :] <= i_idx[:, None]
+    scores = jnp.where(mask[None, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v_c.dtype)
+    o = jnp.einsum("bhst,bthd->bshd", probs, v_c)
+    o = o.reshape(B, Sp, E).astype(h.dtype)
+    return o @ _deq(lp["c_proj_w"], h.dtype) + lp["c_proj_b"], k_pool_l, v_pool_l
+
+
+def paged_prefill(
+    cfg: GPT2Config,
+    params: PyTree,
+    input_ids: jnp.ndarray,   # [1, Sp] right-padded to the static prefill width
+    prompt_len: jnp.ndarray,  # traced i32: true prompt length
+    k_pool: jnp.ndarray,      # [L, P, KV, page, D]
+    v_pool: jnp.ndarray,
+    page_ids: jnp.ndarray,    # [Sp // page] i32 slot pages (scratch-padded)
+    rng: jnp.ndarray,         # PRNGKey for the first sampled token
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """→ (k_pool, v_pool, first_token [1])."""
+    B, Sp = input_ids.shape
+    eps = cfg.layer_norm_epsilon
+    positions = jnp.arange(Sp)
+    h = params["wte"][input_ids] + params["wpe"][positions][None, :, :]
+
+    def body(h, xs):
+        lp, kp, vp = xs
+        a, kp, vp = _attention_prefill_paged(
+            cfg, lp["attn"],
+            _layer_norm(h, lp["ln_1"]["scale"], lp["ln_1"]["bias"], eps),
+            kp, vp, page_ids,
+        )
+        h = h + a
+        m, _aux = _mlp(
+            cfg, lp["mlp"],
+            _layer_norm(h, lp["ln_2"]["scale"], lp["ln_2"]["bias"], eps),
+            False, None,
+        )
+        return h + m, (kp, vp)
+
+    h, (new_k, new_v) = lax.scan(body, h, (params["blocks"], k_pool, v_pool))
+    h_last = jnp.take(h, prompt_len - 1, axis=1)  # [B, E] true last prompt pos
+    h_last = _layer_norm(h_last, params["ln_f"]["scale"], params["ln_f"]["bias"], eps)
+    logits = (h_last @ params["wte"].T)[..., : cfg.vocab_size]
+    first = sample_logits(logits, rng, temperature, top_k, top_p)
+    return new_k, new_v, first
+
+
+# ---------------------------------------------------------------------------
+# paged decode step (one token for every slot)
+# ---------------------------------------------------------------------------
+
+def _attention_decode_paged(cfg, lp, h, k_pool_l, v_pool_l, block_tables,
+                            pos, pidx, poff):
+    """One-token attention per slot against its paged cache.
+
+    ``pos[b]`` = tokens already cached for slot b (the new token's position);
+    new K/V scatters to (page ``pidx[b]``, offset ``poff[b]``) before the
+    gather, mirroring ``_attention_cached``'s update-then-attend order."""
+    B, S, E = h.shape  # S == 1
+    H, D = cfg.n_head, cfg.head_dim
+    qkv = h @ _deq(lp["c_attn_w"], h.dtype) + lp["c_attn_b"]
+    q, k_, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, S, H, D)
+    k_c = k_.reshape(B, S, H, D).astype(k_pool_l.dtype)
+    v_c = v.reshape(B, S, H, D).astype(v_pool_l.dtype)
+
+    # [B,H,D] values to (pidx[b], :, poff[b], :) — advanced indices around the
+    # head slice put the batch dim first, matching the value layout. Inactive
+    # slots target the scratch page.
+    k_pool_l = k_pool_l.at[pidx, :, poff].set(k_c[:, 0])
+    v_pool_l = v_pool_l.at[pidx, :, poff].set(v_c[:, 0])
+
+    scale = 1.0 / np.sqrt(D)
+    if cfg.attn_impl in ("auto", "pallas"):
+        from ..ops.attention import paged_cached_attention
+
+        o1 = paged_cached_attention(
+            q[:, 0], k_pool_l, v_pool_l, block_tables, pos,
+            impl=cfg.attn_impl, sm_scale=scale,
+        )
+        o = o1.reshape(B, 1, E).astype(h.dtype)
+        return o @ _deq(lp["c_proj_w"], h.dtype) + lp["c_proj_b"], k_pool_l, v_pool_l
+
+    # jnp impl: gather the slot's pages into the dense view and run the exact
+    # dense einsum of _attention_cached's decode path, with a per-slot mask.
+    # NOT deduplicated into paged_cached_attention's jnp fallback on purpose:
+    # that fallback mirrors cached_attention (f32 probs·V einsum), while an
+    # attn_impl="jnp" config's generate decodes through _attention_cached's
+    # own branch (probs cast to the CACHE dtype before the V einsum) — for
+    # bf16 caches the two round differently, and serving must match whichever
+    # path generate takes for the model's impl, bit for bit.
+    kd = jnp.swapaxes(k_pool_l[block_tables], 2, 3).reshape(B, -1, H, D)
+    vd = jnp.swapaxes(v_pool_l[block_tables], 2, 3).reshape(B, -1, H, D)
+    Smax = kd.shape[1]
+    scores = jnp.einsum(
+        "bshd,bthd->bhst", q.astype(jnp.float32), kd.astype(jnp.float32)
+    ) * scale
+    mask = jnp.arange(Smax)[None, :] <= pos[:, None]  # [B, Smax]
+    scores = jnp.where(mask[:, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(vd.dtype)
+    o = jnp.einsum("bhst,bthd->bshd", probs, vd)
+    o = o.reshape(B, S, E).astype(h.dtype)
+    return o @ _deq(lp["c_proj_w"], h.dtype) + lp["c_proj_b"], k_pool_l, v_pool_l
+
+
+def paged_decode_step(
+    cfg: GPT2Config,
+    params: PyTree,
+    tokens: jnp.ndarray,        # [B] i32 last emitted token per slot
+    seq_lens: jnp.ndarray,      # [B] i32 tokens already cached per slot
+    k_pool: jnp.ndarray,        # [L, P, KV, page, D]
+    v_pool: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, n_pages] i32
+    keys: jnp.ndarray,          # [B, 2] u32 per-slot sampling keys
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """→ (k_pool, v_pool, next_tokens [B])."""
+    B = tokens.shape[0]
+    page = k_pool.shape[3]
+    eps = cfg.layer_norm_epsilon
+    h = params["wte"][tokens][:, None, :] + params["wpe"][seq_lens][:, None, :]
+    pidx = jnp.take_along_axis(
+        block_tables, (seq_lens // page)[:, None], axis=1
+    )[:, 0]
+    poff = seq_lens % page
+
+    def body(h, xs):
+        lp, kp, vp = xs
+        a, kp, vp = _attention_decode_paged(
+            cfg, lp["attn"],
+            _layer_norm(h, lp["ln_1"]["scale"], lp["ln_1"]["bias"], eps),
+            kp, vp, block_tables, seq_lens, pidx, poff,
+        )
+        h = h + a
+        m, _aux = _mlp(
+            cfg, lp["mlp"],
+            _layer_norm(h, lp["ln_2"]["scale"], lp["ln_2"]["bias"], eps),
+            False, None,
+        )
+        return h + m, (kp, vp)
+
+    h, (new_k, new_v) = lax.scan(body, h, (params["blocks"], k_pool, v_pool))
+    h_last = _layer_norm(
+        h[:, -1], params["ln_f"]["scale"], params["ln_f"]["bias"], eps
+    )
+    logits = (h_last @ params["wte"].T)[..., : cfg.vocab_size]
+    if not temperature or temperature <= 0.0:
+        nxt = jnp.argmax(logits.astype(jnp.float32), axis=-1)
+    else:
+        # per-slot keys: each row samples exactly as its own B=1 generate
+        # (vmap of the PRNG is semantics-preserving, so slot b's draw equals
+        # the sequential request's draw with the same key)
+        nxt = jax.vmap(
+            lambda lg, kk: sample_logits(
+                lg[None, :], kk, temperature, top_k, top_p
+            )[0]
+        )(logits, keys)
+    return new_k, new_v, nxt
+
+
+# ---------------------------------------------------------------------------
+# bucket-padded offline generate (InferenceEngine.generate satellite)
+# ---------------------------------------------------------------------------
+
+def generate_padded(
+    cfg: GPT2Config,
+    params: PyTree,
+    input_ids: jnp.ndarray,   # [B, Sb] right-padded to the bucket length
+    prompt_len: jnp.ndarray,  # traced i32: true prompt length
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    rng=None,
+    cache_dtype=jnp.bfloat16,
+    top_k: int = 0,
+    top_p: float = 1.0,
+) -> jnp.ndarray:
+    """``gpt2.generate`` with a traced prompt length: one executable serves
+    every prompt length in the bucket. Prefill runs on the padded chunk
+    (garbage K/V past ``prompt_len`` is masked until the decode writes
+    overwrite it), the head reads the true last prompt position, and the
+    decode scan is ``gpt2.generate``'s own. Returns [B, max_new_tokens],
+    bit-identical to the unpadded path."""
+    B, Sb = input_ids.shape
+    max_len = Sb + max_new_tokens
+    if max_len > cfg.n_positions:
+        raise ValueError(
+            f"bucket ({Sb}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"n_positions={cfg.n_positions}"
+        )
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    cache = gpt2.init_cache(cfg, B, max_len, dtype=cache_dtype)
+    logits, cache = gpt2.forward_cached(
+        cfg, params, input_ids, cache, logits_at=prompt_len - 1
+    )
+    # rewind pos to the true length: decode overwrites the padded garbage
+    cache = KVCache(k=cache.k, v=cache.v, pos=jnp.asarray(prompt_len, jnp.int32))
+
+    def sample(lg, key):
+        return sample_logits(lg, key, temperature, top_k, top_p)
+
+    first = sample(logits, rng)
+    if max_new_tokens == 1:
+        return first[:, None]
+
+    def step(carry, key):
+        token, cache = carry
+        lg, cache = gpt2.forward_cached(
+            cfg, params, token[:, None].astype(input_ids.dtype), cache
+        )
+        nxt = sample(lg, key)
+        return (nxt, cache), token
+
+    keys = jax.random.split(jax.random.fold_in(rng, 1), max_new_tokens - 1)
+    (last, _), tokens = lax.scan(step, (first, cache), keys)
+    return jnp.concatenate([jnp.moveaxis(tokens, 0, 1), last[:, None]], axis=1)

@@ -1,0 +1,83 @@
+"""Serving request lifecycle: QUEUED → RUNNING → FINISHED/TRUNCATED, or
+REJECTED at the door (admission control) / TIMED_OUT while still queued.
+
+A request is the unit the continuous-batching scheduler moves through slots
+(serving/scheduler.py). ``tokens`` accumulates as the slot decodes; the
+deadline fields make timeout eviction deterministic under an injected clock
+(tests drive a fake clock, production uses ``time.monotonic``).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+
+class RequestStatus:
+    QUEUED = "queued"
+    RUNNING = "running"
+    FINISHED = "finished"       # emitted max_new_tokens or hit EOS
+    TRUNCATED = "truncated"     # deadline passed mid-decode: partial output
+    TIMED_OUT = "timed_out"     # deadline passed before ever reaching a slot
+    REJECTED = "rejected"       # backpressure: queue full / can never fit
+
+    TERMINAL = (FINISHED, TRUNCATED, TIMED_OUT, REJECTED)
+
+
+_ids = itertools.count()
+
+
+@dataclass
+class Request:
+    """One generation request. ``prompt`` is a 1-D int array of token ids."""
+
+    prompt: np.ndarray
+    max_new_tokens: int
+    seed: int = 0
+    eos_token_id: Optional[int] = None
+    # relative deadline (seconds from submit); None → serving config default
+    deadline_s: Optional[float] = None
+    # original ask when admission clamped max_new_tokens (over-long request
+    # degrading to a truncated response); None = not clamped
+    requested_new_tokens: Optional[int] = None
+
+    # -- filled by the scheduler ---------------------------------------
+    id: int = field(default_factory=lambda: next(_ids))
+    status: str = RequestStatus.QUEUED
+    tokens: List[int] = field(default_factory=list)
+    detail: str = ""            # why rejected/truncated
+    t_submit: float = 0.0
+    t_first_token: Optional[float] = None
+    t_finish: Optional[float] = None
+
+    @property
+    def done(self) -> bool:
+        return self.status in RequestStatus.TERMINAL
+
+    @property
+    def prompt_len(self) -> int:
+        return int(np.asarray(self.prompt).shape[-1])
+
+    @property
+    def output(self) -> np.ndarray:
+        """prompt + generated tokens, the ``generate()``-shaped result."""
+        return np.concatenate(
+            [np.asarray(self.prompt, np.int32).reshape(-1),
+             np.asarray(self.tokens, np.int32)]
+        )
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.t_first_token is None:
+            return None
+        return self.t_first_token - self.t_submit
+
+    @property
+    def tpot_s(self) -> Optional[float]:
+        """Mean time per output token AFTER the first (decode cadence)."""
+        if self.t_finish is None or self.t_first_token is None or len(self.tokens) < 2:
+            return None
+        return (self.t_finish - self.t_first_token) / (len(self.tokens) - 1)

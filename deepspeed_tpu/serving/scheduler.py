@@ -1,0 +1,467 @@
+"""Continuous-batching scheduler: slot-based decode over the paged KV pool.
+
+The serving answer to DeepSpeed-Inference's throughput story (PAPERS.md
+2207.00032) under XLA's static-shape constraint (2605.25645): instead of one
+static batch per ``generate`` call, a fixed array of ``max_slots`` decode
+slots advances one token per step through ONE compiled decode program, while
+finished sequences vacate their slot mid-flight and queued requests are
+admitted into free slots via prefill-insertions (ONE compiled prefill
+program). Exactly two executables exist for the lifetime of the engine —
+``ServingEngine.executables`` — because every input shape is a function of
+the ``serving`` config alone:
+
+- tokens/seq_lens/keys: ``[max_slots]`` — inactive slots ride along pointed
+  at the scratch page (their compute is garbage nobody reads; all ops are
+  row-independent, so active slots are unaffected).
+- prompts: right-padded to the static prefill width, true length traced.
+- the KV cache: a paged pool + per-slot block tables (serving/kv_cache.py),
+  so sequence length never appears in any array shape.
+
+Robustness: admission control (queue-depth + KV-page budget) rejects at the
+door; per-request deadlines evict mid-flight to a TRUNCATED response; an
+over-long ask is clamped at submit. A stuck or runaway request can therefore
+never wedge the batch — the invariant the timeout tests pin down.
+
+Determinism: slot ``b``'s token stream is bit-identical to a sequential
+``generate`` of the same request (see serving/model.py for why), which the
+token-equivalence test asserts for mixed-length streams.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Deque, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.gpt2 import GPT2Config
+from ..telemetry.registry import MetricsRegistry
+from ..utils.logging import log_dist
+from . import model as smodel
+from .kv_cache import PageAllocator, SlotTable, init_pools, pages_for, pool_bytes
+from .request import Request, RequestStatus
+
+# TTFT/TPOT histogram buckets (seconds): sub-ms CPU-sim steps through
+# multi-second queue waits
+LATENCY_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+
+@dataclass
+class _Slot:
+    request: Optional[Request] = None
+    pages: List[int] = field(default_factory=list)
+    pos: int = 0    # tokens currently in this slot's cache
+    step: int = 0   # decode steps completed
+    keys: Optional[np.ndarray] = None  # [max_new-1, 2] u32 decode sampling keys
+
+
+class ServingEngine:
+    """Continuous-batching front end over an :class:`InferenceEngine`.
+
+    Construct via ``InferenceEngine.serve()`` (or directly); drive with
+    :meth:`submit` + :meth:`step`, or :meth:`run` to drain. ``clock`` is
+    injectable for deterministic timeout tests."""
+
+    def __init__(self, engine, config=None, clock=time.monotonic):
+        from ..runtime.config import ServingConfig
+
+        if config is None:
+            config = ServingConfig()
+        elif isinstance(config, dict):
+            config = ServingConfig.from_dict(config)
+        self.config = config
+        self.engine = engine
+        self.clock = clock
+        mcfg = engine.model_config
+        if not isinstance(mcfg, GPT2Config):
+            raise ValueError(
+                "ServingEngine v1 serves the gpt2 family (GPT2Config models, "
+                f"including injected HF GPT-2); got {type(mcfg).__name__}"
+            )
+        self.model_config = mcfg
+
+        page = int(config.page_size)
+        self.page_size = page
+        # static prefill width: max_prompt_len rounded up to whole pages
+        self.prefill_pages = pages_for(config.max_prompt_len, page)
+        self.prefill_width = self.prefill_pages * page
+        self.max_total_len = min(
+            int(config.max_prompt_len) + int(config.max_new_tokens),
+            int(mcfg.n_positions),
+        )
+        if self.prefill_width > mcfg.n_positions:
+            raise ValueError(
+                f"serving.max_prompt_len (page-rounded to {self.prefill_width}) "
+                f"exceeds the model's n_positions={mcfg.n_positions}"
+            )
+        self.pages_per_slot = pages_for(self.max_total_len, page)
+        self.allocator = PageAllocator(int(config.num_pages))
+        if self.pages_per_slot > self.allocator.capacity:
+            raise ValueError(
+                f"serving.num_pages={config.num_pages} cannot hold even one "
+                f"max-size request ({self.pages_per_slot} pages of {page} "
+                "tokens; page 0 is scratch)"
+            )
+
+        self.cache_dtype = (
+            jnp.dtype(config.kv_cache_dtype).type if config.kv_cache_dtype
+            else engine.dtype
+        )
+        self.max_slots = int(config.max_slots)
+        self.k_pool, self.v_pool = init_pools(
+            mcfg.n_layer, int(config.num_pages), mcfg.n_head, page,
+            mcfg.head_dim, dtype=self.cache_dtype,
+        )
+        self.table = SlotTable(self.max_slots, self.pages_per_slot)
+        self.slots: List[_Slot] = [_Slot() for _ in range(self.max_slots)]
+        self.queue: Deque[Request] = deque()
+        self.completed: List[Request] = []
+        self._sampling = float(config.temperature) > 0.0
+
+        # -- telemetry (PR-1 registry when the engine carries one) ---------
+        self.metrics: MetricsRegistry = (
+            engine.telemetry.registry if getattr(engine, "telemetry", None)
+            else MetricsRegistry()
+        )
+        m = self.metrics
+        self._h_ttft = m.histogram(
+            "serving_ttft_seconds", "submit → first token", buckets=LATENCY_BUCKETS
+        )
+        self._h_tpot = m.histogram(
+            "serving_tpot_seconds", "mean per-token decode latency per request",
+            buckets=LATENCY_BUCKETS,
+        )
+        self._h_step = m.histogram(
+            "serving_decode_step_seconds", "one batched decode step",
+            buckets=LATENCY_BUCKETS,
+        )
+        self._c_requests = m.counter(
+            "serving_requests_total", "requests by terminal status",
+            labelnames=("status",),
+        )
+        self._c_tokens = m.counter("serving_tokens_total", "generated tokens")
+        self._c_prefills = m.counter("serving_prefills_total", "prefill insertions")
+        self._c_steps = m.counter("serving_decode_steps_total", "batched decode steps")
+        self._c_timeouts = m.counter(
+            "serving_timeout_evictions_total",
+            "requests evicted mid-flight by deadline",
+        )
+        self._g_queue = m.gauge("serving_queue_depth", "waiting requests")
+        self._g_util = m.gauge(
+            "serving_slot_utilization", "active slots / max_slots"
+        )
+        self._g_pages = m.gauge("serving_kv_pages_in_use", "allocated KV pages")
+        self._g_occ = m.gauge(
+            "serving_kv_page_occupancy", "allocated / allocatable KV pages"
+        )
+
+        self._prefill_exec = None
+        self._decode_exec = None
+        self.executables: List[Any] = []
+        log_dist(
+            f"ServingEngine: slots={self.max_slots} page={page} "
+            f"pages={config.num_pages} (pool "
+            f"{pool_bytes(mcfg.n_layer, int(config.num_pages), mcfg.n_head, page, mcfg.head_dim, np.dtype(self.cache_dtype).itemsize) / 1e6:.1f} MB) "
+            f"prefill_width={self.prefill_width} dtype={np.dtype(self.cache_dtype).name}"
+        )
+
+    # ------------------------------------------------------------------
+    # compilation: exactly two executables, ahead-of-time
+    # ------------------------------------------------------------------
+    def _ensure_compiled(self) -> None:
+        if self._prefill_exec is not None:
+            return
+        cfg = self.model_config
+        sc = self.config
+        temp, tk, tp = float(sc.temperature), int(sc.top_k), float(sc.top_p)
+
+        def prefill_fn(params, k_pool, v_pool, ids, plen, page_ids, key):
+            return smodel.paged_prefill(
+                cfg, params, ids, plen, k_pool, v_pool, page_ids, key,
+                temperature=temp, top_k=tk, top_p=tp,
+            )
+
+        def decode_fn(params, k_pool, v_pool, tokens, seq_lens, bt, keys):
+            return smodel.paged_decode_step(
+                cfg, params, tokens, seq_lens, k_pool, v_pool, bt, keys,
+                temperature=temp, top_k=tk, top_p=tp,
+            )
+
+        S = jax.ShapeDtypeStruct
+        i32, u32 = jnp.int32, jnp.uint32
+        # AOT: lower + compile ONCE with the config-derived static shapes;
+        # the compiled objects reject any other shape, enforcing the
+        # two-executables contract structurally (pools are donated — the
+        # cache never exists twice)
+        self._prefill_exec = jax.jit(prefill_fn, donate_argnums=(1, 2)).lower(
+            self.engine.params, self.k_pool, self.v_pool,
+            S((1, self.prefill_width), i32), S((), i32),
+            S((self.prefill_pages,), i32), S((2,), u32),
+        ).compile()
+        self._decode_exec = jax.jit(decode_fn, donate_argnums=(1, 2)).lower(
+            self.engine.params, self.k_pool, self.v_pool,
+            S((self.max_slots,), i32), S((self.max_slots,), i32),
+            S((self.max_slots, self.pages_per_slot), i32),
+            S((self.max_slots, 2), u32),
+        ).compile()
+        self.executables = [self._prefill_exec, self._decode_exec]
+
+    # ------------------------------------------------------------------
+    # admission control
+    # ------------------------------------------------------------------
+    def submit(
+        self,
+        prompt,
+        max_new_tokens: Optional[int] = None,
+        seed: int = 0,
+        eos_token_id: Optional[int] = None,
+        deadline_s: Optional[float] = None,
+    ) -> Request:
+        """Enqueue one request. Backpressure REJECTS at the door (queue depth,
+        or a prompt that can never fit); an over-long ``max_new_tokens`` is
+        clamped and the response marked TRUNCATED at finish."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        mnt = int(self.config.max_new_tokens if max_new_tokens is None else max_new_tokens)
+        req = Request(
+            prompt=prompt, max_new_tokens=mnt, seed=int(seed),
+            eos_token_id=eos_token_id, deadline_s=deadline_s,
+        )
+        req.t_submit = self.clock()
+        plen = req.prompt_len
+        if plen < 1 or plen > int(self.config.max_prompt_len):
+            return self._reject(
+                req, f"prompt length {plen} outside [1, {self.config.max_prompt_len}]"
+            )
+        if mnt < 1:
+            return self._reject(req, f"max_new_tokens {mnt} < 1")
+        cap = min(int(self.config.max_new_tokens), self.max_total_len - plen)
+        if cap < 1:
+            return self._reject(req, f"prompt length {plen} leaves no decode budget")
+        if mnt > cap:
+            # degrade, don't wedge: the response will be truncated at cap
+            req.requested_new_tokens = mnt
+            req.max_new_tokens = cap
+            req.detail = f"max_new_tokens clamped {mnt} -> {cap}"
+        if len(self.queue) >= int(self.config.max_queue_depth):
+            return self._reject(req, f"queue full ({self.config.max_queue_depth})")
+        self.queue.append(req)
+        self._g_queue.set(len(self.queue))
+        return req
+
+    def _reject(self, req: Request, why: str) -> Request:
+        req.status = RequestStatus.REJECTED
+        req.detail = why
+        req.t_finish = self.clock()
+        self._c_requests.inc(status=RequestStatus.REJECTED)
+        self.completed.append(req)
+        return req
+
+    def _deadline(self, req: Request) -> Optional[float]:
+        d = req.deadline_s
+        if d is None:
+            d = float(self.config.default_deadline_s) or None
+        return None if d is None else req.t_submit + d
+
+    # ------------------------------------------------------------------
+    # the scheduler loop
+    # ------------------------------------------------------------------
+    def step(self) -> int:
+        """One scheduler iteration: evict deadline-passed work, admit queued
+        requests into free slots (prefill insertion), advance every active
+        slot one token. Returns the number of active slots after the step."""
+        self._ensure_compiled()
+        now = self.clock()
+
+        # 1. timeout eviction — a request past its deadline degrades to a
+        # truncated response; its slot and pages are reclaimed immediately
+        for i, slot in enumerate(self.slots):
+            if slot.request is None:
+                continue
+            dl = self._deadline(slot.request)
+            if dl is not None and now > dl:
+                self._c_timeouts.inc()
+                self._finish_slot(i, RequestStatus.TRUNCATED, "deadline exceeded", now)
+        if self.queue:
+            keep: Deque[Request] = deque()
+            for req in self.queue:
+                dl = self._deadline(req)
+                if dl is not None and now > dl:
+                    req.status = RequestStatus.TIMED_OUT
+                    req.detail = "deadline exceeded while queued"
+                    req.t_finish = now
+                    self._c_requests.inc(status=RequestStatus.TIMED_OUT)
+                    self.completed.append(req)
+                else:
+                    keep.append(req)
+            self.queue = keep
+
+        # 2. prefill insertions: FIFO admission into free slots, gated by the
+        # KV-page budget (head-of-line blocks until draining slots free pages)
+        while self.queue:
+            free = next(
+                (i for i, s in enumerate(self.slots) if s.request is None), None
+            )
+            if free is None:
+                break
+            req = self.queue[0]
+            need = pages_for(req.prompt_len + req.max_new_tokens, self.page_size)
+            if need > self.allocator.free_pages:
+                break
+            self.queue.popleft()
+            self._admit(free, req)
+
+        # 3. one batched decode step for every active slot
+        active = [i for i, s in enumerate(self.slots) if s.request is not None]
+        if active:
+            t0 = self.clock()
+            kp, vp, nxt = self._decode_exec(
+                self.engine.params, self.k_pool, self.v_pool,
+                jnp.asarray(self.table.tokens), jnp.asarray(self.table.seq_lens),
+                jnp.asarray(self.table.block_tables), jnp.asarray(self.table.keys),
+            )
+            self.k_pool, self.v_pool = kp, vp
+            nxt_np = np.asarray(jax.device_get(nxt))
+            now = self.clock()
+            self._h_step.observe(now - t0)
+            self._c_steps.inc()
+            for i in active:
+                slot = self.slots[i]
+                req = slot.request
+                tok = int(nxt_np[i])
+                req.tokens.append(tok)
+                slot.pos += 1
+                slot.step += 1
+                self.table.seq_lens[i] = slot.pos
+                self.table.tokens[i] = tok
+                if len(req.tokens) >= req.max_new_tokens or (
+                    req.eos_token_id is not None and tok == req.eos_token_id
+                ):
+                    self._finish_slot(i, RequestStatus.FINISHED, "", now)
+                elif slot.keys is not None and slot.step < len(slot.keys):
+                    self.table.keys[i] = slot.keys[slot.step]
+
+        n_active = sum(1 for s in self.slots if s.request is not None)
+        self._g_queue.set(len(self.queue))
+        self._g_util.set(n_active / self.max_slots)
+        self._g_pages.set(self.allocator.pages_in_use)
+        self._g_occ.set(self.allocator.pages_in_use / self.allocator.capacity)
+        return n_active
+
+    def _admit(self, slot_i: int, req: Request) -> None:
+        pages = self.allocator.alloc(
+            pages_for(req.prompt_len + req.max_new_tokens, self.page_size)
+        )
+        slot = self.slots[slot_i]
+        slot.request = req
+        slot.pages = pages
+        slot.pos = 0
+        slot.step = 0
+        slot.keys = None
+        self.table.assign(slot_i, pages)
+
+        ids = np.zeros((1, self.prefill_width), np.int32)
+        ids[0, : req.prompt_len] = req.prompt
+        page_ids = self.table.block_tables[slot_i, : self.prefill_pages]
+        key0 = np.asarray(jax.random.PRNGKey(req.seed))
+        kp, vp, first = self._prefill_exec(
+            self.engine.params, self.k_pool, self.v_pool,
+            jnp.asarray(ids), jnp.asarray(req.prompt_len, jnp.int32),
+            jnp.asarray(page_ids), jnp.asarray(key0),
+        )
+        self.k_pool, self.v_pool = kp, vp
+        self._c_prefills.inc()
+        tok0 = int(np.asarray(jax.device_get(first))[0])
+        now = self.clock()
+        req.status = RequestStatus.RUNNING
+        req.t_first_token = now
+        self._h_ttft.observe(now - req.t_submit)
+        req.tokens.append(tok0)
+        slot.pos = req.prompt_len
+        self.table.seq_lens[slot_i] = slot.pos
+        self.table.tokens[slot_i] = tok0
+        if self._sampling and req.max_new_tokens > 1:
+            # the EXACT key sequence of gpt2.generate for this request:
+            # step t consumes split(fold_in(PRNGKey(seed), 1), N-1)[t-1]
+            slot.keys = np.asarray(
+                jax.random.split(
+                    jax.random.fold_in(jax.random.PRNGKey(req.seed), 1),
+                    req.max_new_tokens - 1,
+                )
+            )
+            self.table.keys[slot_i] = slot.keys[0]
+        if req.max_new_tokens == 1 or (
+            req.eos_token_id is not None and tok0 == req.eos_token_id
+        ):
+            self._finish_slot(slot_i, RequestStatus.FINISHED, "", now)
+
+    def _finish_slot(self, slot_i: int, status: str, detail: str, now: float) -> None:
+        slot = self.slots[slot_i]
+        req = slot.request
+        stopped_on_eos = (
+            req.eos_token_id is not None
+            and bool(req.tokens)
+            and req.tokens[-1] == req.eos_token_id
+        )
+        if (
+            req.requested_new_tokens is not None
+            and status == RequestStatus.FINISHED
+            and not stopped_on_eos
+        ):
+            # the clamp actually bit: the decode budget ran out short of the
+            # original ask. An EOS stop is a complete response even when the
+            # ask was clamped.
+            status = RequestStatus.TRUNCATED
+        req.status = status
+        if detail:
+            req.detail = detail
+        req.t_finish = now
+        tpot = req.tpot_s
+        if tpot is not None:
+            self._h_tpot.observe(tpot)
+        self._c_requests.inc(status=status)
+        self._c_tokens.inc(len(req.tokens))
+        self.allocator.free(slot.pages)
+        self.table.clear(slot_i)
+        self.slots[slot_i] = _Slot()
+        self.completed.append(req)
+
+    def run(self, max_steps: Optional[int] = None) -> List[Request]:
+        """Drive :meth:`step` until queue and slots drain; returns every
+        request completed during the run (in completion order). ``max_steps``
+        bounds the loop; the default budget covers the worst case, so hitting
+        it means a scheduler bug — raise rather than wedge."""
+        if max_steps is None:
+            budget = sum(
+                r.max_new_tokens for r in self.queue
+            ) + sum(
+                s.request.max_new_tokens for s in self.slots if s.request is not None
+            )
+            max_steps = 2 * budget + len(self.queue) + 16
+        start = len(self.completed)
+        for _ in range(max_steps):
+            if not self.queue and all(s.request is None for s in self.slots):
+                break
+            self.step()
+        else:
+            raise RuntimeError(
+                f"ServingEngine.run: no drain within {max_steps} steps "
+                f"(queue={len(self.queue)}, "
+                f"active={sum(1 for s in self.slots if s.request)})"
+            )
+        return self.completed[start:]
+
+    # ------------------------------------------------------------------
+    def check_no_leaks(self) -> None:
+        """Drain invariant: every page back on the free list, every slot
+        empty, every block-table entry pointing at scratch."""
+        self.allocator.check_no_leaks()
+        assert all(s.request is None for s in self.slots)
+        assert (self.table.block_tables == 0).all()
+        assert (self.table.seq_lens == 0).all()

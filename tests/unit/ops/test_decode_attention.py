@@ -94,3 +94,88 @@ class TestGQADecode:
         k = jnp.zeros((1, 64, 3, 64))
         with pytest.raises(ValueError, match="divide"):
             decode_attention(q, k, k, jnp.int32(0), interpret=True)
+
+
+class TestPagedDecode:
+    """Paged variant (ISSUE 3): K/V gathered through a block table from a
+    shared page pool — the serving subsystem's cache layout."""
+
+    def _setup(self, B=3, H=4, KV=4, D=64, page=8, P=16, n=4, seed=0):
+        rs = np.random.RandomState(seed)
+        q = jnp.asarray(rs.randn(B, H, D), jnp.float32)
+        kp = jnp.asarray(rs.randn(P, KV, page, D), jnp.float32)
+        vp = jnp.asarray(rs.randn(P, KV, page, D), jnp.float32)
+        # distinct non-scratch pages per slot: the gather must actually
+        # follow the table, not page order
+        bt = jnp.asarray(
+            rs.choice(np.arange(1, P), (B * n,), replace=False).reshape(B, n),
+            jnp.int32,
+        )
+        return q, kp, vp, bt
+
+    @pytest.mark.parametrize("pos", [[0, 13, 31], [5, 5, 5]])
+    def test_kernel_matches_jnp_gather_fallback(self, pos):
+        from deepspeed_tpu.ops.attention import paged_cached_attention
+        from deepspeed_tpu.ops.pallas.decode_attention import paged_decode_attention
+
+        q, kp, vp, bt = self._setup()
+        pos = jnp.asarray(pos, jnp.int32)
+        out = paged_decode_attention(q, kp, vp, bt, pos, interpret=True)
+        ref = paged_cached_attention(q, kp, vp, bt, pos, impl="jnp")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def test_matches_dense_kernel_on_gathered_view(self):
+        """Paged(pool, table) == dense decode kernel on the logically
+        contiguous per-slot cache — paging is pure data movement."""
+        from deepspeed_tpu.ops.pallas.decode_attention import paged_decode_attention
+
+        q, kp, vp, bt = self._setup(seed=1)
+        B, n, page = 3, 4, 8
+        pos = jnp.asarray([0, 17, 31], jnp.int32)
+        out = paged_decode_attention(q, kp, vp, bt, pos, interpret=True)
+        kd = jnp.swapaxes(kp[bt], 2, 3).reshape(B, n * page, 4, 64)
+        vd = jnp.swapaxes(vp[bt], 2, 3).reshape(B, n * page, 4, 64)
+        for b in range(B):
+            ref = decode_attention(
+                q[b : b + 1], kd[b : b + 1], vd[b : b + 1], pos[b], interpret=True
+            )
+            np.testing.assert_allclose(
+                np.asarray(out[b]), np.asarray(ref[0]), atol=2e-5, rtol=2e-5
+            )
+
+    def test_gqa_pool(self):
+        from deepspeed_tpu.ops.attention import paged_cached_attention
+        from deepspeed_tpu.ops.pallas.decode_attention import paged_decode_attention
+
+        q, _, _, bt = self._setup()
+        rs = np.random.RandomState(2)
+        kp = jnp.asarray(rs.randn(16, 2, 8, 64), jnp.float32)  # KV=2 < H=4
+        vp = jnp.asarray(rs.randn(16, 2, 8, 64), jnp.float32)
+        pos = jnp.asarray([3, 9, 30], jnp.int32)
+        out = paged_decode_attention(q, kp, vp, bt, pos, interpret=True)
+        ref = paged_cached_attention(q, kp, vp, bt, pos, impl="jnp")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def test_scratch_padded_table_entries_are_ignored(self):
+        """Entries past a slot's length point at the scratch page; whatever
+        lives there must not leak into the output."""
+        from deepspeed_tpu.ops.pallas.decode_attention import paged_decode_attention
+
+        q, kp, vp, bt = self._setup(B=1, n=4)
+        pos = jnp.asarray([7], jnp.int32)  # only page 0 of the slot is valid
+        out1 = paged_decode_attention(q, kp, vp, bt, pos, interpret=True)
+        # rewrite every page except the slot's first: output unchanged
+        keep = int(bt[0, 0])
+        poisoned = kp.at[jnp.arange(16) != keep].set(99.0)
+        poisoned_v = vp.at[jnp.arange(16) != keep].set(-99.0)
+        bt_scratch = bt.at[0, 1:].set(0)
+        out2 = paged_decode_attention(q, poisoned, poisoned_v, bt_scratch, pos, interpret=True)
+        np.testing.assert_allclose(np.asarray(out1), np.asarray(out2), atol=1e-6)
+
+    def test_bad_head_ratio_raises(self):
+        from deepspeed_tpu.ops.pallas.decode_attention import paged_decode_attention
+
+        q, _, _, bt = self._setup()
+        kp = jnp.zeros((16, 3, 8, 64), jnp.float32)
+        with pytest.raises(ValueError, match="divide"):
+            paged_decode_attention(q, kp, kp, bt, jnp.asarray([0, 0, 0], jnp.int32), interpret=True)

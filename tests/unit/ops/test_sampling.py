@@ -19,6 +19,19 @@ class TestMasks:
             want = set(np.argsort(-np.asarray(logits[r]))[:5])
             assert kept == want
 
+    def test_top_k_ties_keep_exactly_k(self):
+        """ISSUE 3 satellite regression: a threshold mask (`logits < kth`)
+        keeps every token tied with the k-th logit; the rank-based mask must
+        keep EXACTLY k, breaking ties by index like lax.top_k."""
+        logits = jnp.asarray([[1.0] * 5 + [0.0] * 5, [2.0] * 10], jnp.float32)
+        out = np.asarray(top_k_mask(logits, 3))
+        assert ((out > -1e29).sum(axis=-1) == 3).all()
+        # lax.top_k tie-break: lowest indices win
+        np.testing.assert_array_equal(np.where(out[0] > -1e29)[0], [0, 1, 2])
+        np.testing.assert_array_equal(np.where(out[1] > -1e29)[0], [0, 1, 2])
+        # kept entries keep their values
+        assert (out[0][:3] == 1.0).all()
+
     def test_top_k_noop_for_zero_or_full(self):
         logits = jnp.ones((2, 8))
         np.testing.assert_array_equal(top_k_mask(logits, 0), logits)
