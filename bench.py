@@ -869,6 +869,57 @@ def main():
             )
         except Exception as e:
             result["pr3_error"] = f"{type(e).__name__}: {e}"
+    # --- BENCH_pr5.json (ISSUE 5): performance-introspection artifact — the
+    # HLO analyzer's MFU + per-category flops/bytes from the forced sampled
+    # step's record (vs the analytic MFU above), plus a trace_diff self-check:
+    # the bench trace diffed against itself MUST exit 0, proving the
+    # regression gate wiring end-to-end in every bench run
+    try:
+        trace_file = result.get("telemetry", {}).get("trace_file")
+        intro = None
+        if trace_file and os.path.exists(trace_file):
+            with open(trace_file) as fh:
+                recs = [json.loads(l) for l in fh if l.strip()]
+            intro = next(
+                (r["introspection"] for r in reversed(recs)
+                 if r.get("kind") == "train_step" and "introspection" in r),
+                None,
+            )
+        pr5 = {
+            "schema": "bench_pr5_introspection_v1",
+            "metric": result["metric"],
+            "tokens_per_sec_chip": result["value"],
+            "step_latency_ms": result["step_ms"],
+            "mfu_analytic": result["mfu"],
+            # HLO-walk MFU: per-device program against the peak table entry
+            # (CPU runs report against the nominal fallback entry)
+            "mfu_hlo": intro.get("mfu") if intro else None,
+            "roofline_bound": intro.get("roofline_bound") if intro else None,
+            "overlap_fraction": intro.get("overlap_fraction") if intro else None,
+            "arithmetic_intensity": intro.get("arithmetic_intensity") if intro else None,
+            "flops_per_category": intro.get("flops_per_category") if intro else None,
+            "bytes_per_category": intro.get("bytes_per_category") if intro else None,
+            "peak": intro.get("peak") if intro else None,
+        }
+        if trace_file and os.path.exists(trace_file):
+            import contextlib
+            import io
+
+            from deepspeed_tpu.tools import trace_diff as _td
+
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                rc = _td.main([trace_file, trace_file])
+            pr5["trace_diff_selfcheck"] = "ok" if rc == 0 else f"exit={rc}"
+            if rc != 0:
+                pr5["trace_diff_output"] = buf.getvalue()[-2000:]
+        with open(os.path.join(_BENCH_DIR, "BENCH_pr5.json"), "w") as fh:
+            json.dump(pr5, fh, indent=1)
+        result["pr5_artifact"] = "BENCH_pr5.json"
+        result["mfu_hlo"] = pr5["mfu_hlo"]
+        result["roofline_bound"] = pr5["roofline_bound"]
+    except Exception as e:
+        result["pr5_error"] = f"{type(e).__name__}: {e}"
     disarm_watchdog()  # measurements done: nothing left that can wedge
     print(json.dumps(result))
 

@@ -65,6 +65,39 @@ def main() -> int:
     print(f"devices ............. {len(devs)} x {devs[0].device_kind if devs else '-'}")
     print(f"process count ....... {jax.process_count()}")
     print("-" * 60)
+    print("Telemetry / introspection:")
+    try:
+        import jax.profiler  # noqa: F401
+
+        print(f"jax.profiler ........ {GREEN_OK} (watchdog auto-capture available)")
+    except Exception:
+        print(f"jax.profiler ........ {RED_NO} (watchdog captures disabled)")
+    try:
+        from deepspeed_tpu.telemetry.introspect import chip_peak
+
+        peak = chip_peak(devs[0].device_kind if devs else None)
+        note = "" if peak.source == "table" else f" ({peak.source} — nominal numbers)"
+        print(
+            f"peak table .......... {peak.device_kind}: "
+            f"{peak.peak_flops / 1e12:.1f} TFLOP/s, "
+            f"{peak.hbm_bytes_per_s / 1e9:.0f} GB/s HBM{note}"
+        )
+    except Exception as e:
+        print(f"peak table .......... {RED_NO} ({type(e).__name__})")
+    try:
+        from deepspeed_tpu.telemetry.watchdog import AnomalyWatchdog  # noqa: F401
+
+        print(
+            f"anomaly watchdog .... {GREEN_OK} "
+            "(telemetry.watchdog — disabled by default; policy continue|kill)"
+        )
+    except Exception:
+        print(f"anomaly watchdog .... {RED_NO}")
+    print(
+        "run diff ............ python -m deepspeed_tpu.tools.trace_diff "
+        "A.jsonl B.jsonl"
+    )
+    print("-" * 60)
     return 0
 
 

@@ -377,6 +377,64 @@ class DataTypesConfig(DSConfigModel):
 
 
 @dataclass
+class IntrospectionConfig(DSConfigModel):
+    """telemetry.introspection section (ISSUE 5 tentpole): the HLO cost/MFU
+    analyzer (``telemetry/introspect.py``). On each sampled step of a
+    DISTINCT compiled program the engine walks the post-optimization HLO
+    into a per-category flops/bytes breakdown, computes step MFU against
+    the per-chip peak table (CPU fallback included) and a roofline
+    classification, and attaches the report to the StepTracer record +
+    registry gauges (``step_mfu``, ``flops_per_category``,
+    ``overlap_fraction``). ``peak_tflops`` overrides the table's flops
+    column (e.g. a derated fleet SKU). Costs one extra lower+compile per
+    distinct program — cheap with the persistent compilation cache."""
+
+    enabled: bool = True
+    peak_tflops: float = 0.0  # 0 = per-chip table lookup by device kind
+
+
+@dataclass
+class WatchdogConfig(DSConfigModel):
+    """telemetry.watchdog section (ISSUE 5 tentpole): in-run anomaly
+    detection (``telemetry/watchdog.py``). ``nan_check`` folds a
+    ``jnp.isfinite`` bitmask over loss/grad-norm into the compiled step;
+    spikes are EMA z-scores on loss / grad_norm / step time, judged every
+    ``check_every`` steps after ``warmup_steps`` observations. A trip
+    emits a structured ``anomaly`` trace event and schedules a bounded
+    ``jax.profiler`` capture of the next step (``max_captures`` dirs under
+    ``capture_dir``, oldest pruned). ``policy``: ``continue`` keeps
+    training, ``kill`` raises ``AnomalyError`` after recording.
+    ``straggler_factor`` drives the serving-slot straggler detector
+    (``ServingEngine.step``). Disabled ⇒ nothing constructed, zero host
+    callbacks."""
+
+    enabled: bool = False
+    nan_check: bool = True
+    zscore: float = 6.0
+    ema_alpha: float = 0.05
+    min_rel_std: float = 0.02  # std floor as a fraction of |mean|
+    warmup_steps: int = 20
+    check_every: int = 1
+    policy: str = "continue"  # continue | kill
+    capture_dir: str = "./telemetry/anomalies"
+    max_captures: int = 3
+    straggler_factor: float = 3.0
+
+    def __post_init__(self):
+        if self.policy not in ("continue", "kill"):
+            raise DeepSpeedConfigError(
+                f"telemetry.watchdog.policy must be 'continue' or 'kill', "
+                f"got {self.policy!r}"
+            )
+        if self.zscore <= 0:
+            raise DeepSpeedConfigError("telemetry.watchdog.zscore must be positive")
+        if not 0.0 < self.ema_alpha <= 1.0:
+            raise DeepSpeedConfigError(
+                "telemetry.watchdog.ema_alpha must be in (0, 1]"
+            )
+
+
+@dataclass
 class TelemetryConfig(DSConfigModel):
     """telemetry section (TPU-native; no reference analog — subsumes the
     reference's scattered observability: timer log lines, flops-profiler
@@ -389,14 +447,20 @@ class TelemetryConfig(DSConfigModel):
     each one blocks on the step's outputs to read scalars, so 1 serializes
     the host loop with the device (fine for debugging, use 10-100 in
     production). ``flush_interval`` is records per file append / Prometheus
-    rewrite. Disabled ⇒ nothing is constructed and ``train_batch`` adds no
-    host callbacks."""
+    rewrite. ``trace_max_mb`` caps each per-host trace file: at the cap the
+    file atomically rolls to ``<name>.1`` (one rolled generation kept —
+    disk stays bounded at ~2x the cap on unbounded runs; 0 disables).
+    Disabled ⇒ nothing is constructed and ``train_batch`` adds no host
+    callbacks."""
 
     enabled: bool = False
     trace_path: str = "./telemetry"
     prometheus_path: str = ""  # "" = no Prometheus snapshot
     flush_interval: int = 20
     sample_every: int = 1
+    trace_max_mb: int = 64  # 0 = unbounded
+    introspection: IntrospectionConfig = field(default_factory=IntrospectionConfig)
+    watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
 
 
 @dataclass

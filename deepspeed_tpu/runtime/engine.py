@@ -125,6 +125,13 @@ class DeepSpeedEngine:
 
         # set before the step builders run (they read it)
         self._debug_nan_check = config.debug.enabled and config.debug.nan_check
+        # watchdog in-step NaN/Inf flags (telemetry.watchdog.nan_check) are
+        # folded into the compiled step by the builders — decide here, once,
+        # before any step compiles
+        wcfg = config.telemetry.watchdog
+        self._watchdog_nan_check = bool(
+            config.telemetry.enabled and wcfg.enabled and wcfg.nan_check
+        )
 
         # --- ZeRO sharding policy
         zcfg = config.zero_optimization
@@ -273,6 +280,11 @@ class DeepSpeedEngine:
         from .. import telemetry as _telemetry
 
         self.telemetry = _telemetry.from_config(config.telemetry)
+        # anomaly watchdog (ISSUE 5): None when disabled — the step path
+        # pays one None check, no EMA state, no captures
+        self._watchdog = (
+            self.telemetry.watchdog if self.telemetry is not None else None
+        )
         self._finish_init(model, config, training_data, collate_fn)
 
     def _init_param_offload(self, model, config, zcfg, seed, params) -> None:
@@ -1111,6 +1123,7 @@ class DeepSpeedEngine:
         pld_theta0 = float(pld_cfg.theta)
         pld_gamma = float(pld_cfg.gamma)
         debug_nan = self._debug_nan_check
+        wd_nan = self._watchdog_nan_check
 
         # NOTE: these take the COMPUTE-dtype copy of the params. The fp32->bf16
         # master cast is hoisted out of the per-microbatch scan (one cast per
@@ -1263,6 +1276,15 @@ class DeepSpeedEngine:
                 "lr": jnp.asarray(self.lr_schedule(state.global_step), jnp.float32),
                 "global_step": new_state.global_step,
             }
+            if wd_nan:
+                # watchdog NaN/Inf bitmask, computed in-graph (folded into
+                # the compiled step — no extra host callback; the host reads
+                # it with the metrics it already fetches). bit0=loss,
+                # bit1=grad_norm (telemetry/watchdog.py FLAG_*)
+                metrics["anomaly_flags"] = (
+                    (~jnp.isfinite(metrics["loss"])).astype(jnp.int32)
+                    + 2 * (~jnp.isfinite(gnorm)).astype(jnp.int32)
+                )
             if debug_nan:
                 from .debug import tree_nan_scan
 
@@ -1322,6 +1344,7 @@ class DeepSpeedEngine:
         method, block = cc.method, int(cc.block_size)
         use_ef = cc.error_feedback
         debug_nan = self._debug_nan_check
+        wd_nan = self._watchdog_nan_check
 
         btreedef = jax.tree.structure(self.state.params)
         bshapes = [tuple(l.shape) for l in jax.tree.leaves(self.state.params)]
@@ -1440,6 +1463,11 @@ class DeepSpeedEngine:
                 "lr": jnp.asarray(self.lr_schedule(state.global_step), jnp.float32),
                 "global_step": new_state.global_step,
             }
+            if wd_nan:
+                metrics["anomaly_flags"] = (
+                    (~jnp.isfinite(loss)).astype(jnp.int32)
+                    + 2 * (~jnp.isfinite(gnorm)).astype(jnp.int32)
+                )
             if debug_nan:
                 from .debug import tree_nan_scan
 
@@ -1570,7 +1598,12 @@ class DeepSpeedEngine:
             batch = next(data_iter)
         tel = self.telemetry
         sampled = tel is not None and tel.should_sample(self.global_steps + 1)
-        t_start = time.perf_counter() if sampled else 0.0
+        wd = self._watchdog
+        if wd is not None and wd.capture_pending:
+            # a prior step tripped: this step runs under a bounded profiler
+            # capture (stopped after the sync below)
+            wd.start_capture(self.global_steps + 1)
+        t_start = time.perf_counter() if (sampled or wd is not None) else 0.0
         if self.wall_clock_breakdown:
             self.timers(TRAIN_BATCH_TIMER).start()
         self.tput_timer.start()
@@ -1621,6 +1654,8 @@ class DeepSpeedEngine:
         # XLA dispatches asynchronously, so stopping on dispatch-return would
         # inflate samples/sec by the whole device step time
         self.tput_timer.stop(sync_tree=metrics)
+        if wd is not None:
+            self._watchdog_step(wd, metrics, t_start)
         if sampled:
             self._telemetry_step(tel, metrics, t_start, t_prepared, t_dispatched)
 
@@ -1699,6 +1734,24 @@ class DeepSpeedEngine:
         }
         if comp:
             extra["comm_compression"] = comp
+        # HLO cost/MFU introspection (ISSUE 5): the program analysis is
+        # cached per compiled program; the MFU re-derives each sampled step
+        # from THIS step's measured duration
+        ana = self._introspection_analysis()
+        if ana is not None:
+            from ..telemetry import introspect as _intro
+
+            report = _intro.step_report(
+                ana,
+                duration_s=t_synced - t_start,
+                peak=_intro.chip_peak(
+                    peak_flops_override=float(
+                        getattr(tel.introspection, "peak_tflops", 0.0) or 0.0
+                    ) * 1e12
+                ),
+            )
+            extra["introspection"] = report
+            _intro.export_to_registry(tel.registry, report)
         tel.record_step(
             "train",
             step=self.global_steps,
@@ -1710,6 +1763,77 @@ class DeepSpeedEngine:
             comm_wire_bytes={a: r["wire_bytes"] for a, r in comp.items()} or None,
             extra=extra,
         )
+
+    def _watchdog_step(self, wd, metrics, t_start: float) -> None:
+        """Close any active anomaly capture, then judge this step's scalars
+        (ISSUE 5 watchdog). ``anomaly_flags`` — the in-graph NaN/Inf bitmask
+        — is popped from the metrics surface regardless of the check cadence.
+        The scalars are already synced (tput_timer.stop blocked on them), so
+        the ``device_get`` here is a cheap host copy, not a device sync.
+        Raises AnomalyError under policy="kill"."""
+        wd.stop_capture()
+        flags_arr = (
+            metrics.pop("anomaly_flags", None) if isinstance(metrics, dict) else None
+        )
+        flags = int(jax.device_get(flags_arr)) if flags_arr is not None else None
+        if self.global_steps % wd.check_every != 0:
+            # off-cadence steps skip the EMA/spike judgement only — the
+            # in-graph NaN/Inf flags are computed every compiled step and a
+            # transient non-finite must not slip through the cadence
+            if flags:
+                wd.observe_step(self.global_steps, {}, flags=flags)
+            return
+        scalars: Dict[str, float] = {"step_time_s": time.perf_counter() - t_start}
+        for k in ("loss", "grad_norm"):
+            if isinstance(metrics, dict) and k in metrics:
+                try:
+                    scalars[k] = float(jax.device_get(metrics[k]))
+                except (TypeError, ValueError):
+                    pass
+        wd.observe_step(self.global_steps, scalars, flags=flags)
+
+    def _lower_step_compiled(self):
+        """Lower + compile the current jitted step for program-level analysis
+        (comms accounting, HLO introspection) without perturbing the
+        compressed layer's trace-time records."""
+        from ..comm.compressed import suspend_records
+
+        with suspend_records():
+            return self._train_step.lower(*self._step_arg_structs).compile()
+
+    def _introspection_analysis(self):
+        """Per-category HLO cost analysis of the current step program
+        (telemetry.introspection tentpole), cached per distinct program.
+        One lower+compile covers BOTH this and the comms accounting: the
+        compiled object is handed to ``_record_step_comms`` so the sampled
+        step pays a single re-lower. None on multi-program engine paths
+        (offload/onebit/infinity) and when introspection is disabled."""
+        tel = self.telemetry
+        icfg = tel.introspection if tel is not None else None
+        if icfg is None or not icfg.enabled:
+            return None
+        key = self._jit_step_programs()
+        cached = getattr(self, "_introspect_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        ana = None
+        if hasattr(self._train_step, "lower") and self._step_arg_structs is not None:
+            try:
+                compiled = self._lower_step_compiled()
+                from ..telemetry import introspect as _intro
+
+                ana = _intro.analyze_compiled(
+                    compiled,
+                    loop_iterations=self.gradient_accumulation_steps_value,
+                )
+                try:  # feed the comms accounting from the same compiled step
+                    self._record_step_comms(compiled=compiled)
+                except Exception:
+                    pass
+            except Exception:
+                ana = None
+        self._introspect_cache = (key, ana)
+        return ana
 
     def _compression_stats(self) -> Dict[str, Dict[str, float]]:
         """Per-axis {logical_bytes, wire_bytes, ratio} of ONE compressed
@@ -1751,11 +1875,13 @@ class DeepSpeedEngine:
         except Exception:
             return 0
 
-    def _record_step_comms(self) -> Dict:
+    def _record_step_comms(self, compiled=None) -> Dict:
         """Merge the compiled train step's HLO collective mix into the comms
         logger ONCE per program (repeat calls would double-count; a retrace
         backs out the superseded program's rows and re-derives); returns the
-        current program's {(op, axis): {count, bytes}} mix."""
+        current program's {(op, axis): {count, bytes}} mix. ``compiled``
+        lets a caller that already re-lowered the step (introspection) share
+        the executable instead of paying a second lower+compile."""
         key = self._jit_step_programs()
         found = getattr(self, "_step_comms_found", None)
         if found is not None and getattr(self, "_step_comms_key", None) == key:
@@ -1769,13 +1895,13 @@ class DeepSpeedEngine:
                 "(offload/onebit/infinity paths run multiple programs per step)"
             )
         from ..comm import comm as dscomm
-        from ..comm.compressed import suspend_records
 
         # re-lowering re-traces the step; the compressed layer's trace-time
         # records were already taken on the first (real) trace — appending
         # them again here would double the compressed rows in the logger
-        with suspend_records():
-            compiled = self._train_step.lower(*self._step_arg_structs).compile()
+        # (suspend_records inside _lower_step_compiled)
+        if compiled is None:
+            compiled = self._lower_step_compiled()
         if found:
             # back out the superseded program's contribution before merging
             # the new one, keeping the shared logger's per-step semantics

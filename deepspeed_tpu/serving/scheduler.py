@@ -161,6 +161,23 @@ class ServingEngine:
         self._g_occ = m.gauge(
             "serving_kv_page_occupancy", "allocated / allocatable KV pages"
         )
+        self._g_quant = m.gauge(
+            "serving_latency_quantile_seconds",
+            "TTFT/TPOT/decode-step quantiles estimated from the histograms",
+            labelnames=("metric", "q"),
+        )
+        self._c_stragglers = m.counter(
+            "serving_stragglers_total",
+            "requests flagged resident in a slot far beyond their decode budget",
+        )
+        # anomaly watchdog (ISSUE 5): shared with the owning engine's
+        # telemetry when present — straggler trips land in the same trace
+        self.watchdog = (
+            engine.telemetry.watchdog if getattr(engine, "telemetry", None)
+            else None
+        )
+        self._ema_step_s = 0.0  # EWMA decode-step latency (straggler budget)
+        self._step_count = 0
 
         self._prefill_exec = None
         self._decode_exec = None
@@ -331,6 +348,12 @@ class ServingEngine:
             now = self.clock()
             self._h_step.observe(now - t0)
             self._c_steps.inc()
+            self._step_count += 1
+            dt = now - t0
+            self._ema_step_s = (
+                dt if self._ema_step_s == 0.0
+                else 0.8 * self._ema_step_s + 0.2 * dt
+            )
             for i in active:
                 slot = self.slots[i]
                 req = slot.request
@@ -347,11 +370,35 @@ class ServingEngine:
                 elif slot.keys is not None and slot.step < len(slot.keys):
                     self.table.keys[i] = slot.keys[slot.step]
 
+        # straggler detection (ISSUE 5 watchdog): a request resident in a
+        # slot far beyond its expected decode budget (straggler_factor x
+        # max_new_tokens x EMA step time) is flagged once — a wedged or
+        # pathologically slow request surfaces instead of silently holding
+        # a slot. Slots advance in lockstep, so residence time is the only
+        # per-request axis that can straggle.
+        if self.watchdog is not None and self._ema_step_s > 0.0:
+            factor = float(getattr(self.watchdog.config, "straggler_factor", 3.0))
+            now = self.clock()
+            for slot in self.slots:
+                req = slot.request
+                if req is None or req.t_first_token is None:
+                    continue
+                budget = factor * max(1, req.max_new_tokens) * self._ema_step_s
+                elapsed = now - req.t_first_token
+                if elapsed > budget and self.watchdog.observe_straggler(
+                    self._step_count, req.id,
+                    f"slot residence {elapsed:.3f}s > {budget:.3f}s "
+                    f"({len(req.tokens)}/{req.max_new_tokens} tokens)",
+                ):
+                    self._c_stragglers.inc()
+
         n_active = sum(1 for s in self.slots if s.request is not None)
         self._g_queue.set(len(self.queue))
         self._g_util.set(n_active / self.max_slots)
         self._g_pages.set(self.allocator.pages_in_use)
         self._g_occ.set(self.allocator.pages_in_use / self.allocator.capacity)
+        if self._step_count and self._step_count % 32 == 0:
+            self.stats()  # refresh the quantile gauges for textfile scrapes
         return n_active
 
     def _admit(self, slot_i: int, req: Request) -> None:
@@ -458,6 +505,34 @@ class ServingEngine:
         return self.completed[start:]
 
     # ------------------------------------------------------------------
+    def stats(self) -> dict:
+        """p50/p95/p99 + mean/count summaries of TTFT, TPOT and decode-step
+        latency, estimated from the existing histograms (the same
+        ``histogram_quantile`` interpolation Prometheus applies), plus
+        current load. Also refreshes the
+        ``serving_latency_quantile_seconds{metric,q}`` gauges so the
+        telemetry textfile export carries the summaries."""
+        out: dict = {}
+        for name, hist in (
+            ("ttft", self._h_ttft), ("tpot", self._h_tpot),
+            ("decode_step", self._h_step),
+        ):
+            total, n = hist.stats()
+            entry = {"count": n, "mean_s": (total / n) if n else None}
+            for q, label in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+                v = hist.quantile(q)
+                entry[f"{label}_s"] = v
+                if v is not None:
+                    self._g_quant.set(v, metric=name, q=label)
+            out[name] = entry
+        out["queue_depth"] = len(self.queue)
+        out["active_slots"] = sum(1 for s in self.slots if s.request is not None)
+        out["kv_pages_in_use"] = self.allocator.pages_in_use
+        out["completed"] = len(self.completed)
+        out["decode_steps"] = self._step_count
+        out["stragglers"] = int(self._c_stragglers.value())
+        return out
+
     def check_no_leaks(self) -> None:
         """Drain invariant: every page back on the free list, every slot
         empty, every block-table entry pointing at scratch."""

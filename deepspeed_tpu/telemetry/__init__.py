@@ -22,16 +22,20 @@ from __future__ import annotations
 import time
 from typing import Any, Dict, List, Optional
 
-from . import compile_stats
+from . import compile_stats, introspect
+from . import watchdog as watchdog_mod
 from .exporters import MonitorBridge, PrometheusTextfileExporter
 from .registry import Counter, Gauge, Histogram, MetricsRegistry
 from .tracer import Span, StepTracer, aggregate_scalars, spans_to_tree
+from .watchdog import AnomalyError, AnomalyWatchdog
 
 __all__ = [
+    "AnomalyError", "AnomalyWatchdog",
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "MonitorBridge", "PrometheusTextfileExporter",
     "Span", "StepTracer", "Telemetry",
-    "aggregate_scalars", "device_hbm_stats", "from_config", "spans_to_tree",
+    "aggregate_scalars", "device_hbm_stats", "from_config", "introspect",
+    "spans_to_tree",
 ]
 
 # histogram buckets for step latency (seconds): tighter than the generic
@@ -68,6 +72,7 @@ class Telemetry:
                 flush_interval=config.flush_interval,
                 sample_every=config.sample_every,
                 process_index=process_index,
+                max_bytes=int(getattr(config, "trace_max_mb", 0) or 0) * 2**20,
             )
             if config.trace_path
             else None
@@ -79,6 +84,15 @@ class Telemetry:
         )
         self.monitor_bridge: Optional[MonitorBridge] = None
         self._records_since_export = 0
+        # ISSUE 5: performance-introspection plane — the HLO cost/MFU
+        # analyzer config rides here (the engine drives the analysis; see
+        # introspect.py) and the anomaly watchdog is constructed iff enabled
+        self.introspection = getattr(config, "introspection", None)
+        self.watchdog: Optional[AnomalyWatchdog] = watchdog_mod.from_config(
+            getattr(config, "watchdog", None),
+            registry=self.registry,
+            tracer=self.tracer,
+        )
         compile_stats.install(self.registry)
 
     # -- wiring --------------------------------------------------------

@@ -145,6 +145,35 @@ class Histogram(_Metric):
             _, total, n = self._hist.get(self._key(labels), ([], 0.0, 0))
         return total, n
 
+    def quantile(self, q: float, **labels) -> Optional[float]:
+        """Estimate the q-quantile (0 < q < 1) from the bucket counts by
+        linear interpolation inside the landing bucket — the standard
+        Prometheus ``histogram_quantile`` estimator, so dashboards and
+        these in-process summaries agree. Returns None with no
+        observations; the +Inf bucket clamps to its lower edge (the
+        estimator cannot extrapolate past the last finite bound)."""
+        if not 0.0 < q < 1.0:
+            raise ValueError(f"{self.name}: quantile q must be in (0, 1), got {q}")
+        with self._lock:
+            counts, _, n = self._hist.get(
+                self._key(labels), ([0] * len(self.buckets), 0.0, 0)
+            )
+            counts = list(counts)  # buckets are cumulative (observe() adds
+        if n == 0:                 # to every bucket >= value)
+            return None
+        rank = q * n
+        prev_bound, prev_cum = 0.0, 0
+        for bound, cum in zip(self.buckets, counts):
+            if cum >= rank:
+                if bound == _INF:
+                    return prev_bound
+                if cum == prev_cum:
+                    return bound
+                frac = (rank - prev_cum) / (cum - prev_cum)
+                return prev_bound + (bound - prev_bound) * frac
+            prev_bound, prev_cum = (0.0 if bound == _INF else bound), cum
+        return prev_bound
+
     def value(self, **labels) -> float:
         raise TypeError(
             f"{self.name}: histograms have no single value — use stats() "

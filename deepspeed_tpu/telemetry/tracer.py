@@ -77,10 +77,17 @@ class StepTracer:
         flush_interval: int = 20,
         sample_every: int = 1,
         process_index: Optional[int] = None,
+        max_bytes: int = 0,
     ):
         self.trace_path = trace_path
         self.flush_interval = max(1, int(flush_interval))
         self.sample_every = max(1, int(sample_every))
+        # size-capped rotation (telemetry.trace_max_mb): at the cap the live
+        # file atomically rolls to <file>.1 and a fresh file starts — a
+        # long run's disk use stays bounded at ~2x the cap. 0 = unbounded.
+        self.max_bytes = max(0, int(max_bytes))
+        self._bytes_written: Optional[int] = None  # lazily from getsize
+        self.rotations = 0
         self._buffer: List[str] = []
         self._force_next = False
         self._closed = False
@@ -148,8 +155,24 @@ class StepTracer:
         if not self._buffer:
             return
         self._ensure_dir()
+        data = "\n".join(self._buffer) + "\n"
+        if self.max_bytes:
+            if self._bytes_written is None:  # resumed run: adopt on-disk size
+                try:
+                    self._bytes_written = os.path.getsize(self._file)
+                except OSError:
+                    self._bytes_written = 0
+            if self._bytes_written and self._bytes_written + len(data) > self.max_bytes:
+                # atomic roll: the live file becomes the (single) rolled
+                # generation; a concurrent reader sees either whole file,
+                # never a torn one
+                os.replace(self._file, self._file + ".1")
+                self._bytes_written = 0
+                self.rotations += 1
         with open(self._file, "a") as fh:
-            fh.write("\n".join(self._buffer) + "\n")
+            fh.write(data)
+        if self._bytes_written is not None:
+            self._bytes_written += len(data)
         self._buffer = []
 
     def close(self) -> None:

@@ -1,0 +1,6 @@
+"""Operator-facing CLI tools (run as ``python -m deepspeed_tpu.tools.<name>``).
+
+- ``trace_diff`` — align two step-trace JSONL runs and report per-span /
+  per-category deltas with a regression threshold and a non-zero exit code,
+  making bench regressions machine-checkable.
+"""

@@ -392,3 +392,72 @@ class TestServingConfig:
         )
         with pytest.raises(ValueError, match="gpt2 family"):
             eng.serve(SERVING_CFG)
+
+
+class TickingClock:
+    """Fake clock that advances a fixed delta on every read — decode steps
+    get a nonzero measured latency without real sleeping."""
+
+    def __init__(self, dt=0.05):
+        self.t = 0.0
+        self.dt = dt
+
+    def __call__(self):
+        t, self.t = self.t, self.t + self.dt
+        return t
+
+
+class TestServingStats:
+    def test_stats_quantiles_with_fake_clock(self, inference_engine):
+        """ISSUE 5 satellite: p50/p95/p99 TTFT/TPOT summaries from the
+        existing histograms, surfaced as registry gauges for the textfile
+        export."""
+        srv = inference_engine.serve(SERVING_CFG)
+        srv.clock = TickingClock(0.05)
+        rs = np.random.RandomState(7)
+        for i in range(6):
+            p = rs.randint(0, 512, (4 + i,)).astype(np.int32)
+            srv.submit(p, max_new_tokens=4, seed=i)
+        srv.run()
+        srv.check_no_leaks()
+        st = srv.stats()
+        for name in ("ttft", "tpot", "decode_step"):
+            entry = st[name]
+            assert entry["count"] > 0
+            assert entry["p50_s"] is not None
+            assert entry["p50_s"] <= entry["p95_s"] <= entry["p99_s"]
+        assert st["completed"] == 6 and st["active_slots"] == 0
+        # the quantile gauges back the telemetry textfile export
+        g = srv.metrics.get("serving_latency_quantile_seconds")
+        assert g is not None
+        assert g.value(metric="ttft", q="p50") == st["ttft"]["p50_s"]
+        prom = srv.metrics.to_prometheus()
+        assert "serving_latency_quantile_seconds" in prom
+
+    def test_straggler_detection_with_fake_clock(self, inference_engine):
+        """ISSUE 5 watchdog: a request resident in its slot far beyond the
+        straggler budget is flagged exactly once."""
+        from deepspeed_tpu.runtime.config import WatchdogConfig
+        from deepspeed_tpu.telemetry.watchdog import AnomalyWatchdog
+
+        srv = inference_engine.serve(SERVING_CFG)
+        clock = TickingClock(0.05)
+        srv.clock = clock
+        srv.watchdog = AnomalyWatchdog(
+            WatchdogConfig(enabled=True, straggler_factor=2.0)
+        )
+        p = np.arange(6, dtype=np.int32)
+        req = srv.submit(p, max_new_tokens=8)
+        srv.step()  # admit + first decode (EMA step time learned)
+        srv.step()
+        assert srv.metrics.counter("serving_stragglers_total").value() == 0
+        clock.t += 1000.0  # the request now looks wedged in its slot
+        srv.step()
+        assert srv.metrics.counter("serving_stragglers_total").value() == 1
+        anoms = [a for a in srv.watchdog.anomalies
+                 if a["anomaly_kind"] == "straggler"]
+        assert len(anoms) == 1 and f"request_{req.id}" == anoms[0]["signal"]
+        srv.step()  # flagged once, not every step
+        assert srv.metrics.counter("serving_stragglers_total").value() == 1
+        srv.run()
+        srv.check_no_leaks()
