@@ -461,6 +461,33 @@ def run_serving_bench():
     return pr3
 
 
+def run_dslint_bench():
+    """BENCH_pr6.json (ISSUE 6): the dslint static-analysis finding count as
+    a diffable run-over-run benchmark artifact — lint debt growing between
+    runs is a regression the same way a latency delta is."""
+    from deepspeed_tpu.tools import dslint as _dsl
+
+    pkg = os.path.join(_BENCH_DIR, "deepspeed_tpu")
+    baseline = _dsl._find_baseline([pkg])
+    report = _dsl.collect([pkg], baseline_path=baseline)
+    pr6 = {
+        "schema": "bench_pr6_dslint_v1",
+        "dslint_findings_total": report["findings_total"],
+        "dslint_new_findings": len(report["new"]),
+        "dslint_baselined": len(report["known"]),
+        "dslint_suppressed": report["suppressed"],
+        "per_rule": report["per_rule"],
+        "files_scanned": report["files_scanned"],
+        "baseline": report["baseline_path"],
+        "baseline_size": report["baseline_size"],
+        "stale_baseline_entries": len(report["stale_baseline_entries"]),
+    }
+    with open(os.path.join(_BENCH_DIR, "BENCH_pr6.json"), "w") as fh:
+        json.dump(pr6, fh, indent=1)
+        fh.write("\n")
+    return pr6
+
+
 def main():
     ok, platform, attempts = _await_backend()
     if not ok:
@@ -920,6 +947,16 @@ def main():
         result["roofline_bound"] = pr5["roofline_bound"]
     except Exception as e:
         result["pr5_error"] = f"{type(e).__name__}: {e}"
+    # --- BENCH_pr6.json (ISSUE 6): static-analysis plane — the dslint
+    # finding count rides every bench run so run-over-run comparison
+    # catches lint debt growing the way it catches latency regressions
+    try:
+        pr6 = run_dslint_bench()
+        result["pr6_artifact"] = "BENCH_pr6.json"
+        result["dslint_findings_total"] = pr6["dslint_findings_total"]
+        result["dslint_new_findings"] = pr6["dslint_new_findings"]
+    except Exception as e:
+        result["pr6_error"] = f"{type(e).__name__}: {e}"
     disarm_watchdog()  # measurements done: nothing left that can wedge
     print(json.dumps(result))
 

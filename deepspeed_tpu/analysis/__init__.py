@@ -1,0 +1,86 @@
+"""dslint — the graph & sharding static-analysis plane (ISSUE 6 tentpole).
+
+Two engines over one findings/severity/suppression model:
+
+- **Engine A** (``hlo_rules``): program verifiers over post-optimization HLO
+  text — replication, buffer donation, precision, collective overlap, and
+  executable-count budgets, checked on the already-compiled train/serving
+  programs (``DeepSpeedEngine.verify_program()``, ``ServingEngine.verify()``).
+- **Engine B** (``ast_rules``): a Python AST lint for JAX footguns — host
+  syncs and device-op dispatch in per-step code, tracer branching, missing
+  donation, unstable compile-cache keys.
+
+Front ends: the ``python -m deepspeed_tpu.tools.dslint`` CLI (with the
+committed-baseline CI gate), the ``lint``-marked tier-1 tests, and
+``bench.py``'s ``dslint_findings_total``. See ``docs/ANALYSIS.md`` for the
+rule catalog and the suppression / baseline workflow.
+"""
+
+from .ast_rules import (  # noqa: F401
+    DEFAULT_DONATE_PATTERNS,
+    DEFAULT_HOT_PATTERNS,
+    lint_file,
+    lint_source,
+)
+from .ast_rules import RULES as AST_RULES  # noqa: F401
+from .baseline import DEFAULT_BASELINE_NAME, Baseline  # noqa: F401
+from .findings import (  # noqa: F401
+    SEVERITY_ERROR,
+    SEVERITY_WARNING,
+    Finding,
+    SuppressionIndex,
+)
+from .hlo_rules import (  # noqa: F401
+    RuleContext,
+    check_program_budget,
+    hlo_dtype,
+    verify_compiled,
+    verify_hlo_text,
+)
+from .hlo_rules import RULES as HLO_RULES  # noqa: F401
+
+
+def all_rules():
+    """rule id → one-line description, both engines."""
+    out = dict(HLO_RULES)
+    out.update(AST_RULES)
+    return out
+
+
+def lint_paths(paths, hot_patterns=None, donate_patterns=None):
+    """Lint every ``*.py`` under ``paths`` (files or directories) with
+    Engine B → (findings, suppressed_count, files_scanned).
+
+    Unparseable files surface as SyntaxError, bogus path arguments as
+    ValueError — callers decide whether that is fatal (the CLI reports
+    both as usage-class errors; a typo'd path must NOT make the CI gate
+    pass vacuously by scanning nothing)."""
+    import os
+
+    files = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d not in ("__pycache__", ".git", ".pytest_cache")
+                )
+                files.extend(
+                    os.path.join(root, n) for n in sorted(names)
+                    if n.endswith(".py")
+                )
+        elif p.endswith(".py") and os.path.exists(p):
+            files.append(p)
+        else:
+            raise ValueError(
+                f"dslint path {p!r} is not a directory or an existing "
+                ".py file"
+            )
+    findings, suppressed = [], 0
+    for f in files:
+        got, waived = lint_file(
+            f, hot_patterns=hot_patterns, donate_patterns=donate_patterns
+        )
+        findings.extend(got)
+        suppressed += waived
+    return findings, suppressed, files

@@ -1,0 +1,101 @@
+"""Committed findings baseline: existing debt doesn't block, new debt does.
+
+The CI gate semantics (ISSUE 6): ``dslint`` compared against a committed
+``.dslint-baseline.json`` exits 0 when every finding is already known and 1
+the moment a NEW finding appears. ``--update-baseline`` re-records the
+current findings — entries whose finding no longer exists EXPIRE (they are
+dropped, so the debt ledger only shrinks by fixing, never silently grows).
+
+Fingerprints (``findings.Finding.fingerprint``) key on rule + file + symbol
++ a hash of the offending line, not on line numbers, so edits elsewhere in
+a file do not churn the baseline.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Tuple
+
+from .findings import Finding
+
+BASELINE_VERSION = 1
+DEFAULT_BASELINE_NAME = ".dslint-baseline.json"
+
+
+@dataclass
+class Baseline:
+    path: str = ""
+    entries: Dict[str, Dict] = field(default_factory=dict)  # fingerprint → meta
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        """Missing file → empty baseline (first run bootstraps); a corrupt
+        file raises ValueError with the path (the CLI maps it to exit 2)."""
+        if not path or not os.path.exists(path):
+            return cls(path=path)
+        try:
+            with open(path, encoding="utf-8") as fh:
+                doc = json.load(fh)
+            entries = {
+                e["fingerprint"]: e for e in doc.get("findings", [])
+                if isinstance(e, dict) and "fingerprint" in e
+            }
+        except (json.JSONDecodeError, KeyError, TypeError, AttributeError) as e:
+            raise ValueError(f"corrupt dslint baseline {path!r}: {e}") from e
+        return cls(path=path, entries=entries)
+
+    def split(
+        self, findings: Iterable[Finding]
+    ) -> Tuple[List[Finding], List[Finding], List[str]]:
+        """→ (new, known, stale_fingerprints)."""
+        new, known, seen = [], [], set()
+        for f in findings:
+            fp = f.fingerprint()
+            seen.add(fp)
+            (known if fp in self.entries else new).append(f)
+        stale = [fp for fp in self.entries if fp not in seen]
+        return new, known, stale
+
+    def update(
+        self, findings: Iterable[Finding], scanned_paths=None
+    ) -> None:
+        """Re-record the ledger from the current findings (add + expire).
+
+        ``scanned_paths`` scopes the expiry: entries for files NOT scanned
+        this run are kept verbatim, so ``--changed --update-baseline`` on a
+        subset cannot silently wipe the rest of the ledger. None = full
+        replace."""
+        if scanned_paths is None:
+            self.entries = {}
+        else:
+            self.entries = {
+                fp: e for fp, e in self.entries.items()
+                if e.get("path") not in scanned_paths
+            }
+        for f in findings:
+            fp = f.fingerprint()
+            self.entries[fp] = {
+                "fingerprint": fp,
+                "rule": f.rule,
+                "path": f.path,
+                "symbol": f.symbol,
+                "message": f.message,
+            }
+
+    def save(self) -> None:
+        doc = {
+            "version": BASELINE_VERSION,
+            "tool": "dslint",
+            "findings": sorted(
+                self.entries.values(),
+                key=lambda e: (e["path"], e["rule"], e["fingerprint"]),
+            ),
+        }
+        with open(self.path, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh, indent=1)
+            fh.write("\n")
+
+    def __len__(self) -> int:
+        return len(self.entries)

@@ -1,0 +1,334 @@
+"""Engine A: HLO program verifiers — rules over the compiled executable.
+
+The post-optimization HLO text (the same source of truth the PR-5
+introspection walk and the comms accounting read) states exactly what a
+step will do: which buffers alias, which collectives run synchronously,
+which dots run in which precision. These rules turn that text into findings
+with HLO line provenance, so the failure modes the runtime can only observe
+(HBM doubling, serialized collectives, recompilation storms) are caught at
+verify time instead:
+
+- ``no-unexpected-allgather``: param-sized all-gathers outside the declared
+  ZeRO plan (stage < 3 keeps params resident — a big all-gather means
+  accidental replication; compressed-bucket gathers are exempted by exact
+  wire size via ``allowed_collective_sizes``).
+- ``donation-honored``: the ``input_output_alias`` table must actually alias
+  the buffers the caller donated (``TrainState``, the serving KV pools) —
+  silent copy-instead-of-alias doubles resident HBM.
+- ``no-fp32-upcast``: dot/convolution operands wider than the configured
+  compute dtype (metadata matching ``upcast_allow`` — softmax/loss/norm —
+  is deliberate mixed precision, everything else is a silent 2x).
+- ``collective-overlap``: synchronous (non ``-start/-done``) collectives on
+  the critical path while the latency-hiding scheduler flags are set —
+  per T3, overlap is a property of the compiled schedule, so its absence
+  is visible right here.
+- ``static-shapes``: executable-count budgets (exactly 2 serving programs;
+  a bounded number of train variants) — more programs means retracing,
+  i.e. a recompilation storm in the making.
+
+All size/shape parsing reuses ``telemetry.introspect``'s instruction
+grammar so the two HLO readers cannot drift.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+from ..telemetry.introspect import (
+    DTYPE_BYTES,
+    operand_shapes,
+    parse_instruction,
+    shape_bytes,
+)
+from .findings import SEVERITY_ERROR, SEVERITY_WARNING, Finding
+
+RULES = {
+    "no-unexpected-allgather":
+        "param-sized all-gather outside the declared ZeRO stage's plan",
+    "donation-honored":
+        "donated input not aliased to an output (buffer copied, HBM doubled)",
+    "no-fp32-upcast":
+        "dot/conv operand wider than the configured compute dtype",
+    "collective-overlap":
+        "synchronous collective on the critical path with overlap flags set",
+    "static-shapes":
+        "executable count over budget (recompilation storm)",
+}
+
+_NP_TO_HLO = {
+    "float32": "f32", "float64": "f64", "float16": "f16", "bfloat16": "bf16",
+    "int8": "s8", "uint8": "u8", "int16": "s16", "uint16": "u16",
+    "int32": "s32", "uint32": "u32", "int64": "s64", "uint64": "u64",
+    "bool": "pred", "float8_e4m3fn": "f8e4m3fn", "float8_e5m2": "f8e5m2",
+}
+
+
+def hlo_dtype(np_dtype) -> str:
+    """numpy dtype (or name) → HLO element-type name."""
+    name = getattr(np_dtype, "name", None) or str(np_dtype)
+    return _NP_TO_HLO.get(name, name)
+
+
+@dataclass
+class RuleContext:
+    """What the caller *declared* about a program — the rules verify the
+    compiled text against this declaration."""
+
+    program: str = "program"
+    # -- no-unexpected-allgather --------------------------------------
+    zero_stage: int = 0
+    allgather_min_bytes: int = 1 << 20
+    # exact wire sizes that ARE part of the plan (compressed buckets etc.)
+    allowed_collective_sizes: FrozenSet[int] = frozenset()
+    # -- donation-honored ---------------------------------------------
+    # exact-shape mode: each (hlo_dtype, "d0,d1,...") must be aliased
+    expect_aliased_shapes: Sequence[Tuple[str, str]] = ()
+    # fraction mode: of entry params >= min_donatable_param_bytes, at least
+    # this byte-fraction must be aliased (0 disables the fraction check)
+    min_alias_fraction: float = 0.0
+    min_donatable_param_bytes: int = 1 << 14
+    # -- no-fp32-upcast ------------------------------------------------
+    expected_dtype: Optional[str] = None  # "bf16" | "f16" | None = no check
+    upcast_allow: str = "softmax|loss|norm|logit|cumsum"
+    # -- collective-overlap --------------------------------------------
+    overlap_expected: bool = False
+    sync_collective_min_bytes: int = 1 << 16
+
+    @property
+    def allow_param_allgather(self) -> bool:
+        return self.zero_stage >= 3
+
+
+def _pseudo_path(ctx: RuleContext) -> str:
+    return f"hlo://{ctx.program}"
+
+
+def _finding(ctx, rule, severity, message, line_no=0, snippet=""):
+    return Finding(
+        rule=rule, severity=severity, message=message,
+        path=_pseudo_path(ctx), line=line_no, symbol=ctx.program,
+        snippet=snippet[:160], engine="hlo",
+    )
+
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+def rule_no_unexpected_allgather(txt: str, ctx: RuleContext) -> List[Finding]:
+    if ctx.allow_param_allgather:
+        return []
+    out = []
+    for i, line in enumerate(txt.splitlines(), start=1):
+        op, nbytes, _ = parse_instruction(line)
+        if op is None or not op.startswith("all-gather") or op.endswith("-done"):
+            continue
+        if nbytes < ctx.allgather_min_bytes or nbytes in ctx.allowed_collective_sizes:
+            continue
+        out.append(_finding(
+            ctx, "no-unexpected-allgather", SEVERITY_ERROR,
+            f"{nbytes / 1e6:.1f} MB all-gather in a stage-{ctx.zero_stage} "
+            "program — params should stay resident below stage 3; this is "
+            "accidental full replication",
+            line_no=i, snippet=line.strip(),
+        ))
+    return out
+
+
+_ALIAS_ENTRY = re.compile(r"\{[0-9,\s]*\}:\s*\((\d+)\s*,")
+_PARAM = re.compile(
+    r"%?[\w.\-]+\s*=\s*(?P<dtype>\w+)\[(?P<dims>[0-9,]*)\][^\s]*\s*parameter\((?P<num>\d+)\)"
+)
+
+
+def _aliased_params(txt: str) -> FrozenSet[int]:
+    """Parameter numbers the module header aliases to an output.
+
+    The table nests braces (``{ {0}: (1, {}, may-alias) }``), so the body
+    is cut by brace matching, not regex."""
+    start = txt.find("input_output_alias={")
+    if start < 0:
+        return frozenset()
+    i = txt.find("{", start)
+    depth, end = 0, len(txt)
+    for j in range(i, min(len(txt), i + 8192)):
+        if txt[j] == "{":
+            depth += 1
+        elif txt[j] == "}":
+            depth -= 1
+            if depth == 0:
+                end = j
+                break
+    body = txt[i + 1: end]
+    return frozenset(int(p) for p in _ALIAS_ENTRY.findall(body))
+
+
+def _entry_params(txt: str) -> Dict[int, Tuple[str, str, int]]:
+    """param number → (dtype, dims, line_no), from the ENTRY computation.
+
+    Parameter instructions repeat in nested computations with reused
+    numbers; entry params are the ones that matter for aliasing, so keep
+    the LAST occurrence of each number (ENTRY prints last in post-opt
+    text). Collisions on shape are harmless: donation checks only need
+    sizes/shapes, which nested re-declarations share."""
+    params: Dict[int, Tuple[str, str, int]] = {}
+    entry_at = txt.find("ENTRY")
+    scan_txt = txt[entry_at:] if entry_at >= 0 else txt
+    offset = txt[:entry_at].count("\n") if entry_at >= 0 else 0
+    for i, line in enumerate(scan_txt.splitlines(), start=offset + 1):
+        m = _PARAM.search(line)
+        if m:
+            params[int(m.group("num"))] = (m.group("dtype"), m.group("dims"), i)
+    return params
+
+
+def rule_donation_honored(txt: str, ctx: RuleContext) -> List[Finding]:
+    if not ctx.expect_aliased_shapes and ctx.min_alias_fraction <= 0:
+        return []
+    aliased = _aliased_params(txt)
+    params = _entry_params(txt)
+    out = []
+
+    # duplicate expected shapes (the two serving pools share one shape)
+    # demand that many DISTINCT aliased parameters of that shape
+    want: Dict[Tuple[str, str], int] = {}
+    for shape in ctx.expect_aliased_shapes:
+        want[tuple(shape)] = want.get(tuple(shape), 0) + 1
+    for (want_dtype, want_dims), n_want in want.items():
+        matches = [
+            (num, line_no) for num, (dt, dd, line_no) in params.items()
+            if dt == want_dtype and dd == want_dims
+        ]
+        if len(matches) < n_want:
+            out.append(_finding(
+                ctx, "donation-honored", SEVERITY_ERROR,
+                f"{len(matches)} entry parameter(s) of shape "
+                f"{want_dtype}[{want_dims}] (need {n_want}) — a donated "
+                "buffer is not an input of this program",
+            ))
+            continue
+        n_aliased = sum(1 for num, _ in matches if num in aliased)
+        if n_aliased < n_want:
+            num, line_no = next(
+                (num, ln) for num, ln in matches if num not in aliased
+            )
+            out.append(_finding(
+                ctx, "donation-honored", SEVERITY_ERROR,
+                f"parameter {num} ({want_dtype}[{want_dims}]) is not in the "
+                "input_output_alias table — the donated buffer is copied, "
+                "doubling its HBM footprint "
+                f"({n_aliased}/{n_want} of this shape aliased)",
+                line_no=line_no,
+            ))
+
+    if ctx.min_alias_fraction > 0:
+        big = {
+            num: shape_bytes(dt, dd)
+            for num, (dt, dd, _) in params.items()
+            if shape_bytes(dt, dd) >= ctx.min_donatable_param_bytes
+        }
+        total = sum(big.values())
+        got = sum(b for num, b in big.items() if num in aliased)
+        if total > 0 and got / total < ctx.min_alias_fraction:
+            out.append(_finding(
+                ctx, "donation-honored", SEVERITY_ERROR,
+                f"only {got / 1e6:.2f} of {total / 1e6:.2f} MB of large "
+                f"inputs are aliased ({got / total:.0%} < "
+                f"{ctx.min_alias_fraction:.0%}) — donated state is being "
+                "copied instead of reused",
+            ))
+    return out
+
+
+def rule_no_fp32_upcast(txt: str, ctx: RuleContext) -> List[Finding]:
+    if ctx.expected_dtype not in ("bf16", "f16"):
+        return []
+    allow = re.compile(ctx.upcast_allow, re.I) if ctx.upcast_allow else None
+    expected_bytes = DTYPE_BYTES[ctx.expected_dtype]
+    out = []
+    for i, line in enumerate(txt.splitlines(), start=1):
+        op, _, _ = parse_instruction(line)
+        if op not in ("dot", "convolution"):
+            continue
+        if allow is not None and allow.search(line):
+            continue
+        wide = [
+            f"{dt}[{dd}]" for dt, dd in operand_shapes(line)
+            if DTYPE_BYTES.get(dt, 0) > expected_bytes
+        ]
+        if wide:
+            out.append(_finding(
+                ctx, "no-fp32-upcast", SEVERITY_WARNING,
+                f"{op} consumes {', '.join(wide[:2])} in a "
+                f"{ctx.expected_dtype} program — silently paying "
+                "full-precision flops and bytes",
+                line_no=i, snippet=line.strip(),
+            ))
+    return out
+
+
+_SYNC_COLLECTIVES = (
+    "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+    "collective-permute",
+)
+
+
+def rule_collective_overlap(txt: str, ctx: RuleContext) -> List[Finding]:
+    if not ctx.overlap_expected:
+        return []
+    out = []
+    for i, line in enumerate(txt.splitlines(), start=1):
+        op, nbytes, _ = parse_instruction(line)
+        if op is None or op not in _SYNC_COLLECTIVES:
+            continue  # -start/-done async forms are the overlapped good case
+        if nbytes < ctx.sync_collective_min_bytes:
+            continue
+        out.append(_finding(
+            ctx, "collective-overlap", SEVERITY_WARNING,
+            f"synchronous {op} of {nbytes / 1e6:.2f} MB while the "
+            "latency-hiding scheduler is enabled — this op walls the step "
+            "instead of overlapping with compute (T3)",
+            line_no=i, snippet=line.strip(),
+        ))
+    return out
+
+
+def check_program_budget(
+    n_programs: int, budget: int, ctx: RuleContext, exact: bool = False
+) -> List[Finding]:
+    """``static-shapes``: executable-count budget. ``exact`` demands ==
+    (the serving contract: exactly two programs, ever)."""
+    bad = (n_programs != budget) if exact else (n_programs > budget)
+    if not bad:
+        return []
+    rel = "!=" if exact else ">"
+    return [_finding(
+        ctx, "static-shapes", SEVERITY_ERROR,
+        f"{n_programs} compiled programs {rel} budget {budget} — input "
+        "shapes are leaking into executables (recompilation storm)",
+    )]
+
+
+ALL_PROGRAM_RULES = (
+    rule_no_unexpected_allgather,
+    rule_donation_honored,
+    rule_no_fp32_upcast,
+    rule_collective_overlap,
+)
+
+
+def verify_hlo_text(txt: str, ctx: RuleContext) -> List[Finding]:
+    """Run every per-program Engine-A rule over one HLO module text."""
+    out: List[Finding] = []
+    for rule in ALL_PROGRAM_RULES:
+        out.extend(rule(txt, ctx))
+    return out
+
+
+def verify_compiled(compiled, ctx: RuleContext) -> List[Finding]:
+    """``verify_hlo_text`` over anything with ``as_text()``."""
+    txt = compiled.as_text() if hasattr(compiled, "as_text") else str(compiled)
+    return verify_hlo_text(txt, ctx)

@@ -98,6 +98,31 @@ def main() -> int:
         "A.jsonl B.jsonl"
     )
     print("-" * 60)
+    print("Static analysis (dslint):")
+    try:
+        from deepspeed_tpu.analysis import AST_RULES, HLO_RULES, Baseline
+        from deepspeed_tpu.tools.dslint import _find_baseline
+
+        print(
+            f"engines ............. {GREEN_OK} AST ({len(AST_RULES)} rules) "
+            f"+ HLO ({len(HLO_RULES)} rules)"
+        )
+        bl_path = _find_baseline(["deepspeed_tpu"])
+        if bl_path:
+            print(
+                f"baseline ............ {bl_path}: "
+                f"{len(Baseline.load(bl_path))} accepted finding(s)"
+            )
+        else:
+            print("baseline ............ none (every finding fails)")
+        print(
+            "run lint ............ python -m deepspeed_tpu.tools.dslint "
+            "deepspeed_tpu/ (program rules: engine.verify_program / "
+            "ServingEngine.verify)"
+        )
+    except Exception as e:
+        print(f"analysis ............ {RED_NO} ({type(e).__name__}: {e})")
+    print("-" * 60)
     return 0
 
 

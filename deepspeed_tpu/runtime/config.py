@@ -464,6 +464,49 @@ class TelemetryConfig(DSConfigModel):
 
 
 @dataclass
+class AnalysisConfig(DSConfigModel):
+    """analysis section (ISSUE 6 tentpole): dslint, the graph & sharding
+    static-analysis plane (``deepspeed_tpu/analysis/``). Engine A verifies
+    compiled HLO programs — ``DeepSpeedEngine.verify_program()`` and
+    ``ServingEngine.verify()`` check buffer donation, unexpected
+    param-sized all-gathers, fp32 upcasts, synchronous collectives under
+    overlap flags, and executable-count budgets. Engine B lints the Python
+    source for JAX footguns (host syncs / device-op dispatch in hot
+    per-step code, tracer branching, missing donation, unstable compile
+    caches) via ``python -m deepspeed_tpu.tools.dslint``, gated in CI by a
+    committed baseline. ``hot_function_patterns`` (fnmatch on function
+    qualnames) declares which host code is per-step hot;
+    ``donate_name_patterns`` which jitted functions must donate.
+    ``min_alias_fraction`` is the byte-fraction of large donated inputs
+    that must actually alias an output before ``donation-honored`` trips.
+    ``max_train_programs`` bounds the jit cache (``static-shapes``);
+    serving is always budgeted at exactly 2 executables."""
+
+    enabled: bool = True
+    baseline: str = ".dslint-baseline.json"
+    allgather_min_bytes: int = 1 << 20
+    sync_collective_min_bytes: int = 1 << 16
+    min_alias_fraction: float = 0.5
+    min_donatable_param_bytes: int = 1 << 14
+    max_train_programs: int = 4
+    upcast_allow: str = "softmax|loss|norm|logit|cumsum"
+    hot_function_patterns: List[str] = field(default_factory=list)  # [] = built-in defaults
+    donate_name_patterns: List[str] = field(default_factory=list)   # [] = built-in defaults
+
+    def __post_init__(self):
+        if not 0.0 <= self.min_alias_fraction <= 1.0:
+            raise DeepSpeedConfigError(
+                "analysis.min_alias_fraction must be in [0, 1], got "
+                f"{self.min_alias_fraction}"
+            )
+        if self.max_train_programs < 1:
+            raise DeepSpeedConfigError(
+                "analysis.max_train_programs must be >= 1, got "
+                f"{self.max_train_programs}"
+            )
+
+
+@dataclass
 class ServingConfig(DSConfigModel):
     """serving section (TPU-native; no reference analog — the reference serves
     one static batch per ``InferenceEngine.forward`` call). Drives the
@@ -562,6 +605,7 @@ class DeepSpeedConfig(DSConfigModel):
     debug: DebugConfig = field(default_factory=DebugConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     serving: ServingConfig = field(default_factory=ServingConfig)
+    analysis: AnalysisConfig = field(default_factory=AnalysisConfig)
 
     gradient_clipping: float = 0.0
     prescale_gradients: bool = False

@@ -1616,6 +1616,8 @@ class DeepSpeedEngine:
         if self._train_step_folds_rng:
             step_rng = self._rng
         else:
+            # dslint: disable=jnp-in-hot-loop — the host-driven paths
+            # (offload/onebit/infinity) consume a fresh key per call
             self._rng, step_rng = jax.random.split(self._rng)
         if self._step_arg_structs is None or (
             sampled
@@ -1638,10 +1640,13 @@ class DeepSpeedEngine:
         self.global_steps += 1
         t_dispatched = time.perf_counter() if sampled else 0.0
         nan_flag = metrics.pop("nan_in_grads", None) if isinstance(metrics, dict) else None
+        # dslint: disable=host-sync-in-step — debug.nan_check opts into a
+        # per-step flag read; the sync IS the feature
         if nan_flag is not None and bool(jax.device_get(nan_flag)):
             raise RuntimeError(
                 f"deepspeed_tpu debug: NaN/Inf detected in gradients at step "
                 f"{self.global_steps} (loss="
+                # dslint: disable=host-sync-in-step — raise path, already fatal
                 f"{float(jax.device_get(metrics['loss'])):.4f}). With bf16/fp32 "
                 "there is no loss-scale skip — this is a model/data bug. "
                 "Inspect the batch fed to this step; disable via "
@@ -1660,6 +1665,8 @@ class DeepSpeedEngine:
             self._telemetry_step(tel, metrics, t_start, t_prepared, t_dispatched)
 
         if self.global_steps % self.steps_per_print == 0:
+            # dslint: disable=host-sync-in-step — the print/monitor cadence
+            # reads scalars once per steps_per_print, amortized by config
             host = {k: float(v) for k, v in jax.device_get(metrics).items()}
             host.pop("overflow", None)
             log_dist(
@@ -1705,6 +1712,8 @@ class DeepSpeedEngine:
         The ``device_get`` blocks on the step's outputs to read the scalars —
         that sync is the cost of sampling; ``telemetry.sample_every``
         amortizes it over unsampled steps, which add zero host callbacks."""
+        # dslint: disable=host-sync-in-step — the documented sampling sync
+        # (see docstring); telemetry.sample_every amortizes it
         host = jax.device_get(metrics) if isinstance(metrics, dict) else {}
         t_synced = time.perf_counter()
         scalars = {}
@@ -1775,6 +1784,8 @@ class DeepSpeedEngine:
         flags_arr = (
             metrics.pop("anomaly_flags", None) if isinstance(metrics, dict) else None
         )
+        # dslint: disable=host-sync-in-step — cheap host copy: tput_timer
+        # .stop already blocked on this step's outputs (see docstring)
         flags = int(jax.device_get(flags_arr)) if flags_arr is not None else None
         if self.global_steps % wd.check_every != 0:
             # off-cadence steps skip the EMA/spike judgement only — the
@@ -1787,6 +1798,7 @@ class DeepSpeedEngine:
         for k in ("loss", "grad_norm"):
             if isinstance(metrics, dict) and k in metrics:
                 try:
+                    # dslint: disable=host-sync-in-step — same synced outputs
                     scalars[k] = float(jax.device_get(metrics[k]))
                 except (TypeError, ValueError):
                     pass
@@ -1800,6 +1812,84 @@ class DeepSpeedEngine:
 
         with suspend_records():
             return self._train_step.lower(*self._step_arg_structs).compile()
+
+    def _compiled_step(self):
+        """The analysis copy of the current step program, compiled at most
+        ONCE per distinct program (jit cache size is the invalidation key).
+        Introspection (ISSUE 5), comms accounting, and the dslint program
+        verifier (ISSUE 6) all read this one executable."""
+        key = self._jit_step_programs()
+        cached = getattr(self, "_compiled_step_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        compiled = self._lower_step_compiled()
+        self._compiled_step_cache = (key, compiled)
+        return compiled
+
+    def verify_program(self) -> list:
+        """Engine A (dslint) static verification of the compiled train step.
+
+        Checks the post-optimization HLO against what this engine's config
+        *declared*: state donation actually aliased
+        (``donation-honored``), no param-sized all-gathers below ZeRO
+        stage 3 outside the compression plan's wire sizes
+        (``no-unexpected-allgather``), no silent fp32 dots in a bf16/fp16
+        program (``no-fp32-upcast``), no synchronous collectives when the
+        latency-hiding scheduler flags are set (``collective-overlap``),
+        and a bounded executable count (``static-shapes``). Returns the
+        findings list — empty means the program is clean. Reuses the
+        introspection path's one-compile cache; requires at least one
+        ``train_batch()`` call and the standard jitted step."""
+        acfg = self.config.analysis
+        if not acfg.enabled:
+            return []
+        if self._step_arg_structs is None or not hasattr(self._train_step, "lower"):
+            raise ValueError(
+                "verify_program requires the standard jitted train step and "
+                "at least one train_batch() call (offload/onebit/infinity "
+                "paths run multiple programs per step)"
+            )
+        from .. import analysis as dsa
+
+        txt = self._compiled_step().as_text()
+        # collective sizes that ARE the declared plan: the compressed /
+        # bucketed reduce path all-gathers requantized buckets by design
+        allowed = set()
+        plan_info = getattr(self, "_compression_plan", None)
+        if plan_info is not None:
+            from ..comm.compressed import wire_bytes as _wire
+
+            plan, world, method, block = plan_info
+            for n in plan.padded:
+                chunk = n // world
+                allowed.update((
+                    _wire(n, method, block), _wire(chunk, method, block),
+                    4 * n, 4 * chunk,
+                ))
+        expected_dtype = None
+        if self.compute_dtype == jnp.bfloat16:
+            expected_dtype = "bf16"
+        elif self.compute_dtype == jnp.float16:
+            expected_dtype = "f16"
+        donate = self.config.tpu.donate_state
+        ctx = dsa.RuleContext(
+            program="train_step",
+            zero_stage=self.zero_stage,
+            allgather_min_bytes=acfg.allgather_min_bytes,
+            allowed_collective_sizes=frozenset(allowed),
+            min_alias_fraction=acfg.min_alias_fraction if donate else 0.0,
+            min_donatable_param_bytes=acfg.min_donatable_param_bytes,
+            expected_dtype=expected_dtype,
+            upcast_allow=acfg.upcast_allow,
+            overlap_expected="latency_hiding_scheduler=true"
+            in os.environ.get("XLA_FLAGS", ""),
+            sync_collective_min_bytes=acfg.sync_collective_min_bytes,
+        )
+        findings = dsa.verify_hlo_text(txt, ctx)
+        findings.extend(dsa.check_program_budget(
+            max(1, self._jit_step_programs()), acfg.max_train_programs, ctx
+        ))
+        return findings
 
     def _introspection_analysis(self):
         """Per-category HLO cost analysis of the current step program
@@ -1819,7 +1909,7 @@ class DeepSpeedEngine:
         ana = None
         if hasattr(self._train_step, "lower") and self._step_arg_structs is not None:
             try:
-                compiled = self._lower_step_compiled()
+                compiled = self._compiled_step()
                 from ..telemetry import introspect as _intro
 
                 ana = _intro.analyze_compiled(
@@ -1901,7 +1991,7 @@ class DeepSpeedEngine:
         # them again here would double the compressed rows in the logger
         # (suspend_records inside _lower_step_compiled)
         if compiled is None:
-            compiled = self._lower_step_compiled()
+            compiled = self._compiled_step()
         if found:
             # back out the superseded program's contribution before merging
             # the new one, keeping the shared logger's per-step semantics
@@ -2053,8 +2143,11 @@ class DeepSpeedEngine:
     def eval_batch(self, batch: PyTree, rng=None) -> jnp.ndarray:
         device_batch = self.shard_batch(batch)
         if rng is None:
+            # dslint: disable=jnp-in-hot-loop — stateful host rng: each eval
+            # call must consume a fresh key
             self._rng, rng = jax.random.split(self._rng)
         if self.param_offload_enabled:
+            # dslint: disable=jnp-in-hot-loop — API returns a device scalar
             return jnp.float32(self._infinity.eval_loss(device_batch, rng))
         return self._eval_step(self.state.params, device_batch, rng)
 

@@ -53,6 +53,27 @@ LATENCY_BUCKETS = (
 )
 
 
+def _host_prng_key(seed: int) -> np.ndarray:
+    """``jax.random.PRNGKey(seed)``'s raw [2]-uint32 data, built host-side.
+
+    The admission path needs the key only as numpy input to the compiled
+    prefill program; materializing it through ``jax.random.PRNGKey`` +
+    ``np.asarray`` dispatched a device op and a device→host sync per
+    admission (dslint ``host-sync-in-step``). For the default threefry2x32
+    impl and an int32-range non-negative seed — every realistic request
+    seed — the key is just ``[0, seed]``, identically under x64 on or off:
+    bit-parity with ``generate`` at zero device round-trips. Anything else
+    (negative / >= 2**31 seeds are canonicalized by jax in x64-dependent
+    ways, other PRNG impls lay keys out differently) takes the exact jax
+    path rather than guessing."""
+    if (
+        jax.config.jax_default_prng_impl == "threefry2x32"
+        and 0 <= seed < 2**31
+    ):
+        return np.array([0, seed], np.uint32)
+    return np.asarray(jax.random.PRNGKey(seed))
+
+
 @dataclass
 class _Slot:
     request: Optional[Request] = None
@@ -338,13 +359,18 @@ class ServingEngine:
         active = [i for i, s in enumerate(self.slots) if s.request is not None]
         if active:
             t0 = self.clock()
+            # the AOT executable takes the numpy slot tables directly — a
+            # jnp.asarray wrapper here would dispatch four extra device ops
+            # per decode step (dslint jnp-in-hot-loop)
             kp, vp, nxt = self._decode_exec(
                 self.engine.params, self.k_pool, self.v_pool,
-                jnp.asarray(self.table.tokens), jnp.asarray(self.table.seq_lens),
-                jnp.asarray(self.table.block_tables), jnp.asarray(self.table.keys),
+                self.table.tokens, self.table.seq_lens,
+                self.table.block_tables, self.table.keys,
             )
             self.k_pool, self.v_pool = kp, vp
-            nxt_np = np.asarray(jax.device_get(nxt))
+            # the ONE deliberate sync of the slot loop: the scheduler must
+            # read the sampled tokens to retire/advance slots
+            nxt_np = jax.device_get(nxt)  # dslint: disable=host-sync-in-step
             now = self.clock()
             self._h_step.observe(now - t0)
             self._c_steps.inc()
@@ -416,15 +442,18 @@ class ServingEngine:
         ids = np.zeros((1, self.prefill_width), np.int32)
         ids[0, : req.prompt_len] = req.prompt
         page_ids = self.table.block_tables[slot_i, : self.prefill_pages]
-        key0 = np.asarray(jax.random.PRNGKey(req.seed))
+        # host-built key + plain numpy operands: the compiled prefill does
+        # its own device_put, so admission dispatches exactly one program
+        key0 = _host_prng_key(req.seed)
         kp, vp, first = self._prefill_exec(
             self.engine.params, self.k_pool, self.v_pool,
-            jnp.asarray(ids), jnp.asarray(req.prompt_len, jnp.int32),
-            jnp.asarray(page_ids), jnp.asarray(key0),
+            ids, np.asarray(req.prompt_len, np.int32), page_ids, key0,
         )
         self.k_pool, self.v_pool = kp, vp
         self._c_prefills.inc()
-        tok0 = int(np.asarray(jax.device_get(first))[0])
+        # deliberate sync: TTFT is defined by the first token reaching the
+        # host, and an at-admission EOS must retire the slot before decode
+        tok0 = int(jax.device_get(first)[0])  # dslint: disable=host-sync-in-step
         now = self.clock()
         req.status = RequestStatus.RUNNING
         req.t_first_token = now
@@ -435,13 +464,16 @@ class ServingEngine:
         self.table.tokens[slot_i] = tok0
         if self._sampling and req.max_new_tokens > 1:
             # the EXACT key sequence of gpt2.generate for this request:
-            # step t consumes split(fold_in(PRNGKey(seed), 1), N-1)[t-1]
-            slot.keys = np.asarray(
-                jax.random.split(
-                    jax.random.fold_in(jax.random.PRNGKey(req.seed), 1),
-                    req.max_new_tokens - 1,
-                )
+            # step t consumes split(fold_in(PRNGKey(seed), 1), N-1)[t-1].
+            # fold_in/split ARE the jax PRNG — reimplementing threefry on
+            # the host would fork the bit-parity contract, so the sampling
+            # path keeps one device round-trip per admission (waived below)
+            key1 = jax.random.fold_in(  # dslint: disable=jnp-in-hot-loop
+                jax.random.PRNGKey(req.seed), 1
             )
+            # dslint: disable=jnp-in-hot-loop
+            keys = jax.random.split(key1, req.max_new_tokens - 1)
+            slot.keys = np.asarray(keys)  # dslint: disable=host-sync-in-step
             self.table.keys[slot_i] = slot.keys[0]
         if req.max_new_tokens == 1 or (
             req.eos_token_id is not None and tok0 == req.eos_token_id
@@ -505,6 +537,47 @@ class ServingEngine:
         return self.completed[start:]
 
     # ------------------------------------------------------------------
+    def verify(self, analysis_config=None) -> list:
+        """Engine A (dslint) verification of the serving program set.
+
+        The serving contract, checked against the compiled artifacts
+        themselves: EXACTLY two executables (``static-shapes``), both KV
+        pools donated AND actually aliased input→output in each program
+        (``donation-honored`` — a copied pool silently doubles the
+        dominant HBM consumer), and no fp32 upcasts when the cache dtype
+        says bf16/fp16 (``no-fp32-upcast``). Returns findings; empty =
+        clean. Compiles the two programs if the engine has not run yet."""
+        from ..runtime.config import AnalysisConfig
+        from .. import analysis as dsa
+
+        acfg = analysis_config or AnalysisConfig()
+        if isinstance(acfg, dict):
+            acfg = AnalysisConfig.from_dict(acfg)
+        if not acfg.enabled:
+            return []
+        self._ensure_compiled()
+        pool_dt = dsa.hlo_dtype(np.dtype(self.cache_dtype))
+        pool_dims = ",".join(str(d) for d in self.k_pool.shape)
+        expected_dtype = pool_dt if pool_dt in ("bf16", "f16") else None
+        ctx = dsa.RuleContext(program="serving")
+        findings = dsa.check_program_budget(
+            len(self.executables), 2, ctx, exact=True
+        )
+        for name, exe in (
+            ("serving_prefill", self._prefill_exec),
+            ("serving_decode", self._decode_exec),
+        ):
+            pctx = dsa.RuleContext(
+                program=name,
+                # both pools share one shape: demand two aliased params
+                expect_aliased_shapes=[(pool_dt, pool_dims)] * 2,
+                expected_dtype=expected_dtype,
+                upcast_allow=acfg.upcast_allow,
+                allgather_min_bytes=acfg.allgather_min_bytes,
+            )
+            findings.extend(dsa.verify_compiled(exe, pctx))
+        return findings
+
     def stats(self) -> dict:
         """p50/p95/p99 + mean/count summaries of TTFT, TPOT and decode-step
         latency, estimated from the existing histograms (the same

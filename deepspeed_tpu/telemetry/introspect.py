@@ -304,6 +304,38 @@ def _loop_computations(comps: Dict[str, List[str]]) -> set:
     return in_loop
 
 
+# -- public instruction grammar (ISSUE 6) -----------------------------------
+# the dslint program verifiers (analysis/hlo_rules.py) read the same HLO
+# text; exporting the grammar keeps the two HLO readers from drifting
+
+DTYPE_BYTES = _DTYPE_BYTES
+shape_bytes = _shape_bytes
+operand_shapes = _operand_shapes
+
+
+def parse_instruction(line: str):
+    """One HLO instruction line → ``(op, result_bytes, tuple_shapes)``.
+
+    ``tuple_shapes`` is the parsed ``[(dtype, dims), ...]`` list for
+    tuple-typed results (async collective starts) and None for plain
+    results; ``result_bytes`` is the result size (largest tuple element
+    for tuples, 0 for unknown dtypes). Returns ``(None, 0, None)`` for
+    non-instruction lines."""
+    m = _INSTR.search(line)
+    if m:
+        dtype, dims = m.group("dtype"), m.group("dims")
+        nbytes = _shape_bytes(dtype, dims) if dtype in _DTYPE_BYTES else 0
+        return m.group("op"), nbytes, None
+    tm = _INSTR_TUPLE.search(line)
+    if tm:
+        shapes = _SHAPE.findall(tm.group("shapes"))
+        sizes = [
+            _shape_bytes(dt, dd) for dt, dd in shapes if dt in _DTYPE_BYTES
+        ]
+        return tm.group("op"), (max(sizes) if sizes else 0), shapes
+    return None, 0, None
+
+
 def analyze_hlo_text(txt: str, loop_iterations: int = 1) -> HloAnalysis:
     """Walk post-optimization HLO text into a per-category cost breakdown.
 

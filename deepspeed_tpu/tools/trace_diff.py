@@ -28,24 +28,74 @@ import sys
 from typing import Any, Dict, List, Optional, Tuple
 
 
+class TraceFormatError(Exception):
+    """A trace file that cannot be diffed: wrong schema or truncated beyond
+    use. Carries a human-readable message — the CLI exits 2 with it instead
+    of a raw traceback (rotation can hand this tool a partial ``.1`` file)."""
+
+
+def _check_schema(rec: Any, path: str, lineno: int) -> Dict[str, Any]:
+    """A step record must be a flat object with the expected field types —
+    anything else is another tool's JSONL, not a StepTracer trace."""
+    if not isinstance(rec, dict):
+        raise TraceFormatError(
+            f"{path}:{lineno}: JSON line is {type(rec).__name__}, not an "
+            "object — this is not a StepTracer trace"
+        )
+    for key, want in (("spans", dict), ("comm_bytes", dict),
+                      ("introspection", dict)):
+        if key in rec and rec[key] is not None and not isinstance(rec[key], want):
+            raise TraceFormatError(
+                f"{path}:{lineno}: field {key!r} is "
+                f"{type(rec[key]).__name__}, expected {want.__name__} — "
+                "schema mismatch (trace written by an incompatible version?)"
+            )
+    dur = rec.get("dur_ms")
+    if dur is not None and not isinstance(dur, (int, float)):
+        raise TraceFormatError(
+            f"{path}:{lineno}: field 'dur_ms' is {type(dur).__name__}, "
+            "expected a number — schema mismatch"
+        )
+    return rec
+
+
 def load_step_records(path: str, kind: Optional[str] = None) -> List[Dict[str, Any]]:
-    """The ``*_step`` records of one JSONL trace, in file order."""
-    out = []
-    with open(path) as fh:
-        for line in fh:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError:
-                continue  # a torn tail line (killed run) must not sink the diff
-            k = str(rec.get("kind", ""))
-            if not k.endswith("_step"):
-                continue
-            if kind is not None and k != f"{kind}_step":
-                continue
-            out.append(rec)
+    """The ``*_step`` records of one JSONL trace, in file order.
+
+    One torn TAIL line (a killed or mid-rotation run) is tolerated; torn
+    lines elsewhere, undecodable bytes, or records of the wrong shape raise
+    :class:`TraceFormatError` with the offending location."""
+    out: List[Dict[str, Any]] = []
+    torn: List[int] = []
+    try:
+        with open(path, encoding="utf-8") as fh:
+            lines = fh.readlines()
+    except UnicodeDecodeError as e:
+        raise TraceFormatError(
+            f"{path}: not a text JSONL trace ({e.reason} at byte {e.start})"
+        ) from e
+    last = len(lines)
+    for lineno, line in enumerate(lines, start=1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            torn.append(lineno)
+            continue
+        k = str(_check_schema(rec, path, lineno).get("kind", ""))
+        if not k.endswith("_step"):
+            continue
+        if kind is not None and k != f"{kind}_step":
+            continue
+        out.append(rec)
+    if torn and torn != [last]:
+        raise TraceFormatError(
+            f"{path}: {len(torn)} undecodable line(s) (first at line "
+            f"{torn[0]} of {last}) — the file is truncated or corrupt, not "
+            "just missing its tail; re-capture the trace"
+        )
     return out
 
 
@@ -178,7 +228,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     try:
         a = load_step_records(args.trace_a, kind=args.kind)
         b = load_step_records(args.trace_b, kind=args.kind)
-    except OSError as e:
+    except (OSError, TraceFormatError) as e:
         print(f"trace_diff: {e}", file=sys.stderr)
         return 2
     if not a or not b:
@@ -188,7 +238,18 @@ def main(argv: Optional[List[str]] = None) -> int:
             file=sys.stderr,
         )
         return 2
-    report = diff(a, b, threshold_pct=args.threshold_pct, min_ms=args.min_ms)
+    try:
+        report = diff(a, b, threshold_pct=args.threshold_pct, min_ms=args.min_ms)
+    except (TypeError, ValueError, KeyError, AttributeError) as e:
+        # records that passed the shape check but still defeat the metric
+        # extraction: a clear one-liner, never a traceback, always exit 2
+        print(
+            f"trace_diff: traces are not comparable "
+            f"({type(e).__name__}: {e}) — schema mismatch between "
+            f"{args.trace_a} and {args.trace_b}?",
+            file=sys.stderr,
+        )
+        return 2
     print(json.dumps(report, indent=1) if args.json else _format_table(report))
     return 1 if report["regressions"] else 0
 

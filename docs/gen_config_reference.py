@@ -25,11 +25,14 @@ def _is_subconfig(t) -> bool:
 
 def _fmt_default(f: dataclasses.Field):
     if f.default is not dataclasses.MISSING:
-        return repr(f.default)
-    if f.default_factory is not dataclasses.MISSING:  # type: ignore[misc]
+        out = repr(f.default)
+    elif f.default_factory is not dataclasses.MISSING:  # type: ignore[misc]
         v = f.default_factory()  # type: ignore[misc]
-        return "{}" if dataclasses.is_dataclass(v) else repr(v)
-    return ""
+        out = "{}" if dataclasses.is_dataclass(v) else repr(v)
+    else:
+        return ""
+    # a literal | in a default (regex alternations) would split the table row
+    return out.replace("|", "\\|")
 
 
 def _fmt_type(f: dataclasses.Field) -> str:
