@@ -461,6 +461,116 @@ def run_serving_bench():
     return pr3
 
 
+def run_resilience_bench():
+    """BENCH_pr7.json (ISSUE 7): save-overhead-per-step of the async
+    integrity-checked checkpoint path, and recovery time through the
+    corrupt-tag walk-back — the two numbers the fault-tolerance plane is
+    accountable for. Scale-aware like the serving bench: gpt2-tiny on CPU,
+    the real preset on TPU."""
+    import shutil
+    import tempfile
+    import time as _time
+
+    import jax
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    model_name = os.environ.get(
+        "BENCH_RESILIENCE_MODEL", "gpt2" if on_tpu else "gpt2-tiny"
+    )
+    seq = 128 if not on_tpu else int(os.environ.get("BENCH_SEQ", "1024"))
+    # window = one save interval: ONE async save overlaps `steps` train
+    # steps, so the reported per-step overhead is the amortized cost at a
+    # save-every-`steps` cadence (production saves far less often)
+    steps = int(os.environ.get("BENCH_RESILIENCE_STEPS", "48"))
+
+    from deepspeed_tpu.models import gpt2
+    from deepspeed_tpu.parallel.topology import MeshSpec
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+    cfg = gpt2.get_config(model_name, n_positions=seq)
+    module = gpt2.make_module(cfg)
+    n_dev = len(jax.devices())
+    mesh = MeshSpec(dp=n_dev).build_mesh()
+    ds = DeepSpeedConfig.load(
+        {
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+            "zero_optimization": {"stage": 0},
+            "steps_per_print": 10**9,
+            "resilience": {"enabled": True, "async_checkpoint": True},
+        },
+        dp_world_size=n_dev,
+    )
+    engine = DeepSpeedEngine(module, ds, mesh=mesh, seed=0)
+    rs = np.random.RandomState(0)
+    batch = {
+        "input_ids": rs.randint(
+            0, cfg.vocab_size, size=(engine.train_batch_size, seq)
+        ).astype(np.int32)
+    }
+    m = engine.train_batch(batch)  # compile + warm
+    jax.block_until_ready(m["loss"])
+    batch = engine.shard_batch(batch)
+
+    def timed_steps(save_dir=None):
+        t0 = _time.perf_counter()
+        for i in range(steps):
+            m = engine.train_batch(batch)
+            if save_dir is not None and i == 0:
+                # ONE async save overlapping the window: the per-step cost
+                # is the HBM→host snapshot + any write-thread contention
+                engine.save_checkpoint(save_dir)
+            jax.block_until_ready(m["loss"])
+        dt = _time.perf_counter() - t0
+        if save_dir is not None:
+            assert engine.flush_checkpoints(timeout=120)
+        return dt / steps
+
+    ckpt_dir = tempfile.mkdtemp(prefix="bench_pr7_")
+    try:
+        base_s = timed_steps()
+        with_save_s = timed_steps(os.path.join(ckpt_dir, "overlap"))
+        overhead_pct = (with_save_s - base_s) / base_s * 100.0
+
+        # recovery: two good tags, newest corrupted → load walks back
+        rdir = os.path.join(ckpt_dir, "recover")
+        engine.save_checkpoint(rdir, tag="t1", blocking=True)
+        engine.train_batch(batch)
+        engine.save_checkpoint(rdir, tag="t2", blocking=True)
+        bin0 = os.path.join(rdir, "t2", "00000.bin")
+        with open(bin0, "r+b") as fh:
+            fh.seek(0)
+            fh.write(b"\xde\xad\xbe\xef")
+        t0 = _time.perf_counter()
+        engine.load_checkpoint(rdir)
+        recovery_ms = (_time.perf_counter() - t0) * 1e3
+        walked_back = engine.get_global_step() is not None
+        from deepspeed_tpu.resilience import find_latest_valid
+
+        tag_used, skipped = find_latest_valid(rdir)
+        pr7 = {
+            "schema": "bench_pr7_resilience_v1",
+            "model": model_name,
+            "backend": jax.default_backend(),
+            "steps_per_window": steps,
+            "step_ms_baseline": round(base_s * 1e3, 3),
+            "step_ms_with_async_save": round(with_save_s * 1e3, 3),
+            "async_save_overhead_pct": round(overhead_pct, 2),
+            "recovery_walkback_ms": round(recovery_ms, 3),
+            "recovery_tag_used": tag_used,
+            "recovery_tags_skipped": [s["tag"] for s in skipped],
+            "walkback_ok": bool(walked_back and tag_used == "t1"),
+        }
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+    with open(os.path.join(_BENCH_DIR, "BENCH_pr7.json"), "w") as fh:
+        json.dump(pr7, fh, indent=1)
+        fh.write("\n")
+    return pr7
+
+
 def run_dslint_bench():
     """BENCH_pr6.json (ISSUE 6): the dslint static-analysis finding count as
     a diffable run-over-run benchmark artifact — lint debt growing between
@@ -957,14 +1067,28 @@ def main():
         result["dslint_new_findings"] = pr6["dslint_new_findings"]
     except Exception as e:
         result["pr6_error"] = f"{type(e).__name__}: {e}"
+    # --- BENCH_pr7.json (ISSUE 7): fault-tolerance plane — async-save
+    # overhead per step + corrupt-tag recovery time. BENCH_RESILIENCE=0
+    # opts out (it compiles a second tiny engine on CPU runs).
+    if os.environ.get("BENCH_RESILIENCE", "1") == "1":
+        try:
+            pr7 = run_resilience_bench()
+            result["pr7_artifact"] = "BENCH_pr7.json"
+            result["async_save_overhead_pct"] = pr7["async_save_overhead_pct"]
+            result["recovery_walkback_ms"] = pr7["recovery_walkback_ms"]
+        except Exception as e:
+            result["pr7_error"] = f"{type(e).__name__}: {e}"
     disarm_watchdog()  # measurements done: nothing left that can wedge
     print(json.dumps(result))
 
 
 if __name__ == "__main__":
     # BENCH_SERVING_ONLY=1: just the serving sweep (CPU-friendly; no backend
-    # probe/training) — prints the BENCH_pr3.json content as the one JSON line
+    # probe/training) — prints the BENCH_pr3.json content as the one JSON line.
+    # BENCH_RESILIENCE_ONLY=1: just the fault-tolerance bench (BENCH_pr7.json).
     if os.environ.get("BENCH_SERVING_ONLY", "0") == "1":
         print(json.dumps(run_serving_bench()))
+    elif os.environ.get("BENCH_RESILIENCE_ONLY", "0") == "1":
+        print(json.dumps(run_resilience_bench()))
     else:
         main()

@@ -86,11 +86,16 @@ def save_train_state(
     os.makedirs(base, exist_ok=True)
     engine.save(os.path.join(base, "state"), state)
     if jax.process_index() == 0:
+        from ..resilience.manifest import atomic_write_text
+
         with open(os.path.join(base, "client_state.json"), "w") as fh:
             json.dump(client_state or {}, fh)
         if save_latest:
-            with open(os.path.join(os.path.abspath(save_dir), LATEST_FILE), "w") as fh:
-                fh.write(str(tag))
+            # atomic swap (temp + fsync + rename): a crash mid-update must
+            # leave the previous 'latest', never a torn/empty one (ISSUE 7)
+            atomic_write_text(
+                os.path.join(os.path.abspath(save_dir), LATEST_FILE), str(tag)
+            )
     return base
 
 

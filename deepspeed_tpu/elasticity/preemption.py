@@ -10,19 +10,33 @@ never interrupted mid-dispatch.
 
 Usage::
 
-    guard = PreemptionGuard(engine, save_dir)           # installs handlers
-    for batch in loader:
-        engine.train_batch(batch)
-        if guard.should_stop():                          # signal seen?
-            guard.checkpoint_and_log()                   # save + latest tag
-            break
+    with PreemptionGuard(engine, save_dir) as guard:   # installs handlers
+        for batch in loader:
+            engine.train_batch(batch)
+            if guard.should_stop():                    # signal seen?
+                guard.checkpoint_and_log()             # save + grace flush
+                break
+    # handlers restored on exit — no leak across tests / callers
 
-or as the engine-integrated form, ``initialize(...)`` callers can poll
+or the engine-integrated form: ``initialize(...)`` callers poll
 ``engine.preempted`` when a guard is attached.
+
+Resilience semantics (ISSUE 7):
+
+- ``checkpoint_and_log`` flushes any in-flight *async* checkpoint write
+  inside ``grace_window_s`` (``resilience.grace_window_s`` when the engine
+  carries a resilience config); an overrun forces a fresh BLOCKING snapshot
+  under ``<tag>-final`` so the process never exits with only a torn write
+  on disk.
+- a SECOND termination signal while the final save is running escalates to
+  immediate exit (flushed log line, exit code 128+signum) instead of
+  re-entering the save — the platform is done waiting; re-entering would
+  corrupt the write it interrupts.
 """
 
 from __future__ import annotations
 
+import os
 import signal
 import threading
 from typing import Optional
@@ -40,20 +54,44 @@ class PreemptionGuard:
 
     Handlers chain to any previously installed handler (the launcher's
     tree-kill propagation still works). Thread-safe: the flag is a simple
-    event set from the signal context.
+    event set from the signal context. Usable as a context manager —
+    ``__exit__`` uninstalls, so handler chains don't leak across tests.
     """
 
-    def __init__(self, engine=None, save_dir: Optional[str] = None, signals=_DEFAULT_SIGNALS, install: bool = True):
+    def __init__(
+        self,
+        engine=None,
+        save_dir: Optional[str] = None,
+        signals=_DEFAULT_SIGNALS,
+        install: bool = True,
+        grace_window_s: Optional[float] = None,
+    ):
         self.engine = engine
         self.save_dir = save_dir
         self._stop = threading.Event()
         self._prev = {}
         self._signals = []
+        self._in_final_save = False
+        # injectable for tests: escalation must really exit in production
+        # (os._exit — a raise from a signal frame could be swallowed), but a
+        # test asserting the escalation can't survive that
+        self._exit = os._exit
+        if grace_window_s is None:
+            rcfg = getattr(getattr(engine, "config", None), "resilience", None)
+            grace_window_s = float(getattr(rcfg, "grace_window_s", 30.0))
+        self.grace_window_s = float(grace_window_s)
         if install:
             self.install(signals)
         if engine is not None:
             # engine.preempted polls this guard (DeepSpeedEngine property)
             engine._preemption_guard = self
+
+    def __enter__(self) -> "PreemptionGuard":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.uninstall()
+        return False
 
     def install(self, signals=_DEFAULT_SIGNALS) -> None:
         for name in signals:
@@ -81,9 +119,22 @@ class PreemptionGuard:
             self.engine._preemption_guard = None
 
     def _handler(self, signum, frame):
+        name = signal.Signals(signum).name
+        if self._stop.is_set() and self._in_final_save:
+            # double-signal during the final save: the platform's grace
+            # window is over. Re-entering the save would corrupt the write
+            # it interrupts — flush one log line and go. The committed (or
+            # walked-back) previous tag is the recovery point.
+            log_dist(
+                f"second {name} during preemption checkpoint — exiting "
+                "immediately (previous committed tag is the recovery point)"
+            )
+            self._flush_logs()
+            self._exit(128 + signum)
+            return  # only reached when _exit is stubbed (tests)
         self._stop.set()
         log_dist(
-            f"preemption signal {signal.Signals(signum).name} received — "
+            f"preemption signal {name} received — "
             "will checkpoint at the next step boundary"
         )
         prev = self._prev.get(signum)
@@ -91,6 +142,22 @@ class PreemptionGuard:
         # KeyboardInterrupt — that would defeat the graceful checkpoint)
         if callable(prev) and prev is not signal.default_int_handler:
             prev(signum, frame)
+
+    @staticmethod
+    def _flush_logs() -> None:
+        import logging
+        import sys
+
+        for h in logging.getLogger().handlers + logging.getLogger("deepspeed_tpu").handlers:
+            try:
+                h.flush()
+            except Exception:
+                pass
+        try:
+            sys.stderr.flush()
+            sys.stdout.flush()
+        except Exception:
+            pass
 
     def request_stop(self) -> None:
         """Programmatic trigger (tests; cooperative shutdown)."""
@@ -100,9 +167,31 @@ class PreemptionGuard:
         return self._stop.is_set()
 
     def checkpoint_and_log(self, tag: Optional[str] = None) -> Optional[str]:
-        """Save via the attached engine (no-op without one). Returns path."""
+        """Save via the attached engine (no-op without one), then flush any
+        in-flight async write inside the grace window; an overrun forces a
+        fresh BLOCKING save under ``<tag>-final``. Returns the path."""
         if self.engine is None or self.save_dir is None:
             return None
-        path = self.engine.save_checkpoint(self.save_dir, tag=tag)
-        log_dist(f"preemption checkpoint saved: {path}")
-        return path
+        self._in_final_save = True
+        try:
+            path = self.engine.save_checkpoint(self.save_dir, tag=tag)
+            flush = getattr(self.engine, "flush_checkpoints", None)
+            flushed = flush(timeout=self.grace_window_s) if callable(flush) else True
+            # `flushed` only proves the queue drained — a write that DIED
+            # also drains. The committed tag directory exists iff the
+            # atomic rename happened (a torn write leaves only <tag>.tmp),
+            # so probe the path before trusting the async save.
+            if not flushed or not os.path.isdir(str(path)):
+                log_dist(
+                    "async checkpoint did not commit "
+                    + ("within the grace window" if not flushed else "(write failed)")
+                    + " — forcing a fresh blocking snapshot"
+                )
+                final_tag = f"{tag}-final" if tag else "preempt-final"
+                path = self.engine.save_checkpoint(
+                    self.save_dir, tag=final_tag, blocking=True
+                )
+            log_dist(f"preemption checkpoint saved: {path}")
+            return path
+        finally:
+            self._in_final_save = False

@@ -1,0 +1,54 @@
+"""Fault-tolerance plane (ISSUE 7): a production run survives its failures.
+
+Three coupled parts, all opt-in via the ``resilience`` config section:
+
+- :mod:`~deepspeed_tpu.resilience.writer` /
+  :mod:`~deepspeed_tpu.resilience.manifest` — async checkpointing with an
+  atomic, checksummed commit protocol (snapshot to host off the step path,
+  background write, ``<tag>.tmp`` → fsync → rename → atomic ``latest``).
+- :mod:`~deepspeed_tpu.resilience.recovery` — manifest-validated restore
+  that walks back across corrupt/torn tags, plus the in-memory
+  :class:`~deepspeed_tpu.resilience.recovery.RollbackManager` behind the
+  watchdog's ``rollback`` policy.
+- :mod:`~deepspeed_tpu.resilience.faults` — seeded deterministic fault
+  injection (NaN loss, crash-mid-checkpoint, SIGTERM, serving-slot stalls)
+  so every recovery path above is exercised by tests.
+
+Serving-side resilience (graceful drain, retry-with-backoff) lives on
+:class:`~deepspeed_tpu.serving.scheduler.ServingEngine` directly; the
+preemption grace-window flush on
+:class:`~deepspeed_tpu.elasticity.preemption.PreemptionGuard`.
+See docs/RESILIENCE.md.
+"""
+
+from .faults import FaultInjected, FaultInjector
+from .manifest import (
+    CheckpointIntegrityError,
+    atomic_write_text,
+    find_latest_valid,
+    validate_tag,
+    write_tag,
+)
+from .recovery import (
+    RollbackLimitError,
+    RollbackManager,
+    is_resilient_dir,
+    load_resilient_state,
+)
+from .writer import AsyncCheckpointWriter, snapshot_to_host
+
+__all__ = [
+    "AsyncCheckpointWriter",
+    "CheckpointIntegrityError",
+    "FaultInjected",
+    "FaultInjector",
+    "RollbackLimitError",
+    "RollbackManager",
+    "atomic_write_text",
+    "find_latest_valid",
+    "is_resilient_dir",
+    "load_resilient_state",
+    "snapshot_to_host",
+    "validate_tag",
+    "write_tag",
+]

@@ -1,0 +1,302 @@
+"""Integrity-checked checkpoint format: per-array checksums + atomic commit.
+
+The reference's Nebula engine (nebula_checkpoint_engine.py) gets integrity
+from a managed service; here the commit protocol is explicit and local so a
+torn write, a corrupt block, or a half-renamed directory is *detectable at
+load time* instead of surfacing as a silently wrong resume:
+
+Layout on disk::
+
+    <save_dir>/<tag>/manifest.json     format, step, fingerprint, client
+                                       state, per-array {file, dtype, shape,
+                                       bytes, crc32}
+    <save_dir>/<tag>/00000.bin ...     raw array bytes, one file per leaf
+    <save_dir>/latest                  text file naming the newest GOOD tag
+
+Commit protocol (write_tag):
+
+1. write every array file into ``<tag>.tmp`` and fsync each;
+2. write ``manifest.json`` (checksums computed from the bytes actually
+   written) and fsync it;
+3. fsync the tmp directory, then ``rename(<tag>.tmp, <tag>)`` — the tag
+   becomes visible atomically, fully checksummed or not at all;
+4. atomically swap ``latest`` (temp file + fsync + rename).
+
+A crash at any point leaves either the previous state intact (steps 1-3) or
+a fully-committed tag without the ``latest`` swap (after 3) — both are
+recovered by :func:`find_latest_valid`'s walk-back. Raw ``.bin`` + manifest
+dtype strings (not ``.npy``) so bf16 and other ml_dtypes round-trip without
+depending on numpy descriptor support.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+FORMAT = "dstpu-resilient-ckpt-v1"
+MANIFEST = "manifest.json"
+LATEST_FILE = "latest"
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """A tag failed manifest validation (torn write / corruption)."""
+
+
+def checksum(data: bytes) -> int:
+    """crc32 (unsigned) — fast enough to run per-array on every save."""
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+def _fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return  # platforms without O_RDONLY dir opens: rename is still atomic
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    """temp file + fsync + rename: readers see the old or the new content,
+    never a torn write (the ``latest`` swap primitive). The temp name is
+    unique per process+thread so a background async writer and a forced
+    blocking save racing on the same ``latest`` never clobber each other's
+    temp file — last rename wins, both renames succeed."""
+    import threading
+
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    with open(tmp, "w") as fh:
+        fh.write(text)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(os.path.abspath(path)))
+
+
+def write_tag(
+    save_dir: str,
+    tag: str,
+    arrays: Dict[str, np.ndarray],
+    client_state: Optional[Dict[str, Any]] = None,
+    fingerprint: str = "",
+    step: int = 0,
+    save_latest: bool = True,
+    crash_before_manifest: bool = False,
+) -> str:
+    """Write one checkpoint tag under the atomic commit protocol; returns the
+    committed tag directory.
+
+    ``crash_before_manifest`` is the deterministic fault-injection hook
+    (resilience.fault_injection ``crash_saves``): raise after the array
+    files are on disk but before the manifest/rename, leaving exactly the
+    torn ``<tag>.tmp`` a mid-write process death would.
+    """
+    base = os.path.abspath(save_dir)
+    os.makedirs(base, exist_ok=True)
+    final = os.path.join(base, str(tag))
+    tmp = final + ".tmp"
+    if os.path.isdir(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+
+    entries: Dict[str, Dict[str, Any]] = {}
+    for i, (name, arr) in enumerate(arrays.items()):
+        # np.asarray, NOT ascontiguousarray: the latter promotes 0-d scalars
+        # to [1], corrupting every scalar leaf's recorded shape; tobytes()
+        # already emits C-order regardless of the source layout
+        arr = np.asarray(arr)
+        data = arr.tobytes()
+        fname = f"{i:05d}.bin"
+        fpath = os.path.join(tmp, fname)
+        with open(fpath, "wb") as fh:
+            fh.write(data)
+            fh.flush()
+            os.fsync(fh.fileno())
+        entries[name] = {
+            "file": fname,
+            "dtype": arr.dtype.name,
+            "shape": list(arr.shape),
+            "bytes": len(data),
+            "crc32": checksum(data),
+        }
+
+    if crash_before_manifest:
+        from .faults import FaultInjected
+
+        raise FaultInjected(
+            f"injected crash mid-checkpoint-write of tag {tag!r} "
+            f"(arrays on disk, no manifest — torn {os.path.basename(tmp)})"
+        )
+
+    manifest = {
+        "format": FORMAT,
+        "tag": str(tag),
+        "step": int(step),
+        "fingerprint": fingerprint,
+        "client_state": client_state or {},
+        "arrays": entries,
+    }
+    mpath = os.path.join(tmp, MANIFEST)
+    with open(mpath, "w") as fh:
+        json.dump(manifest, fh, indent=1)
+        fh.flush()
+        os.fsync(fh.fileno())
+    _fsync_dir(tmp)
+
+    # the one visibility point: a fully-written, checksummed directory
+    # appears under the final name in a single rename. Overwriting an
+    # existing tag (re-save of the same step) moves the stale dir aside
+    # first — rename onto a non-empty dir is not atomic-or-anything.
+    if os.path.isdir(final):
+        stale = final + ".stale"
+        if os.path.isdir(stale):
+            shutil.rmtree(stale)
+        os.rename(final, stale)
+        os.rename(tmp, final)
+        shutil.rmtree(stale, ignore_errors=True)
+    else:
+        os.rename(tmp, final)
+    _fsync_dir(base)
+
+    if save_latest:
+        atomic_write_text(os.path.join(base, LATEST_FILE), str(tag))
+    return final
+
+
+def read_manifest(tag_dir: str) -> Dict[str, Any]:
+    with open(os.path.join(tag_dir, MANIFEST)) as fh:
+        return json.load(fh)
+
+
+def validate_tag(tag_dir: str) -> Tuple[bool, str]:
+    """Full integrity check of one committed tag: manifest present and
+    parseable, every array file present, size and crc32 matching. Returns
+    ``(ok, reason)`` — reason names the first failure."""
+    mpath = os.path.join(tag_dir, MANIFEST)
+    if not os.path.isfile(mpath):
+        return False, "no manifest.json (torn write)"
+    try:
+        manifest = read_manifest(tag_dir)
+    except (OSError, ValueError) as e:
+        return False, f"unreadable manifest: {e}"
+    if manifest.get("format") != FORMAT:
+        return False, f"unknown format {manifest.get('format')!r}"
+    for name, ent in manifest.get("arrays", {}).items():
+        fpath = os.path.join(tag_dir, ent["file"])
+        try:
+            with open(fpath, "rb") as fh:
+                data = fh.read()
+        except OSError:
+            return False, f"missing array file {ent['file']} ({name})"
+        if len(data) != int(ent["bytes"]):
+            return False, (
+                f"array {name}: {len(data)} bytes on disk, manifest says "
+                f"{ent['bytes']} (truncated write)"
+            )
+        if checksum(data) != int(ent["crc32"]):
+            return False, f"array {name}: crc32 mismatch (corruption)"
+    return True, "ok"
+
+
+def load_arrays(tag_dir: str, manifest: Optional[Dict] = None) -> Dict[str, np.ndarray]:
+    """Read every array of a VALIDATED tag back as host numpy. dtype comes
+    from the manifest string via ``jnp.dtype`` so bf16/fp8 (ml_dtypes)
+    round-trip exactly."""
+    import jax.numpy as jnp
+
+    manifest = manifest or read_manifest(tag_dir)
+    out: Dict[str, np.ndarray] = {}
+    for name, ent in manifest["arrays"].items():
+        with open(os.path.join(tag_dir, ent["file"]), "rb") as fh:
+            data = fh.read()
+        arr = np.frombuffer(data, dtype=jnp.dtype(ent["dtype"]))
+        out[name] = arr.reshape(tuple(ent["shape"]))
+    return out
+
+
+def read_latest_tag(load_dir: str) -> Optional[str]:
+    p = os.path.join(load_dir, LATEST_FILE)
+    if os.path.isfile(p):
+        with open(p) as fh:
+            return fh.read().strip() or None
+    return None
+
+
+def list_tags(load_dir: str) -> List[str]:
+    """Manifest-bearing tag directories, newest first (manifest step desc,
+    mtime as the tiebreak)."""
+    base = os.path.abspath(load_dir)
+    cands = []
+    try:
+        entries = os.listdir(base)
+    except OSError:
+        return []
+    for name in entries:
+        d = os.path.join(base, name)
+        if not os.path.isdir(d) or name.endswith((".tmp", ".stale")):
+            continue
+        if not os.path.isfile(os.path.join(d, MANIFEST)):
+            continue
+        try:
+            step = int(read_manifest(d).get("step", -1))
+        except (OSError, ValueError):
+            step = -1
+        cands.append((step, os.path.getmtime(d), name))
+    cands.sort(reverse=True)
+    return [name for _, _, name in cands]
+
+
+def find_latest_valid(
+    load_dir: str, tag: Optional[str] = None
+) -> Tuple[str, List[Dict[str, str]]]:
+    """The newest tag that passes full validation, walking back across
+    corrupt/torn tags. Returns ``(tag, skipped)`` where ``skipped`` records
+    every invalid tag passed over (for the recovery event log). An
+    explicitly requested ``tag`` is validated strictly — asking for a
+    specific tag and getting a different one would be a silent lie."""
+    base = os.path.abspath(load_dir)
+    if tag is not None:
+        ok, why = validate_tag(os.path.join(base, str(tag)))
+        if not ok:
+            raise CheckpointIntegrityError(
+                f"checkpoint tag {tag!r} in {load_dir} failed validation: {why}"
+            )
+        return str(tag), []
+    skipped: List[Dict[str, str]] = []
+    seen = set()
+    candidates: List[str] = []
+    latest = read_latest_tag(base)
+    if latest is not None:
+        candidates.append(latest)
+        seen.add(latest)
+    for t in list_tags(base):
+        if t not in seen:
+            candidates.append(t)
+            seen.add(t)
+    for t in candidates:
+        ok, why = validate_tag(os.path.join(base, t))
+        if ok:
+            return t, skipped
+        skipped.append({"tag": t, "reason": why})
+    raise CheckpointIntegrityError(
+        f"no valid checkpoint tag in {load_dir} "
+        f"(tried {[s['tag'] for s in skipped] or 'none'})"
+    )

@@ -1,0 +1,192 @@
+"""Recovery: manifest-validated restore with walk-back + in-memory rollback.
+
+Two recovery axes, matching the two failure classes:
+
+- **Across restarts** (:func:`load_resilient_state`): find the newest tag
+  whose manifest validates (skipping corrupt/torn tags — see
+  ``manifest.find_latest_valid``), restore every leaf onto the engine's
+  current shardings, and hand back the client state (step counters, RNG,
+  telemetry counters) so the resumed run is bit-identical to the saved one.
+
+- **Within a run** (:class:`RollbackManager`): a bounded host-side snapshot
+  of the last known-good TrainState. When the watchdog trips under the
+  ``rollback`` policy, the engine restores the snapshot and skips the
+  poisoned batch instead of dying — the NaN-spike remediation that keeps a
+  production run alive through one bad batch.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..utils.logging import log_dist, logger
+from ..utils.pytree import path_str as _path_str
+from . import manifest as mf
+
+PyTree = Any
+
+
+class RollbackLimitError(RuntimeError):
+    """Too many rollbacks: the pathology is persistent, not a bad batch."""
+
+
+def is_resilient_dir(load_dir: str, tag: Optional[str] = None) -> bool:
+    """Does ``load_dir`` hold manifest-format checkpoints (vs orbax)?"""
+    if tag is not None:
+        return os.path.isfile(os.path.join(load_dir, str(tag), mf.MANIFEST))
+    return bool(mf.list_tags(load_dir))
+
+
+def load_resilient_state(
+    load_dir: str,
+    tag: Optional[str],
+    like_state: PyTree,
+    shardings: PyTree,
+    load_optimizer_states: bool = True,
+    registry=None,
+) -> Tuple[PyTree, Dict[str, Any], str, Dict[str, np.ndarray]]:
+    """Restore the newest VALID tag onto ``shardings``.
+
+    Returns ``(state, client_state, tag_used, extras)`` where ``extras``
+    holds non-state arrays the save added (``__rng__``, …). Leaf matching is
+    by pytree path name; ``comm_error`` leaves are allowed to differ between
+    save and resume (compression toggled) — missing ones keep the engine's
+    current zeros, extra ones are dropped with a warning. Any other
+    missing leaf raises: a partial state restore is corruption, not
+    flexibility."""
+    tag_used, skipped = mf.find_latest_valid(load_dir, tag)
+    if skipped:
+        names = [s["tag"] for s in skipped]
+        logger.warning(
+            f"checkpoint walk-back: skipped invalid tag(s) {names} in "
+            f"{load_dir}; recovering from {tag_used!r} "
+            f"({'; '.join(s['reason'] for s in skipped)})"
+        )
+        if registry is not None:
+            registry.counter(
+                "recovery_events_total", "recovery actions by kind",
+                labelnames=("kind",),
+            ).inc(len(skipped), kind="walk_back")
+    tag_dir = os.path.join(os.path.abspath(load_dir), tag_used)
+    manifest = mf.read_manifest(tag_dir)
+    arrays = mf.load_arrays(tag_dir, manifest)
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(like_state)
+    shard_leaves = jax.tree.leaves(shardings)
+    assert len(shard_leaves) == len(flat), (
+        f"shardings tree ({len(shard_leaves)} leaves) does not match state "
+        f"({len(flat)} leaves)"
+    )
+    new_leaves = []
+    used = set()
+    for (path, cur), sh in zip(flat, shard_leaves):
+        name = _path_str(path)
+        skip_opt = not load_optimizer_states and name.startswith("opt_state")
+        arr = arrays.get(name)
+        if arr is None or skip_opt:
+            if skip_opt or name.startswith("comm_error"):
+                if arr is None and not skip_opt:
+                    logger.warning(
+                        f"checkpoint {tag_used!r} has no {name!r} (comm "
+                        "compression residuals restart from zero)"
+                    )
+                new_leaves.append(cur)
+                if arr is not None:
+                    used.add(name)
+                continue
+            raise KeyError(
+                f"checkpoint {tag_used!r} is missing state leaf {name!r} "
+                "(engine/checkpoint structure mismatch)"
+            )
+        used.add(name)
+        if tuple(arr.shape) != tuple(cur.shape):
+            raise ValueError(
+                f"state leaf {name!r}: checkpoint shape {tuple(arr.shape)} "
+                f"!= engine shape {tuple(cur.shape)}"
+            )
+        if np.dtype(arr.dtype) != np.dtype(cur.dtype):
+            # a silent dtype swap corrupts training exactly like a shape
+            # mismatch would — fail loud instead of retracing at the wrong
+            # precision
+            raise ValueError(
+                f"state leaf {name!r}: checkpoint dtype {arr.dtype} "
+                f"!= engine dtype {cur.dtype}"
+            )
+        new_leaves.append(jax.device_put(arr, sh))
+    extras = {
+        n: a for n, a in arrays.items()
+        if n not in used and n.startswith("__")
+    }
+    dropped = [
+        n for n in arrays
+        if n not in used and not n.startswith("__")
+    ]
+    if dropped:
+        logger.warning(
+            f"checkpoint {tag_used!r} carries leaves this engine does not: "
+            f"{dropped[:5]}{'...' if len(dropped) > 5 else ''}; dropping them"
+        )
+    state = jax.tree_util.tree_unflatten(treedef, new_leaves)
+    log_dist(f"restored checkpoint tag {tag_used!r} from {load_dir}")
+    return state, dict(manifest.get("client_state", {})), tag_used, extras
+
+
+class RollbackManager:
+    """Last-known-good in-memory snapshot + bounded restore.
+
+    ``snapshot`` keeps ONE host copy of the state (overwritten each call);
+    ``restore`` hands it back and counts — past ``max_rollbacks`` it raises
+    :class:`RollbackLimitError`, because a run that needs its Nth rollback
+    is diverging, not hitting bad batches."""
+
+    def __init__(self, max_rollbacks: int = 8, registry=None):
+        self.max_rollbacks = int(max_rollbacks)
+        self.rollbacks = 0
+        self._snap: Optional[Tuple[Any, int]] = None
+        self._snap_step: Optional[int] = None
+        if registry is not None:
+            self._c_rolled = registry.counter(
+                "rolled_back_steps_total",
+                "train steps undone by watchdog rollback",
+            )
+            self._c_events = registry.counter(
+                "recovery_events_total", "recovery actions by kind",
+                labelnames=("kind",),
+            )
+        else:
+            self._c_rolled = self._c_events = None
+
+    def snapshot(self, state: PyTree, global_steps: int) -> None:
+        """Host-copy the state (blocks until its producing step finished —
+        by snapshot time the engine already synced on the step's metrics, so
+        this is a device→host copy, not an extra device sync)."""
+        host = jax.device_get(state)
+        self._snap = (host, int(global_steps))
+        self._snap_step = int(global_steps)
+
+    @property
+    def can_restore(self) -> bool:
+        return self._snap is not None
+
+    @property
+    def snapshot_step(self) -> Optional[int]:
+        return self._snap_step
+
+    def restore(self) -> Tuple[Any, int]:
+        if self._snap is None:
+            raise RuntimeError("no snapshot taken yet")
+        self.rollbacks += 1
+        if self.rollbacks > self.max_rollbacks:
+            raise RollbackLimitError(
+                f"rollback #{self.rollbacks} exceeds "
+                f"resilience.max_rollbacks={self.max_rollbacks} — the "
+                "anomaly is persistent, not a poisoned batch; stopping"
+            )
+        if self._c_rolled is not None:
+            self._c_rolled.inc()
+            self._c_events.inc(kind="rollback")
+        return self._snap

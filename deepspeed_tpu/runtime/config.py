@@ -405,7 +405,10 @@ class WatchdogConfig(DSConfigModel):
     ``capture_dir``, oldest pruned). ``policy``: ``continue`` keeps
     training, ``kill`` raises ``AnomalyError`` after recording.
     ``straggler_factor`` drives the serving-slot straggler detector
-    (``ServingEngine.step``). Disabled ⇒ nothing constructed, zero host
+    (``ServingEngine.step``). ``policy="rollback"`` (ISSUE 7) restores the
+    last good in-memory snapshot and skips the poisoned batch instead of
+    killing the run — requires ``resilience.enabled`` with
+    ``snapshot_every > 0``. Disabled ⇒ nothing constructed, zero host
     callbacks."""
 
     enabled: bool = False
@@ -415,16 +418,16 @@ class WatchdogConfig(DSConfigModel):
     min_rel_std: float = 0.02  # std floor as a fraction of |mean|
     warmup_steps: int = 20
     check_every: int = 1
-    policy: str = "continue"  # continue | kill
+    policy: str = "continue"  # continue | kill | rollback
     capture_dir: str = "./telemetry/anomalies"
     max_captures: int = 3
     straggler_factor: float = 3.0
 
     def __post_init__(self):
-        if self.policy not in ("continue", "kill"):
+        if self.policy not in ("continue", "kill", "rollback"):
             raise DeepSpeedConfigError(
-                f"telemetry.watchdog.policy must be 'continue' or 'kill', "
-                f"got {self.policy!r}"
+                f"telemetry.watchdog.policy must be 'continue', 'kill' or "
+                f"'rollback', got {self.policy!r}"
             )
         if self.zscore <= 0:
             raise DeepSpeedConfigError("telemetry.watchdog.zscore must be positive")
@@ -507,6 +510,77 @@ class AnalysisConfig(DSConfigModel):
 
 
 @dataclass
+class FaultInjectionConfig(DSConfigModel):
+    """resilience.fault_injection section (ISSUE 7): seeded deterministic
+    fault injection (``resilience/faults.py``). Explicit index schedules are
+    the test-friendly mode — ``nan_loss_steps``/``sigterm_steps`` index by
+    the engine's ``train_batch`` invocation ordinal (1-based, monotonic —
+    NOT ``global_steps``, which a rollback rewinds), ``crash_saves`` by the
+    per-writer save ordinal (1-based), ``stall_requests`` by the serving
+    admission ordinal (1-based). ``probability`` adds a chaos mode: each
+    (site, index) fires independently with probability p, derived from a
+    stable hash of (seed, site, index) so the same seed replays the same
+    faults across restarts."""
+
+    enabled: bool = False
+    seed: int = 0
+    nan_loss_steps: List[int] = field(default_factory=list)
+    sigterm_steps: List[int] = field(default_factory=list)
+    crash_saves: List[int] = field(default_factory=list)
+    stall_requests: List[int] = field(default_factory=list)
+    probability: float = 0.0
+
+    def __post_init__(self):
+        if not 0.0 <= self.probability <= 1.0:
+            raise DeepSpeedConfigError(
+                "resilience.fault_injection.probability must be in [0, 1], "
+                f"got {self.probability}"
+            )
+
+
+@dataclass
+class ResilienceConfig(DSConfigModel):
+    """resilience section (ISSUE 7 tentpole): the fault-tolerance plane
+    (``deepspeed_tpu/resilience/``). With ``enabled`` the engine's
+    checkpoints use the integrity-checked manifest format (per-array crc32 +
+    config fingerprint, ``<tag>.tmp`` → fsync → rename → atomic ``latest``)
+    and ``load_checkpoint`` walks back across corrupt/torn tags to the
+    newest good one. ``async_checkpoint`` moves the disk write to a
+    background thread (ZeRO-Infinity overlap: the step path pays only the
+    HBM→host snapshot). ``snapshot_every`` sets the cadence of the
+    last-good-TrainState host snapshot (0 = off) consumed by the
+    watchdog's ``rollback`` policy, bounded by ``max_rollbacks`` —
+    snapshots are only taken when that policy is active (standard jitted
+    step path only), so async-checkpoint-only runs pay nothing.
+    ``grace_window_s`` is the PreemptionGuard's budget for flushing an
+    in-flight async save before exit (overrun forces a fresh blocking
+    snapshot). ``fault_injection`` is the deterministic fault plane — see
+    :class:`FaultInjectionConfig`. Disabled ⇒ nothing constructed, the
+    orbax checkpoint path and step loop are untouched."""
+
+    enabled: bool = False
+    async_checkpoint: bool = True
+    snapshot_every: int = 1
+    max_rollbacks: int = 8
+    grace_window_s: float = 30.0
+    fault_injection: FaultInjectionConfig = field(default_factory=FaultInjectionConfig)
+
+    def __post_init__(self):
+        if self.snapshot_every < 0:
+            raise DeepSpeedConfigError(
+                f"resilience.snapshot_every must be >= 0, got {self.snapshot_every}"
+            )
+        if self.max_rollbacks < 0:
+            raise DeepSpeedConfigError(
+                f"resilience.max_rollbacks must be >= 0, got {self.max_rollbacks}"
+            )
+        if self.grace_window_s < 0:
+            raise DeepSpeedConfigError(
+                f"resilience.grace_window_s must be >= 0, got {self.grace_window_s}"
+            )
+
+
+@dataclass
 class ServingConfig(DSConfigModel):
     """serving section (TPU-native; no reference analog — the reference serves
     one static batch per ``InferenceEngine.forward`` call). Drives the
@@ -538,6 +612,15 @@ class ServingConfig(DSConfigModel):
     top_k: int = 0
     top_p: float = 1.0
     kv_cache_dtype: str = ""  # "" = the inference engine's dtype
+    # --- resilience (ISSUE 7): graceful drain + transient-failure retry ---
+    # drain(): stop admission, finish in-flight up to this budget, evict the
+    # rest as PREEMPTED (slot/pages reclaimed — never wedged)
+    drain_deadline_s: float = 5.0
+    # transiently-failed requests (injected slot stalls, future real slot
+    # faults) re-enqueue up to retry_max times with exponential backoff
+    # (retry_backoff_s * 2^(retries-1)); 0 = transient failures are terminal
+    retry_max: int = 0
+    retry_backoff_s: float = 0.05
 
     def __post_init__(self):
         for key in ("max_slots", "page_size", "num_pages", "max_prompt_len",
@@ -606,6 +689,7 @@ class DeepSpeedConfig(DSConfigModel):
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     serving: ServingConfig = field(default_factory=ServingConfig)
     analysis: AnalysisConfig = field(default_factory=AnalysisConfig)
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
 
     gradient_clipping: float = 0.0
     prescale_gradients: bool = False

@@ -285,6 +285,47 @@ class DeepSpeedEngine:
         self._watchdog = (
             self.telemetry.watchdog if self.telemetry is not None else None
         )
+        # --- resilience plane (ISSUE 7): fault injector + rollback snapshots
+        # + async checkpoint writers. All None/empty when disabled — the
+        # step path pays two None checks, checkpointing stays orbax.
+        rcfg = config.resilience
+        self.fault_injector = None
+        self._rollback = None
+        self._ckpt_writers: Dict[str, Any] = {}
+        if rcfg.enabled:
+            from ..resilience import faults as _faults
+
+            self.fault_injector = _faults.from_config(rcfg.fault_injection)
+        if self._watchdog is not None and self._watchdog.policy == "rollback":
+            if not (rcfg.enabled and rcfg.snapshot_every > 0):
+                raise ValueError(
+                    "telemetry.watchdog.policy='rollback' requires "
+                    "resilience.enabled with resilience.snapshot_every > 0 "
+                    "(the rollback restores the resilience plane's in-memory "
+                    "snapshot)"
+                )
+            if not self._train_step_folds_rng:
+                # host-driven paths (offload/onebit/infinity) keep state the
+                # snapshot can't see (host optimizer tiers) and split the
+                # RNG per call — a restored snapshot would be inconsistent
+                # and the replayed steps would draw different keys
+                raise ValueError(
+                    "telemetry.watchdog.policy='rollback' supports the "
+                    "standard jitted train step only (not offload / 1-bit / "
+                    "infinity engines)"
+                )
+            from ..resilience.recovery import RollbackManager
+
+            # constructed ONLY when the rollback policy can consume it: an
+            # unconditional snapshot would device_get the full TrainState
+            # every snapshot_every steps for nothing
+            self._rollback = RollbackManager(
+                max_rollbacks=rcfg.max_rollbacks,
+                registry=(
+                    self.telemetry.registry
+                    if self.telemetry is not None else None
+                ),
+            )
         self._finish_init(model, config, training_data, collate_fn)
 
     def _init_param_offload(self, model, config, zcfg, seed, params) -> None:
@@ -1638,6 +1679,10 @@ class DeepSpeedEngine:
             self._step_structs_key = self._jit_step_programs()
         self.state, metrics = self._train_step(self.state, device_batch, step_rng)
         self.global_steps += 1
+        # monotonic train_batch ordinal: the fault-injection index. NOT
+        # global_steps — a rollback rewinds that, which would re-fire the
+        # same scheduled fault on every post-rollback step forever.
+        self._train_batch_count = getattr(self, "_train_batch_count", 0) + 1
         t_dispatched = time.perf_counter() if sampled else 0.0
         nan_flag = metrics.pop("nan_in_grads", None) if isinstance(metrics, dict) else None
         # dslint: disable=host-sync-in-step — debug.nan_check opts into a
@@ -1659,10 +1704,40 @@ class DeepSpeedEngine:
         # XLA dispatches asynchronously, so stopping on dispatch-return would
         # inflate samples/sec by the whole device step time
         self.tput_timer.stop(sync_tree=metrics)
-        if wd is not None:
-            self._watchdog_step(wd, metrics, t_start)
+        inj = self.fault_injector
+        if (
+            inj is not None
+            and isinstance(metrics, dict)
+            and inj.fire("nan_loss", self._train_batch_count)
+        ):
+            # ISSUE 7 fault injection: poison this step's loss scalar so the
+            # watchdog's non-finite detector (and the rollback/kill policy
+            # behind it) runs for real. Host-side only — the compiled
+            # program is untouched, so trajectories stay comparable.
+            metrics["loss"] = float("nan")
+            metrics["fault_injected"] = "nan_loss"
+            if wd is not None:
+                # route through the in-graph flags path too: off-cadence
+                # steps skip the scalar judgement (check_every > 1), and an
+                # injected fault that the cadence can silently miss tests
+                # nothing
+                metrics["anomaly_flags"] = 1  # FLAG_LOSS_NONFINITE
+        tripped = self._watchdog_step(wd, metrics, t_start) if wd is not None else []
+        if self._rollback is not None:
+            if tripped and wd.policy == "rollback":
+                self._apply_rollback(metrics)
+            elif (
+                not tripped
+                and self.global_steps % self.config.resilience.snapshot_every == 0
+            ):
+                # judged clean: refresh the last-known-good host snapshot
+                # (device→host copy only — tput_timer.stop already blocked
+                # on this step's outputs)
+                self._rollback.snapshot(self.state, self.global_steps)
         if sampled:
             self._telemetry_step(tel, metrics, t_start, t_prepared, t_dispatched)
+        if inj is not None and inj.fire("sigterm", self._train_batch_count):
+            inj.deliver_sigterm()
 
         if self.global_steps % self.steps_per_print == 0:
             # dslint: disable=host-sync-in-step — the print/monitor cadence
@@ -1773,13 +1848,14 @@ class DeepSpeedEngine:
             extra=extra,
         )
 
-    def _watchdog_step(self, wd, metrics, t_start: float) -> None:
+    def _watchdog_step(self, wd, metrics, t_start: float) -> list:
         """Close any active anomaly capture, then judge this step's scalars
         (ISSUE 5 watchdog). ``anomaly_flags`` — the in-graph NaN/Inf bitmask
         — is popped from the metrics surface regardless of the check cadence.
         The scalars are already synced (tput_timer.stop blocked on them), so
         the ``device_get`` here is a cheap host copy, not a device sync.
-        Raises AnomalyError under policy="kill"."""
+        Raises AnomalyError under policy="kill"; returns the tripped
+        anomalies (the rollback policy's input, ISSUE 7)."""
         wd.stop_capture()
         flags_arr = (
             metrics.pop("anomaly_flags", None) if isinstance(metrics, dict) else None
@@ -1792,8 +1868,8 @@ class DeepSpeedEngine:
             # in-graph NaN/Inf flags are computed every compiled step and a
             # transient non-finite must not slip through the cadence
             if flags:
-                wd.observe_step(self.global_steps, {}, flags=flags)
-            return
+                return wd.observe_step(self.global_steps, {}, flags=flags)
+            return []
         scalars: Dict[str, float] = {"step_time_s": time.perf_counter() - t_start}
         for k in ("loss", "grad_norm"):
             if isinstance(metrics, dict) and k in metrics:
@@ -1802,7 +1878,40 @@ class DeepSpeedEngine:
                     scalars[k] = float(jax.device_get(metrics[k]))
                 except (TypeError, ValueError):
                     pass
-        wd.observe_step(self.global_steps, scalars, flags=flags)
+        return wd.observe_step(self.global_steps, scalars, flags=flags)
+
+    def _apply_rollback(self, metrics) -> bool:
+        """Watchdog ``rollback`` policy (ISSUE 7): restore the last good
+        in-memory snapshot and discard this step's (poisoned) update — the
+        run continues as if the bad batch never happened. Raises
+        ``RollbackLimitError`` past ``resilience.max_rollbacks`` (a run
+        that keeps rolling back is diverging, not unlucky). Returns False
+        when no snapshot exists yet (warmup trip: nothing to restore)."""
+        rb = self._rollback
+        if rb is None or not rb.can_restore:
+            from ..utils.logging import warning_once
+
+            warning_once(
+                "watchdog rollback requested before the first clean-step "
+                "snapshot — continuing without rollback"
+            )
+            return False
+        host_state, steps = rb.restore()
+        self.state = jax.device_put(host_state, self.state_shardings)
+        self.global_steps = steps
+        if isinstance(metrics, dict):
+            metrics["rolled_back"] = True
+        if self.telemetry is not None:
+            self.telemetry.record_event(
+                "rollback", 0.0,
+                {"restored_step": steps, "rollbacks": rb.rollbacks},
+            )
+        log_dist(
+            f"watchdog rollback: restored in-memory snapshot of step {steps} "
+            f"(rollback {rb.rollbacks}/{rb.max_rollbacks}); poisoned batch "
+            "skipped"
+        )
+        return True
 
     def _lower_step_compiled(self):
         """Lower + compile the current jitted step for program-level analysis
@@ -2261,9 +2370,13 @@ class DeepSpeedEngine:
                 raise RuntimeError(msg) from e
             logger.warning(msg)
 
-    def save_checkpoint(self, save_dir: str, tag: Optional[str] = None, client_state: Optional[Dict] = None, save_latest: bool = True):
+    def save_checkpoint(self, save_dir: str, tag: Optional[str] = None, client_state: Optional[Dict] = None, save_latest: bool = True, blocking: Optional[bool] = None):
         from ..checkpoint.engine import save_train_state
 
+        if self._resilient_checkpointing():
+            return self._save_checkpoint_resilient(
+                save_dir, tag, client_state, save_latest, blocking
+            )
         t_ckpt0 = time.perf_counter()
         tag = tag or f"global_step{self.get_global_step()}"
         self._checkpoint_tag_validation(tag)
@@ -2293,6 +2406,174 @@ class DeepSpeedEngine:
                 {"step": self.global_steps, "tag": tag, "path": str(path)},
             )
         return path
+
+    # -- resilient checkpointing (ISSUE 7) ------------------------------
+    def _resilient_checkpointing(self) -> bool:
+        """Manifest-format (integrity-checked, walk-back-recoverable)
+        checkpointing engages when the resilience plane is on AND the
+        training state is device-resident — the host-tier engines
+        (offload/infinity) carry side files the manifest can't vouch for
+        yet, so they keep the orbax path."""
+        rcfg = self.config.resilience
+        if not rcfg.enabled:
+            return False
+        if self._offload is not None or self.param_offload_enabled:
+            from ..utils.logging import warning_once
+
+            warning_once(
+                "resilience checkpointing supports device-resident state "
+                "only; offload/infinity engines keep the orbax path"
+            )
+            return False
+        return True
+
+    def _config_fingerprint(self) -> str:
+        """Hex digest of the resolved config + mesh — stamped into every
+        manifest so a resume onto a different config is *visible* (warn on
+        mismatch at load; arrays still restore when shapes agree)."""
+        import dataclasses
+
+        from .debug import config_fingerprint
+
+        doc = {
+            k: v for k, v in dataclasses.asdict(self.config).items()
+            if not k.startswith("_")
+        }
+        return config_fingerprint(doc, self.mesh).hex()
+
+    def _checkpoint_writer(self, save_dir: str):
+        """One AsyncCheckpointWriter per save directory, created lazily."""
+        from ..resilience.writer import AsyncCheckpointWriter
+
+        key = os.path.abspath(save_dir)
+        w = self._ckpt_writers.get(key)
+        if w is None:
+            w = AsyncCheckpointWriter(
+                key,
+                fingerprint=self._config_fingerprint(),
+                registry=(
+                    self.telemetry.registry if self.telemetry is not None else None
+                ),
+                injector=self.fault_injector,
+                telemetry=self.telemetry,
+            )
+            self._ckpt_writers[key] = w
+        return w
+
+    def flush_checkpoints(self, timeout: Optional[float] = None) -> bool:
+        """Drain every pending async checkpoint write (the PreemptionGuard
+        grace-window hook). True when everything committed in time.
+        ``timeout`` is ONE shared deadline across all writers — a grace
+        window must not multiply by the number of save directories."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        ok = True
+        for w in self._ckpt_writers.values():
+            left = None if deadline is None else max(0.0, deadline - time.monotonic())
+            ok = w.wait(timeout=left) and ok
+        return ok
+
+    def _resilience_counter_values(self) -> Dict[str, float]:
+        """Current values of the resilience telemetry counters, carried in
+        the manifest client state so a restart resumes the counts."""
+        if self.telemetry is None:
+            return {}
+        out = {}
+        for name in ("rolled_back_steps_total", "checkpoint_writes_total"):
+            m = self.telemetry.registry.get(name)
+            if m is not None:
+                try:
+                    out[name] = float(m.value())
+                except Exception:
+                    pass
+        return out
+
+    def _save_checkpoint_resilient(
+        self, save_dir, tag, client_state, save_latest, blocking
+    ) -> str:
+        from ..resilience.writer import snapshot_to_host
+
+        rcfg = self.config.resilience
+        t_ckpt0 = time.perf_counter()
+        tag = tag or f"global_step{self.get_global_step()}"
+        self._checkpoint_tag_validation(tag)
+        # the snapshot is the only step-path cost: the write happens on the
+        # writer thread (resilience.async_checkpoint; blocking overrides)
+        arrays = snapshot_to_host(
+            self.state, extra={"__rng__": np.asarray(self._rng)}
+        )
+        client = {
+            **(client_state or {}),
+            "global_steps": self.global_steps,
+            "resilience_counters": self._resilience_counter_values(),
+        }
+        writer = self._checkpoint_writer(save_dir)
+        block = (not rcfg.async_checkpoint) if blocking is None else bool(blocking)
+        path = writer.save(
+            tag, arrays, client_state=client,
+            step=self.global_steps, save_latest=save_latest, blocking=block,
+        )
+        log_dist(
+            f"{'committed' if block else 'enqueued async'} resilient "
+            f"checkpoint: {path}"
+        )
+        if self.telemetry is not None:
+            self.telemetry.record_event(
+                "checkpoint_save", time.perf_counter() - t_ckpt0,
+                {
+                    "step": self.global_steps, "tag": tag, "path": str(path),
+                    "async": not block,
+                },
+            )
+        return path
+
+    def _load_checkpoint_resilient(
+        self, load_dir, tag, load_optimizer_states
+    ) -> Tuple[str, Dict]:
+        from ..resilience.recovery import load_resilient_state
+
+        t_ckpt0 = time.perf_counter()
+        registry = self.telemetry.registry if self.telemetry is not None else None
+        state, client_state, tag_used, extras = load_resilient_state(
+            load_dir, tag, self.state, self.state_shardings,
+            load_optimizer_states=load_optimizer_states,
+            registry=registry,
+        )
+        self.state = state
+        rng = extras.get("__rng__")
+        if rng is not None:
+            self._rng = jnp.asarray(rng)
+        self.global_steps = int(client_state.get("global_steps", self.get_global_step()))
+        self._offload_applied_steps = self.get_global_step()
+        # resume the resilience counters a previous run accumulated
+        if registry is not None:
+            for name, v in (client_state.get("resilience_counters") or {}).items():
+                m = registry.get(name)
+                try:
+                    cur = float(m.value()) if m is not None else None
+                except Exception:
+                    cur = None
+                if m is not None and cur is not None and v > cur:
+                    m.inc(v - cur)
+        # config drift is visible, not fatal: shapes already validated
+        from ..resilience.manifest import read_manifest
+
+        saved_fp = read_manifest(
+            os.path.join(os.path.abspath(load_dir), tag_used)
+        ).get("fingerprint", "")
+        if saved_fp and saved_fp != self._config_fingerprint():
+            logger.warning(
+                f"checkpoint tag {tag_used!r} was saved under a different "
+                "config/mesh fingerprint — resuming anyway (shapes matched)"
+            )
+        log_dist(
+            f"loaded resilient checkpoint from {load_dir} (tag={tag_used})"
+        )
+        if self.telemetry is not None:
+            self.telemetry.record_event(
+                "checkpoint_load", time.perf_counter() - t_ckpt0,
+                {"step": self.global_steps, "tag": tag_used, "path": load_dir},
+            )
+        return load_dir, client_state
 
     def save_16bit_model(self, save_dir: str, output_file: str = "pytorch_model.npz"):
         """Gather the (possibly ZeRO-sharded) params to full arrays, cast to
@@ -2331,6 +2612,16 @@ class DeepSpeedEngine:
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None, load_optimizer_states: bool = True, load_lr_scheduler_states: bool = True):
         from ..checkpoint.engine import load_train_state
 
+        # manifest-format checkpoints are self-identifying: restore them with
+        # integrity validation + corrupt-tag walk-back regardless of this
+        # engine's resilience setting (a resilient run's artifacts must stay
+        # loadable after the config flag flips off)
+        from ..resilience.recovery import is_resilient_dir
+
+        if is_resilient_dir(load_dir, tag):
+            return self._load_checkpoint_resilient(
+                load_dir, tag, load_optimizer_states
+            )
         t_ckpt0 = time.perf_counter()
         try:
             state, client_state = load_train_state(

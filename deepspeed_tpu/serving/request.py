@@ -23,8 +23,10 @@ class RequestStatus:
     TRUNCATED = "truncated"     # deadline passed mid-decode: partial output
     TIMED_OUT = "timed_out"     # deadline passed before ever reaching a slot
     REJECTED = "rejected"       # backpressure: queue full / can never fit
+    PREEMPTED = "preempted"     # graceful drain evicted it (shutdown/SIGTERM)
+    FAILED = "failed"           # transient slot failure, retry budget spent
 
-    TERMINAL = (FINISHED, TRUNCATED, TIMED_OUT, REJECTED)
+    TERMINAL = (FINISHED, TRUNCATED, TIMED_OUT, REJECTED, PREEMPTED, FAILED)
 
 
 _ids = itertools.count()
@@ -43,6 +45,15 @@ class Request:
     # original ask when admission clamped max_new_tokens (over-long request
     # degrading to a truncated response); None = not clamped
     requested_new_tokens: Optional[int] = None
+
+    # -- resilience (ISSUE 7) ------------------------------------------
+    # transient-failure retries consumed (scheduler retry-with-backoff)
+    retries: int = 0
+    # earliest re-admission time after a backoff (scheduler clock domain)
+    not_before: float = 0.0
+    # fault injection: fail this slot transiently once it has emitted this
+    # many tokens (None = healthy); set by the scheduler at admission
+    stall_after: Optional[int] = None
 
     # -- filled by the scheduler ---------------------------------------
     id: int = field(default_factory=lambda: next(_ids))

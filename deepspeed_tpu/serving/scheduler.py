@@ -22,6 +22,13 @@ door; per-request deadlines evict mid-flight to a TRUNCATED response; an
 over-long ask is clamped at submit. A stuck or runaway request can therefore
 never wedge the batch — the invariant the timeout tests pin down.
 
+Resilience (ISSUE 7): :meth:`drain` is the graceful-shutdown path (stop
+admission, finish in-flight up to ``serving.drain_deadline_s``, evict the
+rest as PREEMPTED — slots and KV pages always reclaimed); transiently
+failed slots (fault-injected stalls today, real slot faults tomorrow)
+re-enqueue their request with exponential backoff up to
+``serving.retry_max`` times before going terminal FAILED.
+
 Determinism: slot ``b``'s token stream is bit-identical to a sequential
 ``generate`` of the same request (see serving/model.py for why), which the
 token-equivalence test asserts for mixed-length streams.
@@ -90,7 +97,7 @@ class ServingEngine:
     :meth:`submit` + :meth:`step`, or :meth:`run` to drain. ``clock`` is
     injectable for deterministic timeout tests."""
 
-    def __init__(self, engine, config=None, clock=time.monotonic):
+    def __init__(self, engine, config=None, clock=time.monotonic, fault_injector=None):
         from ..runtime.config import ServingConfig
 
         if config is None:
@@ -100,6 +107,14 @@ class ServingEngine:
         self.config = config
         self.engine = engine
         self.clock = clock
+        # resilience (ISSUE 7): deterministic fault injection + drain state
+        self.fault_injector = (
+            fault_injector
+            if fault_injector is not None
+            else getattr(engine, "fault_injector", None)
+        )
+        self._draining = False
+        self._admissions = 0  # 1-based admission ordinal (stall injection)
         mcfg = engine.model_config
         if not isinstance(mcfg, GPT2Config):
             raise ValueError(
@@ -190,6 +205,14 @@ class ServingEngine:
         self._c_stragglers = m.counter(
             "serving_stragglers_total",
             "requests flagged resident in a slot far beyond their decode budget",
+        )
+        self._c_drained = m.counter(
+            "serving_drained_requests_total",
+            "requests preempted by a graceful drain (queued + in-flight)",
+        )
+        self._c_retries = m.counter(
+            "serving_retried_requests_total",
+            "transient slot failures re-enqueued with backoff",
         )
         # anomaly watchdog (ISSUE 5): shared with the owning engine's
         # telemetry when present — straggler trips land in the same trace
@@ -287,6 +310,8 @@ class ServingEngine:
             req.requested_new_tokens = mnt
             req.max_new_tokens = cap
             req.detail = f"max_new_tokens clamped {mnt} -> {cap}"
+        if self._draining:
+            return self._reject(req, "engine draining (admission stopped)")
         if len(self.queue) >= int(self.config.max_queue_depth):
             return self._reject(req, f"queue full ({self.config.max_queue_depth})")
         self.queue.append(req)
@@ -341,18 +366,27 @@ class ServingEngine:
             self.queue = keep
 
         # 2. prefill insertions: FIFO admission into free slots, gated by the
-        # KV-page budget (head-of-line blocks until draining slots free pages)
-        while self.queue:
+        # KV-page budget (head-of-line blocks until draining slots free
+        # pages). A drain stops admission entirely; a retried request still
+        # inside its backoff window (not_before) is passed over, not a
+        # head-of-line blocker.
+        while self.queue and not self._draining:
             free = next(
                 (i for i, s in enumerate(self.slots) if s.request is None), None
             )
             if free is None:
                 break
-            req = self.queue[0]
+            idx = next(
+                (j for j, r in enumerate(self.queue) if r.not_before <= now),
+                None,
+            )
+            if idx is None:
+                break
+            req = self.queue[idx]
             need = pages_for(req.prompt_len + req.max_new_tokens, self.page_size)
             if need > self.allocator.free_pages:
                 break
-            self.queue.popleft()
+            del self.queue[idx]
             self._admit(free, req)
 
         # 3. one batched decode step for every active slot
@@ -393,6 +427,10 @@ class ServingEngine:
                     req.eos_token_id is not None and tok == req.eos_token_id
                 ):
                     self._finish_slot(i, RequestStatus.FINISHED, "", now)
+                elif req.stall_after is not None and len(req.tokens) >= req.stall_after:
+                    # injected transient slot failure (ISSUE 7): evict and
+                    # route through the retry-with-backoff path
+                    self._fail_slot(i, "injected slot stall", now)
                 elif slot.keys is not None and slot.step < len(slot.keys):
                     self.table.keys[i] = slot.keys[slot.step]
 
@@ -428,6 +466,15 @@ class ServingEngine:
         return n_active
 
     def _admit(self, slot_i: int, req: Request) -> None:
+        self._admissions += 1
+        if (
+            req.stall_after is None
+            and self.fault_injector is not None
+            and self.fault_injector.fire("serving_stall", self._admissions)
+        ):
+            # fail once the request is mid-decode — the interesting point:
+            # pages held, tokens emitted, retry must rewind all of it
+            req.stall_after = max(1, req.max_new_tokens // 2)
         pages = self.allocator.alloc(
             pages_for(req.prompt_len + req.max_new_tokens, self.page_size)
         )
@@ -510,6 +557,95 @@ class ServingEngine:
         self.table.clear(slot_i)
         self.slots[slot_i] = _Slot()
         self.completed.append(req)
+
+    def _fail_slot(self, slot_i: int, why: str, now: float) -> None:
+        """Transient slot failure (ISSUE 7): reclaim the slot and pages
+        immediately, then either re-enqueue the request with exponential
+        backoff (``serving.retry_max`` budget — generation restarts from
+        scratch, the evicted KV is gone) or finish it terminal FAILED."""
+        slot = self.slots[slot_i]
+        req = slot.request
+        self.allocator.free(slot.pages)
+        self.table.clear(slot_i)
+        self.slots[slot_i] = _Slot()
+        retry_max = int(getattr(self.config, "retry_max", 0))
+        if not self._draining and req.retries < retry_max:
+            req.retries += 1
+            req.stall_after = None  # the injected fault is one-shot
+            req.tokens = []
+            req.status = RequestStatus.QUEUED
+            req.t_first_token = None
+            req.not_before = now + float(
+                getattr(self.config, "retry_backoff_s", 0.05)
+            ) * (2 ** (req.retries - 1))
+            req.detail = f"retry {req.retries}/{retry_max}: {why}"
+            self._c_retries.inc()
+            self.queue.append(req)
+            self._g_queue.set(len(self.queue))
+        else:
+            req.status = RequestStatus.FAILED
+            req.detail = why if req.retries == 0 else (
+                f"{why} (retry budget {retry_max} spent)"
+            )
+            req.t_finish = now
+            self._c_requests.inc(status=RequestStatus.FAILED)
+            self.completed.append(req)
+
+    def drain(self, deadline_s: Optional[float] = None) -> dict:
+        """Graceful shutdown (ISSUE 7): stop admission, let in-flight
+        requests finish inside the deadline (``serving.drain_deadline_s``
+        default), then evict whatever remains as PREEMPTED — every slot
+        empty and every KV page back on the free list when this returns
+        (asserted via :meth:`check_no_leaks`). Queued requests that never
+        reached a slot are preempted immediately: starting new work inside
+        a shutdown window is how drains overrun.
+
+        Idempotent and terminal for this engine instance — ``submit`` after
+        ``drain`` rejects with "engine draining"."""
+        self._draining = True
+        start = self.clock()
+        deadline = start + float(
+            self.config.drain_deadline_s if deadline_s is None else deadline_s
+        )
+        preempted = 0
+        while self.queue:
+            req = self.queue.popleft()
+            req.status = RequestStatus.PREEMPTED
+            req.detail = "drained before admission"
+            req.t_finish = start
+            self._c_requests.inc(status=RequestStatus.PREEMPTED)
+            self._c_drained.inc()
+            self.completed.append(req)
+            preempted += 1
+        finished = 0
+        while any(s.request is not None for s in self.slots) and self.clock() < deadline:
+            before = {id(s.request) for s in self.slots if s.request is not None}
+            self.step()
+            finished += sum(
+                1 for x in before
+                if x not in {id(s.request) for s in self.slots if s.request is not None}
+            )
+        now = self.clock()
+        deadline_hit = False
+        for i, s in enumerate(self.slots):
+            if s.request is not None:
+                deadline_hit = True
+                self._c_drained.inc()
+                self._finish_slot(i, RequestStatus.PREEMPTED, "drained at deadline", now)
+                preempted += 1
+        self._g_queue.set(0)
+        self._g_util.set(0.0)
+        self._g_pages.set(self.allocator.pages_in_use)
+        log_dist(
+            f"serving drain complete in {now - start:.3f}s: "
+            f"{finished} finished in-flight, {preempted} preempted"
+        )
+        return {
+            "duration_s": now - start,
+            "finished_in_flight": finished,
+            "preempted": preempted,
+            "deadline_hit": deadline_hit,
+        }
 
     def run(self, max_steps: Optional[int] = None) -> List[Request]:
         """Drive :meth:`step` until queue and slots drain; returns every
@@ -604,6 +740,9 @@ class ServingEngine:
         out["completed"] = len(self.completed)
         out["decode_steps"] = self._step_count
         out["stragglers"] = int(self._c_stragglers.value())
+        out["drained"] = int(self._c_drained.value())
+        out["retried"] = int(self._c_retries.value())
+        out["draining"] = self._draining
         return out
 
     def check_no_leaks(self) -> None:

@@ -31,9 +31,12 @@ On trip
    pathology right after detection. The directory is bounded:
    ``max_captures`` total, oldest pruned;
 3. ``policy`` decides what happens to the run: ``"continue"`` (default —
-   log and keep going) or ``"kill"`` (raise :class:`AnomalyError` so the
+   log and keep going), ``"kill"`` (raise :class:`AnomalyError` so the
    training loop stops at the step that went bad instead of burning
-   TPU-hours on a diverged run).
+   TPU-hours on a diverged run), or ``"rollback"`` (ISSUE 7: the engine
+   restores the last good in-memory snapshot and skips the poisoned batch
+   — the watchdog only detects and records; the state surgery lives in
+   ``runtime/engine.py`` + ``resilience/recovery.py``).
 
 A disabled watchdog config constructs nothing: the engine holds
 ``watchdog=None`` and the step path pays one ``None`` check.
