@@ -571,6 +571,72 @@ def run_resilience_bench():
     return pr7
 
 
+def run_dsan_bench():
+    """BENCH_pr8.json (ISSUE 8): the concurrency/collective sanitizer plane
+    as a diffable artifact — per-rule finding counts of the two new engines
+    over the package, and the runtime sanitizer's measured overhead on the
+    instrumented StepTracer hot path (emit+flush throughput with the shim
+    active vs plain locks)."""
+    import tempfile
+    import time as _time
+
+    from deepspeed_tpu.analysis import runtime_sanitizer as _dsan
+    from deepspeed_tpu.telemetry.tracer import StepTracer
+    from deepspeed_tpu.tools import dslint as _dsl
+
+    pkg = os.path.join(_BENCH_DIR, "deepspeed_tpu")
+    baseline = _dsl._find_baseline([pkg])
+    per_rule = {}
+    totals = {"findings_total": 0, "new": 0, "suppressed": 0}
+    for letter in ("c", "d"):
+        rep = _dsl.collect([pkg], baseline_path=baseline,
+                           engines=frozenset(letter))
+        for rule, n in rep["per_rule"].items():
+            per_rule[rule] = per_rule.get(rule, 0) + n
+        totals["findings_total"] += rep["findings_total"]
+        totals["new"] += len(rep["new"])
+        totals["suppressed"] += rep["suppressed"]
+        if letter == "c":
+            c_report = rep
+
+    def _emit_loop(n=400):
+        with tempfile.TemporaryDirectory() as td:
+            t = StepTracer(os.path.join(td, "t.jsonl"),
+                           flush_interval=20, process_index=0)
+            t0 = _time.perf_counter()
+            for i in range(n):
+                t.emit({"kind": "train_step", "step": i, "loss": 1.0})
+            t.close()
+            return _time.perf_counter() - t0
+
+    plain_s = min(_emit_loop() for _ in range(3))
+    _dsan.enable(_dsan.RuntimeSanitizer())
+    try:
+        sanitized_s = min(_emit_loop() for _ in range(3))
+        observed = _dsan.active().findings()
+    finally:
+        _dsan.disable()
+    overhead_pct = (
+        100.0 * (sanitized_s - plain_s) / plain_s if plain_s > 0 else 0.0
+    )
+    pr8 = {
+        "schema": "bench_pr8_dsan_v1",
+        "dsan_findings_total": totals["findings_total"],
+        "dsan_new_findings": totals["new"],
+        "dsan_suppressed": totals["suppressed"],
+        "per_rule": per_rule,
+        "sanitizer_overhead_pct": round(overhead_pct, 2),
+        "sanitizer_runtime_findings": len(observed),
+        "tracer_emit_plain_us": round(plain_s / 400 * 1e6, 2),
+        "tracer_emit_sanitized_us": round(sanitized_s / 400 * 1e6, 2),
+        "baseline": c_report["baseline_path"],
+    }
+    with open(os.path.join(_BENCH_DIR, "BENCH_pr8.json"), "w") as fh:
+        json.dump(pr8, fh, indent=1)
+        fh.write("\n")
+    return pr8
+
+
 def run_dslint_bench():
     """BENCH_pr6.json (ISSUE 6): the dslint static-analysis finding count as
     a diffable run-over-run benchmark artifact — lint debt growing between
@@ -1067,6 +1133,16 @@ def main():
         result["dslint_new_findings"] = pr6["dslint_new_findings"]
     except Exception as e:
         result["pr6_error"] = f"{type(e).__name__}: {e}"
+    # --- BENCH_pr8.json (ISSUE 8): concurrency/collective sanitizer plane —
+    # per-rule counts of engines C/D + the runtime sanitizer's measured
+    # overhead on the instrumented tracer hot path
+    try:
+        pr8 = run_dsan_bench()
+        result["pr8_artifact"] = "BENCH_pr8.json"
+        result["dsan_new_findings"] = pr8["dsan_new_findings"]
+        result["sanitizer_overhead_pct"] = pr8["sanitizer_overhead_pct"]
+    except Exception as e:
+        result["pr8_error"] = f"{type(e).__name__}: {e}"
     # --- BENCH_pr7.json (ISSUE 7): fault-tolerance plane — async-save
     # overhead per step + corrupt-tag recovery time. BENCH_RESILIENCE=0
     # opts out (it compiles a second tiny engine on CPU runs).
@@ -1086,9 +1162,12 @@ if __name__ == "__main__":
     # BENCH_SERVING_ONLY=1: just the serving sweep (CPU-friendly; no backend
     # probe/training) — prints the BENCH_pr3.json content as the one JSON line.
     # BENCH_RESILIENCE_ONLY=1: just the fault-tolerance bench (BENCH_pr7.json).
+    # BENCH_DSAN_ONLY=1: just the sanitizer-plane bench (BENCH_pr8.json).
     if os.environ.get("BENCH_SERVING_ONLY", "0") == "1":
         print(json.dumps(run_serving_bench()))
     elif os.environ.get("BENCH_RESILIENCE_ONLY", "0") == "1":
         print(json.dumps(run_resilience_bench()))
+    elif os.environ.get("BENCH_DSAN_ONLY", "0") == "1":
+        print(json.dumps(run_dsan_bench()))
     else:
         main()

@@ -1,6 +1,6 @@
-"""dslint — the graph & sharding static-analysis plane (ISSUE 6 tentpole).
+"""dslint — the static-analysis plane (ISSUE 6 tentpole, ISSUE 8 dsan).
 
-Two engines over one findings/severity/suppression model:
+Four engines over one findings/severity/suppression model:
 
 - **Engine A** (``hlo_rules``): program verifiers over post-optimization HLO
   text — replication, buffer donation, precision, collective overlap, and
@@ -9,11 +9,22 @@ Two engines over one findings/severity/suppression model:
 - **Engine B** (``ast_rules``): a Python AST lint for JAX footguns — host
   syncs and device-op dispatch in per-step code, tracer branching, missing
   donation, unstable compile-cache keys.
+- **Engine C** (``concurrency_rules``): the AST concurrency sanitizer —
+  per-module thread/lock/shared-attribute model reporting unlocked shared
+  state, lock-order cycles, signal-unsafe handlers, thread leaks and
+  blocking calls under locks. Its dynamic half, ``runtime_sanitizer``,
+  records REAL lock orders and cross-thread accesses in ``dsan``-marked
+  tests and reports through the same Finding stream.
+- **Engine D** (``collective_rules``): the HLO collective-consistency
+  verifier — channel-id uniqueness, async start/done pairing and FIFO
+  order, and cross-program collective-order agreement on shared mesh
+  groups (the SPMD desync/deadlock shape).
 
 Front ends: the ``python -m deepspeed_tpu.tools.dslint`` CLI (with the
-committed-baseline CI gate), the ``lint``-marked tier-1 tests, and
-``bench.py``'s ``dslint_findings_total``. See ``docs/ANALYSIS.md`` for the
-rule catalog and the suppression / baseline workflow.
+committed-baseline CI gate and ``--engines a,b,c,d`` selection), the
+``lint``/``dsan``-marked tier-1 tests, and ``bench.py``'s finding counters.
+See ``docs/ANALYSIS.md`` for the rule catalog and the suppression /
+baseline workflow.
 """
 
 from .ast_rules import (  # noqa: F401
@@ -24,6 +35,20 @@ from .ast_rules import (  # noqa: F401
 )
 from .ast_rules import RULES as AST_RULES  # noqa: F401
 from .baseline import DEFAULT_BASELINE_NAME, Baseline  # noqa: F401
+from .collective_rules import (  # noqa: F401
+    CollectiveOp,
+    extract_collectives,
+    verify_collective_text,
+    verify_compiled_set,
+    verify_program_set,
+)
+from .collective_rules import RULES as COLLECTIVE_RULES  # noqa: F401
+from .concurrency_rules import (  # noqa: F401
+    build_model,
+    check_file,
+    check_source,
+)
+from .concurrency_rules import RULES as CONCURRENCY_RULES  # noqa: F401
 from .findings import (  # noqa: F401
     SEVERITY_ERROR,
     SEVERITY_WARNING,
@@ -39,17 +64,36 @@ from .hlo_rules import (  # noqa: F401
 )
 from .hlo_rules import RULES as HLO_RULES  # noqa: F401
 
+# engine letter → rule catalog (the CLI's --engines selector)
+ENGINE_RULES = {
+    "a": HLO_RULES,
+    "b": AST_RULES,
+    "c": CONCURRENCY_RULES,
+    "d": COLLECTIVE_RULES,
+}
+ALL_ENGINES = frozenset(ENGINE_RULES)
 
-def all_rules():
-    """rule id → one-line description, both engines."""
-    out = dict(HLO_RULES)
-    out.update(AST_RULES)
+# HLO text dumps the CLI can verify with Engines A/D without a live engine
+HLO_SUFFIXES = (".hlo",)
+
+
+def all_rules(engines=None):
+    """rule id → one-line description for the selected engines (default
+    all four)."""
+    out = {}
+    for letter in sorted(engines or ALL_ENGINES):
+        out.update(ENGINE_RULES[letter])
     return out
 
 
-def lint_paths(paths, hot_patterns=None, donate_patterns=None):
-    """Lint every ``*.py`` under ``paths`` (files or directories) with
-    Engine B → (findings, suppressed_count, files_scanned).
+def lint_paths(paths, hot_patterns=None, donate_patterns=None, engines=None):
+    """Lint files under ``paths`` (files or directories) →
+    (findings, suppressed_count, files_scanned).
+
+    ``*.py`` files go through the source engines (B and/or C per
+    ``engines``); ``*.hlo`` text dumps go through the program engines (A
+    with a default declaration context and/or D, including the
+    cross-program order-divergence check over every dump in the run).
 
     Unparseable files surface as SyntaxError, bogus path arguments as
     ValueError — callers decide whether that is fatal (the CLI reports
@@ -57,7 +101,15 @@ def lint_paths(paths, hot_patterns=None, donate_patterns=None):
     pass vacuously by scanning nothing)."""
     import os
 
-    files = []
+    engines = frozenset(engines or ALL_ENGINES)
+    py_files, hlo_files = [], []
+
+    def _route(f):
+        if f.endswith(".py"):
+            py_files.append(f)
+        elif f.endswith(HLO_SUFFIXES):
+            hlo_files.append(f)
+
     for p in paths:
         if os.path.isdir(p):
             for root, dirs, names in os.walk(p):
@@ -65,22 +117,62 @@ def lint_paths(paths, hot_patterns=None, donate_patterns=None):
                     d for d in dirs
                     if d not in ("__pycache__", ".git", ".pytest_cache")
                 )
-                files.extend(
-                    os.path.join(root, n) for n in sorted(names)
-                    if n.endswith(".py")
-                )
-        elif p.endswith(".py") and os.path.exists(p):
-            files.append(p)
+                for n in sorted(names):
+                    _route(os.path.join(root, n))
+        elif os.path.exists(p) and (
+            p.endswith(".py") or p.endswith(HLO_SUFFIXES)
+        ):
+            _route(p)
         else:
             raise ValueError(
                 f"dslint path {p!r} is not a directory or an existing "
-                ".py file"
+                ".py/.hlo file"
             )
     findings, suppressed = [], 0
-    for f in files:
-        got, waived = lint_file(
-            f, hot_patterns=hot_patterns, donate_patterns=donate_patterns
+    for f in py_files:
+        if "b" in engines:
+            got, waived = lint_file(
+                f, hot_patterns=hot_patterns, donate_patterns=donate_patterns
+            )
+            findings.extend(got)
+            suppressed += waived
+        if "c" in engines:
+            got, waived = check_file(f)
+            findings.extend(got)
+            suppressed += waived
+    hlo_texts = {}
+    for f in hlo_files:
+        with open(f, encoding="utf-8") as fh:
+            hlo_texts[f] = fh.read()
+    for f, txt in hlo_texts.items():
+        program = os.path.splitext(os.path.basename(f))[0]
+        if "a" in engines:
+            got = verify_hlo_text(txt, RuleContext(program=program))
+            for x in got:
+                x.path = f  # real file provenance beats hlo://<program>
+            findings.extend(got)
+        if "d" in engines:
+            got = verify_collective_text(txt, program)
+            for x in got:
+                x.path = f
+            findings.extend(got)
+    if "d" in engines and len(hlo_texts) > 1:
+        # program name = basename when unique; colliding basenames (e.g.
+        # runA/step.hlo vs runB/step.hlo — the natural two-run compare)
+        # keep their full paths so neither dump silently shadows the other
+        short = {}
+        for f in hlo_texts:
+            short.setdefault(
+                os.path.splitext(os.path.basename(f))[0], []
+            ).append(f)
+        by_program = {
+            (name if len(files) == 1 else f): hlo_texts[f]
+            for name, files in short.items() for f in files
+        }
+        from .collective_rules import (
+            extract_collectives as _ext,
+            rule_order_divergence as _div,
         )
-        findings.extend(got)
-        suppressed += waived
-    return findings, suppressed, files
+
+        findings.extend(_div({p: _ext(t) for p, t in by_program.items()}))
+    return findings, suppressed, py_files + hlo_files

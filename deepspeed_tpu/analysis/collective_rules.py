@@ -1,0 +1,289 @@
+"""Engine D: HLO collective-consistency verifier — SPMD ordering rules.
+
+A multichip program deadlocks the way ROADMAP item 4's hand-pipelined
+``ppermute`` chains will: two programs (or two branches of one) disagree
+about which collective happens next on a shared mesh axis, every chip waits
+for a partner that is executing a different collective, and the run hangs
+with zero error text. The compiled HLO states the full collective schedule
+— op kind, ``channel_id``, ``replica_groups``/``source_target_pairs``,
+async ``-start``/``-done`` pairing — so the desync shapes are checkable at
+verify time:
+
+- ``collective-channel-reuse``: one ``channel_id`` claimed by two distinct
+  collective ops in a program. XLA assigns channels uniquely; a reused one
+  (hand-written ``Send``/``Recv`` ladders, manual channel plumbing) makes
+  two logically different collectives rendezvous with each other.
+- ``collective-start-orphan``: an async ``-start`` whose result no ``-done``
+  consumes (the transfer is never awaited — its buffer lifetime is a race),
+  or a ``-done`` with no matching start.
+- ``collective-order-inversion``: two async collectives of the same kind on
+  the same group set whose dones complete in the opposite order to their
+  starts — an in-flight FIFO inversion; legal to XLA's scheduler only when
+  it proves independence, a deadlock when a manual pipeline gets it wrong.
+- ``collective-order-divergence``: across a program SET (the engine's
+  compiled-step cache, both serving executables), programs sharing a
+  replica-group signature must issue the same ordered kind-sequence on it.
+  Two programs that may run concurrently on one mesh axis but disagree on
+  the collective order are the textbook SPMD desync.
+
+All shape/size parsing reuses ``telemetry.introspect.parse_instruction`` —
+the third HLO reader in the codebase shares the first one's grammar.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..telemetry.introspect import parse_instruction
+from .findings import SEVERITY_ERROR, SEVERITY_WARNING, Finding
+
+RULES = {
+    "collective-channel-reuse":
+        "one channel_id claimed by two distinct collectives in a program",
+    "collective-start-orphan":
+        "async collective start never awaited (or done without start)",
+    "collective-order-inversion":
+        "async dones complete in the opposite order to their starts",
+    "collective-order-divergence":
+        "programs sharing a mesh group issue different collective orders",
+}
+
+_COLLECTIVE_KINDS = (
+    "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+    "collective-permute",
+)
+
+_RESULT_NAME = re.compile(r"^\s*(?:ROOT\s+)?%(?P<name>[\w.\-]+)\s*=")
+_CHANNEL = re.compile(r"channel_id=(\d+)")
+_OPERAND = re.compile(r"%([\w.\-]+)")
+
+
+def _group_signature(line: str) -> str:
+    """Canonical replica-group text: ``replica_groups={{...}}`` (or
+    ``source_target_pairs`` for collective-permute), braces matched so the
+    nested form survives. '' when absent (full-world default)."""
+    for key in ("replica_groups=", "source_target_pairs="):
+        at = line.find(key)
+        if at < 0:
+            continue
+        i = line.find("{", at)
+        if i < 0:
+            continue
+        depth = 0
+        for j in range(i, len(line)):
+            if line[j] == "{":
+                depth += 1
+            elif line[j] == "}":
+                depth -= 1
+                if depth == 0:
+                    return line[i:j + 1].replace(" ", "")
+    return ""
+
+
+@dataclass
+class CollectiveOp:
+    """One collective instruction, in program text order."""
+
+    op: str                       # full opcode, e.g. "all-gather-start"
+    kind: str                     # base kind, e.g. "all-gather"
+    name: str                     # SSA result name (without %)
+    channel_id: Optional[int]
+    groups: str                   # canonical replica-group signature
+    nbytes: int
+    line_no: int
+    snippet: str
+    operands: List[str] = field(default_factory=list)
+
+    @property
+    def is_start(self) -> bool:
+        return self.op.endswith("-start")
+
+    @property
+    def is_done(self) -> bool:
+        return self.op.endswith("-done")
+
+
+def extract_collectives(txt: str) -> List[CollectiveOp]:
+    """Ordered collective sequence of one HLO module text."""
+    out: List[CollectiveOp] = []
+    for i, line in enumerate(txt.splitlines(), start=1):
+        op, nbytes, _ = parse_instruction(line)
+        if op is None:
+            continue
+        kind = re.sub(r"-(start|done)$", "", op)
+        if kind not in _COLLECTIVE_KINDS:
+            continue
+        nm = _RESULT_NAME.match(line)
+        name = nm.group("name") if nm else ""
+        ch = _CHANNEL.search(line)
+        # operand names: %refs inside the call parens, minus the result
+        call_at = line.find("(", line.find("= "))
+        operands = _OPERAND.findall(line[call_at:]) if call_at >= 0 else []
+        out.append(CollectiveOp(
+            op=op, kind=kind, name=name,
+            channel_id=int(ch.group(1)) if ch else None,
+            groups=_group_signature(line), nbytes=nbytes,
+            line_no=i, snippet=line.strip()[:160],
+        ))
+        out[-1].operands = operands
+    return out
+
+
+def _finding(program, rule, severity, message, line_no=0, snippet=""):
+    return Finding(
+        rule=rule, severity=severity, message=message,
+        path=f"hlo://{program}", line=line_no, symbol=program,
+        snippet=snippet[:160], engine="collective",
+    )
+
+
+# ---------------------------------------------------------------------------
+# per-program rules
+# ---------------------------------------------------------------------------
+
+def rule_channel_unique(seq: List[CollectiveOp], program: str) -> List[Finding]:
+    seen: Dict[int, CollectiveOp] = {}
+    out = []
+    for c in seq:
+        if c.channel_id is None or c.is_done:
+            continue  # a -done legitimately echoes its start's channel
+        prev = seen.get(c.channel_id)
+        if prev is None:
+            seen[c.channel_id] = c
+        elif (prev.kind, prev.groups) != (c.kind, c.groups) or \
+                prev.name != c.name:
+            out.append(_finding(
+                program, "collective-channel-reuse", SEVERITY_ERROR,
+                f"channel_id={c.channel_id} claimed by {prev.op} "
+                f"(line {prev.line_no}) and {c.op} (line {c.line_no}) — "
+                "two distinct collectives rendezvousing on one channel "
+                "cross-match across chips",
+                line_no=c.line_no, snippet=c.snippet,
+            ))
+    return out
+
+
+def rule_start_done(seq: List[CollectiveOp], program: str) -> List[Finding]:
+    """Start/done matching + in-flight FIFO order on (kind, groups)."""
+    out = []
+    starts = {c.name: c for c in seq if c.is_start}
+    consumed: Dict[str, CollectiveOp] = {}
+    done_order: List[CollectiveOp] = []
+    for c in seq:
+        if not c.is_done:
+            continue
+        src = next((op for op in c.operands if op in starts), None)
+        if src is None:
+            out.append(_finding(
+                program, "collective-start-orphan", SEVERITY_ERROR,
+                f"{c.op} (line {c.line_no}) consumes no known "
+                f"{c.kind}-start — an unmatched done waits forever",
+                line_no=c.line_no, snippet=c.snippet,
+            ))
+            continue
+        consumed[src] = c
+        done_order.append(c)
+    for name, s in starts.items():
+        if name not in consumed:
+            out.append(_finding(
+                program, "collective-start-orphan", SEVERITY_ERROR,
+                f"{s.op} %{name} (line {s.line_no}) is never awaited by a "
+                f"{s.kind}-done — the transfer's buffer lifetime is a race",
+                line_no=s.line_no, snippet=s.snippet,
+            ))
+
+    # FIFO inversion per (kind, groups): dones must retire in start order
+    by_key: Dict[Tuple[str, str], List[str]] = {}
+    for c in seq:
+        if c.is_start and c.name in consumed:
+            by_key.setdefault((c.kind, c.groups), []).append(c.name)
+    for (kind, groups), names in by_key.items():
+        if len(names) < 2:
+            continue
+        done_pos = {
+            src: i for i, d in enumerate(done_order)
+            for src in d.operands if src in names
+        }
+        positions = [done_pos[n] for n in names if n in done_pos]
+        if positions != sorted(positions):
+            first_bad = names[
+                next(i for i in range(len(positions) - 1)
+                     if positions[i] > positions[i + 1]) + 1
+            ]
+            s = starts[first_bad]
+            out.append(_finding(
+                program, "collective-order-inversion", SEVERITY_WARNING,
+                f"in-flight {kind} ops on group {groups or '<world>'} "
+                "retire out of start order — a manually pipelined chain "
+                "with this shape deadlocks when the inversion is real",
+                line_no=s.line_no, snippet=s.snippet,
+            ))
+    return out
+
+
+def verify_collective_text(txt: str, program: str = "program") -> List[Finding]:
+    """All per-program Engine D rules over one HLO module text."""
+    seq = extract_collectives(txt)
+    out = rule_channel_unique(seq, program)
+    out.extend(rule_start_done(seq, program))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# cross-program rule
+# ---------------------------------------------------------------------------
+
+def rule_order_divergence(
+    sequences: Dict[str, List[CollectiveOp]]
+) -> List[Finding]:
+    """Programs sharing a replica-group signature must agree on the ordered
+    collective kind-sequence they issue on it (SPMD desync check)."""
+    per_group: Dict[str, Dict[str, List[CollectiveOp]]] = {}
+    for prog, seq in sequences.items():
+        for c in seq:
+            if c.is_done or not c.groups:
+                continue
+            per_group.setdefault(c.groups, {}).setdefault(prog, []).append(c)
+    out = []
+    for groups, progs in sorted(per_group.items()):
+        if len(progs) < 2:
+            continue
+        kinds = {p: [c.kind for c in seq] for p, seq in progs.items()}
+        names = sorted(kinds)
+        ref = kinds[names[0]]
+        for other in names[1:]:
+            if kinds[other] != ref:
+                c = progs[other][0]
+                out.append(_finding(
+                    other, "collective-order-divergence", SEVERITY_ERROR,
+                    f"programs {names[0]} and {other} share mesh group "
+                    f"{groups} but issue different collective orders "
+                    f"({'/'.join(ref)} vs {'/'.join(kinds[other])}) — "
+                    "run concurrently, every chip waits on a partner doing "
+                    "a different collective (SPMD desync)",
+                    line_no=c.line_no, snippet=c.snippet,
+                ))
+    return out
+
+
+def verify_program_set(programs: Dict[str, str]) -> List[Finding]:
+    """Per-program rules over each text + the cross-program divergence
+    check; ``programs`` maps program name → post-opt HLO text."""
+    out: List[Finding] = []
+    sequences = {}
+    for name, txt in programs.items():
+        sequences[name] = extract_collectives(txt)
+        out.extend(rule_channel_unique(sequences[name], name))
+        out.extend(rule_start_done(sequences[name], name))
+    out.extend(rule_order_divergence(sequences))
+    return out
+
+
+def verify_compiled_set(compiled: Dict[str, object]) -> List[Finding]:
+    """``verify_program_set`` over compiled executables (``as_text()``)."""
+    return verify_program_set({
+        name: (exe.as_text() if hasattr(exe, "as_text") else str(exe))
+        for name, exe in compiled.items()
+    })

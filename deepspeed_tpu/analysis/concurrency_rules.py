@@ -1,0 +1,690 @@
+"""Engine C: AST concurrency sanitizer — host-thread race/deadlock rules.
+
+PR 7 made host-side concurrency load-bearing: a background checkpoint-writer
+thread, SIGTERM handlers, the elastic-agent probe loop, serving drain/retry.
+None of it runs under a compiler that checks interleavings — but the
+dangerous shapes are visible in the AST. This engine builds a per-module
+model of threads (``threading.Thread`` targets and their transitive
+same-module call closure), locks (``threading.Lock/RLock/Condition``
+assignments and the ``with <lock>:`` blocks that hold them), and the
+attributes each context reads/writes, then reports:
+
+- ``shared-state-unlocked``: an attribute written from thread-target code
+  and read/written from non-thread code with no common lock held at every
+  site. Attributes bound in ``__init__`` to thread-safe primitives
+  (``Event``/``Queue``/locks) are exempt, as is ``__init__`` itself
+  (happens-before the thread starts).
+- ``lock-order-cycle``: the lock-acquisition graph (lock A held while lock
+  B is acquired, lexically or through a same-module call) has a cycle —
+  the classic ABBA deadlock, latent until the schedule lines up.
+- ``signal-unsafe-handler``: a registered signal handler calling anything
+  beyond flag-sets (``Event.set``), ``os.write``/``os._exit``/``os.kill``,
+  and ``signal.*`` introspection. CPython handlers run between bytecodes on
+  the main thread, but they still interrupt arbitrary code — allocation,
+  logging, and lock acquisition inside one can deadlock or corrupt the very
+  state being saved.
+- ``thread-leak``: a non-daemon thread constructed with no reachable
+  ``join()`` on its binding — process exit blocks on it forever.
+- ``blocking-under-lock``: ``time.sleep``/file IO/``subprocess``/
+  ``jax.device_get``/``Thread.join`` while holding a lock — every other
+  thread contending that lock stalls for the full blocking call.
+
+All rules silence with ``# dslint: disable=<rule>`` exactly like Engines
+A/B; waivers are counted, never hidden.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .findings import (
+    SEVERITY_ERROR,
+    SEVERITY_WARNING,
+    Finding,
+    SuppressionIndex,
+    apply_suppressions,
+)
+
+RULES = {
+    "shared-state-unlocked":
+        "attribute shared between a thread target and other code with no "
+        "common lock",
+    "lock-order-cycle":
+        "lock-acquisition graph has a cycle (ABBA deadlock shape)",
+    "signal-unsafe-handler":
+        "signal handler calls beyond flag-sets/os.write/reentrant-safe ops",
+    "thread-leak":
+        "non-daemon thread with no reachable join()",
+    "blocking-under-lock":
+        "blocking call (sleep/IO/device_get/join) while holding a lock",
+}
+
+_LOCK_CTORS = (
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Semaphore", "threading.BoundedSemaphore",
+    "Lock", "RLock", "Condition",
+)
+# attributes bound to these in __init__ are thread-safe by construction:
+# cross-thread use through their methods is their whole point
+_SAFE_CTORS = _LOCK_CTORS + (
+    "threading.Event", "Event",
+    "queue.Queue", "queue.SimpleQueue", "queue.LifoQueue",
+    "queue.PriorityQueue", "Queue", "SimpleQueue",
+    "threading.local",
+)
+_THREAD_CTORS = ("threading.Thread", "Thread")
+
+# method calls that mutate their receiver (a write to the attribute even
+# though the AST context is Load)
+_MUTATORS = frozenset((
+    "append", "extend", "insert", "remove", "clear", "update", "add",
+    "discard", "pop", "popleft", "appendleft", "setdefault", "put",
+    "sort", "reverse", "write",
+))
+
+# calls that block: holding a lock across one serializes every contender
+_BLOCKING_PREFIXES = (
+    "time.sleep", "sleep", "subprocess.", "requests.", "urllib.",
+    "socket.", "os.fsync", "os.replace", "os.rename", "os.remove",
+    "os.makedirs", "shutil.", "jax.device_get",
+)
+_BLOCKING_SUFFIXES = (".block_until_ready",)
+
+# the async-signal-safe allowlist: flag sets, raw fd writes, process exit,
+# signal introspection, and a few pure builtins
+_HANDLER_SAFE_SUFFIXES = (".set", ".is_set", ".clear", "._exit", ".write",
+                          ".kill")
+_HANDLER_SAFE_CHAINS = (
+    "os.write", "os._exit", "os.kill", "signal.signal", "signal.getsignal",
+    "signal.Signals", "callable", "isinstance", "getattr", "len", "int",
+    "str",
+)
+
+
+def _chain(node: ast.AST) -> str:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_lockish(chain: str) -> bool:
+    """A ``with`` subject that is plausibly a lock even without a matching
+    ``threading.Lock()`` assignment in this module (injected locks)."""
+    last = chain.split(".")[-1].lower()
+    return any(k in last for k in ("lock", "mutex"))
+
+
+@dataclass
+class _Access:
+    attr: str          # canonical "Class.attr" / module-level name
+    kind: str          # "read" | "write"
+    line: int
+    locks: frozenset   # canonical lock ids held at the site
+
+
+@dataclass
+class _Func:
+    node: ast.AST
+    name: str
+    qualname: str
+    cls: str = ""                   # enclosing class name, "" at module level
+    accesses: List[_Access] = field(default_factory=list)
+    # every lock this function acquires directly: (lock id, line)
+    acquired: List[Tuple[str, int]] = field(default_factory=list)
+    # (outer lock, inner lock, line) from lexical `with` nesting
+    nest_edges: List[Tuple[str, str, int]] = field(default_factory=list)
+    # calls made: (dotted chain, line, locks held at the call site)
+    calls: List[Tuple[str, int, frozenset]] = field(default_factory=list)
+
+
+@dataclass
+class _ThreadSite:
+    target: str                     # bare function/method name
+    target_cls: str                 # class of `self.X` targets ("" otherwise)
+    binding: str                    # "self._thread" / "t" / "" if unbound
+    daemon: bool
+    line: int
+
+
+@dataclass
+class ModuleModel:
+    """Everything the concurrency rules need to know about one module."""
+
+    path: str
+    lines: List[str]
+    funcs: Dict[str, _Func] = field(default_factory=dict)  # qualname → func
+    locks: Set[str] = field(default_factory=set)           # canonical ids
+    safe_attrs: Set[str] = field(default_factory=set)      # "Class.attr"
+    threads: List[_ThreadSite] = field(default_factory=list)
+    handlers: List[Tuple[str, str, int]] = field(default_factory=list)
+    # thread attrs ("Class.attr" / name) bound to Thread(...) — join targets
+    thread_attrs: Set[str] = field(default_factory=set)
+
+
+# ---------------------------------------------------------------------------
+# model construction
+# ---------------------------------------------------------------------------
+
+def _canon(target: ast.AST, cls: str) -> Optional[str]:
+    """Canonical id of an assignment target / with-subject: ``Class.attr``
+    for ``self.attr`` (scoped per class), bare name at module level."""
+    if isinstance(target, ast.Attribute) and isinstance(target.value, ast.Name) \
+            and target.value.id == "self":
+        return f"{cls}.{target.attr}" if cls else target.attr
+    if isinstance(target, ast.Name):
+        return target.id
+    return None
+
+
+class _ModelBuilder(ast.NodeVisitor):
+    """First pass: locks, safe attrs, thread sites, handlers, join targets."""
+
+    def __init__(self, model: ModuleModel):
+        self.m = model
+        self._cls = ""
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        prev, self._cls = self._cls, node.name
+        self.generic_visit(node)
+        self._cls = prev
+
+    def _record_assign(self, target: ast.AST, value: ast.AST, line: int):
+        name = _canon(target, self._cls)
+        if name is None or not isinstance(value, ast.Call):
+            return
+        ctor = _chain(value.func)
+        if ctor in _LOCK_CTORS:
+            self.m.locks.add(name)
+        if ctor in _SAFE_CTORS:
+            self.m.safe_attrs.add(name)
+        if ctor in _THREAD_CTORS:
+            self.m.thread_attrs.add(name)
+            self._record_thread(value, binding=name, line=line)
+
+    def visit_Assign(self, node: ast.Assign):
+        for t in node.targets:
+            self._record_assign(t, node.value, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is not None:
+            self._record_assign(node.target, node.value, node.lineno)
+        self.generic_visit(node)
+
+    def _record_thread(self, call: ast.Call, binding: str, line: int):
+        target = None
+        for kw in call.keywords:
+            if kw.arg == "target":
+                target = kw.value
+        if target is None and len(call.args) >= 2:
+            target = call.args[1]
+        if target is None:
+            return
+        tname, tcls = "", ""
+        if isinstance(target, ast.Name):
+            tname = target.id
+        elif isinstance(target, ast.Attribute) and \
+                isinstance(target.value, ast.Name) and target.value.id == "self":
+            tname, tcls = target.attr, self._cls
+        if not tname:
+            return
+        daemon = any(
+            kw.arg == "daemon" and isinstance(kw.value, ast.Constant)
+            and kw.value.value is True
+            for kw in call.keywords
+        )
+        self.m.threads.append(_ThreadSite(
+            target=tname, target_cls=tcls, binding=binding,
+            daemon=daemon, line=line,
+        ))
+
+    def visit_Call(self, node: ast.Call):
+        chain = _chain(node.func)
+        if chain in _THREAD_CTORS:
+            # unbound construction: threading.Thread(...).start()
+            parent_bound = False
+            # bound constructions were already recorded via visit_Assign
+            for t in self.m.threads:
+                if t.line == node.lineno:
+                    parent_bound = True
+            if not parent_bound:
+                self._record_thread(node, binding="", line=node.lineno)
+        elif chain == "signal.signal" and len(node.args) >= 2:
+            h = node.args[1]
+            hname, hcls = "", ""
+            if isinstance(h, ast.Name):
+                hname = h.id
+            elif isinstance(h, ast.Attribute) and \
+                    isinstance(h.value, ast.Name) and h.value.id == "self":
+                hname, hcls = h.attr, self._cls
+            if hname:
+                self.m.handlers.append((hname, hcls, node.lineno))
+        self.generic_visit(node)
+
+
+class _FuncScanner:
+    """Second pass: per-function accesses, lock acquisitions, calls."""
+
+    def __init__(self, model: ModuleModel):
+        self.m = model
+
+    def scan_module(self, tree: ast.Module):
+        self._scan_block(tree.body, prefix="", cls="")
+
+    def _scan_block(self, stmts, prefix: str, cls: str):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_function(stmt, f"{prefix}{stmt.name}", cls)
+            elif isinstance(stmt, ast.ClassDef):
+                self._scan_block(stmt.body, f"{stmt.name}.", stmt.name)
+
+    def _scan_function(self, fn, qualname: str, cls: str):
+        func = _Func(node=fn, name=fn.name, qualname=qualname, cls=cls)
+        self.m.funcs[qualname] = func
+        self._walk(fn.body, func, held=())
+        for sub in self._nested_defs(fn):
+            self._scan_function(sub, f"{qualname}.{sub.name}", cls)
+
+    def _nested_defs(self, fn):
+        out, stack = [], list(fn.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append(node)
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+        return out
+
+    def _lock_id(self, item: ast.withitem, cls: str) -> Optional[str]:
+        chain = _chain(item.context_expr)
+        if not chain:
+            return None
+        canon = _canon(item.context_expr, cls)
+        if canon in self.m.locks:
+            return canon
+        if _is_lockish(chain):
+            return canon or chain
+        return None
+
+    def _walk(self, stmts, func: _Func, held: tuple):
+        for node in stmts:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # scanned separately with a fresh context
+            if isinstance(node, ast.With):
+                inner = list(held)
+                for item in node.items:
+                    lock = self._lock_id(item, func.cls)
+                    if lock is not None:
+                        func.acquired.append((lock, node.lineno))
+                        for outer in inner:
+                            func.nest_edges.append((outer, lock, node.lineno))
+                        inner.append(lock)
+                    else:
+                        # a later item's expression runs with the earlier
+                        # items' locks already held
+                        self._visit_expr(item.context_expr, func, tuple(inner))
+                self._walk(node.body, func, tuple(inner))
+                continue
+            # this statement's own expressions (tests, targets, values)
+            for child in ast.iter_child_nodes(node):
+                if not isinstance(child, (ast.stmt, ast.excepthandler)):
+                    self._visit_expr(child, func, held)
+            # nested statement blocks keep the current lock context
+            for fname in ("body", "orelse", "finalbody"):
+                sub = getattr(node, fname, None)
+                if sub and isinstance(sub, list):
+                    self._walk(sub, func, held)
+            for h in getattr(node, "handlers", None) or []:
+                self._walk(h.body, func, held)
+
+    def _visit_expr(self, expr: ast.AST, func: _Func, held: tuple):
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Attribute):
+                self._record_attr(node, func, held)
+            elif isinstance(node, ast.Call):
+                chain = _chain(node.func)
+                if chain:
+                    func.calls.append((chain, node.lineno, frozenset(held)))
+                # receiver-mutating method call = a write to the receiver,
+                # even though its AST context is Load
+                if isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in _MUTATORS:
+                    name = _canon(node.func.value, func.cls)
+                    if name and "." in name:
+                        func.accesses.append(_Access(
+                            attr=name, kind="write", line=node.lineno,
+                            locks=frozenset(held),
+                        ))
+            elif isinstance(node, ast.Subscript) and \
+                    isinstance(node.ctx, (ast.Store, ast.Del)):
+                name = _canon(node.value, func.cls)
+                if name and "." in name:
+                    func.accesses.append(_Access(
+                        attr=name, kind="write", line=node.lineno,
+                        locks=frozenset(held),
+                    ))
+
+    def _record_attr(self, node: ast.Attribute, func: _Func, held: tuple):
+        if not (isinstance(node.value, ast.Name) and node.value.id == "self"):
+            return
+        name = f"{func.cls}.{node.attr}" if func.cls else node.attr
+        if "." not in name:
+            return
+        kind = "write" if isinstance(node.ctx, (ast.Store, ast.Del)) else "read"
+        func.accesses.append(_Access(
+            attr=name, kind=kind, line=node.lineno, locks=frozenset(held),
+        ))
+
+
+def build_model(source: str, path: str = "<string>") -> ModuleModel:
+    tree = ast.parse(source, filename=path)
+    model = ModuleModel(path=path, lines=source.splitlines())
+    _ModelBuilder(model).visit(tree)
+    _FuncScanner(model).scan_module(tree)
+    return model
+
+
+# ---------------------------------------------------------------------------
+# closures over the module call graph
+# ---------------------------------------------------------------------------
+
+def _resolve_call(model: ModuleModel, chain: str, caller: _Func) -> Optional[str]:
+    """Map a call chain to a qualname of a function in this module."""
+    if chain.startswith("self.") and caller.cls:
+        cand = f"{caller.cls}.{chain[5:]}"
+        if cand in model.funcs:
+            return cand
+        return None
+    if chain in model.funcs:
+        return chain
+    # a bare name may be a nested def in the same scope
+    cand = f"{caller.qualname}.{chain}"
+    if cand in model.funcs:
+        return cand
+    return None
+
+
+def _is_target(model: ModuleModel, f: _Func) -> bool:
+    return any(
+        f.name == t.target and (not t.target_cls or f.cls == t.target_cls)
+        for t in model.threads
+    )
+
+
+def _thread_closure(model: ModuleModel) -> Set[str]:
+    """Qualnames of functions reachable from any thread target."""
+    seeds = [qn for qn, f in model.funcs.items() if _is_target(model, f)]
+    seen: Set[str] = set()
+    stack = list(seeds)
+    while stack:
+        qn = stack.pop()
+        if qn in seen:
+            continue
+        seen.add(qn)
+        f = model.funcs[qn]
+        for chain, _, _ in f.calls:
+            callee = _resolve_call(model, chain, f)
+            if callee is not None and callee not in seen:
+                stack.append(callee)
+    return seen
+
+
+def _main_closure(model: ModuleModel) -> Set[str]:
+    """Qualnames reachable from NON-thread entry points (a function in both
+    closures — e.g. a worker body also called synchronously — counts on both
+    sides; that dual use is exactly where races live)."""
+    seeds = [
+        qn for qn, f in model.funcs.items()
+        if not _is_target(model, f) and f.name != "__init__"
+    ]
+    seen: Set[str] = set()
+    stack = seeds
+    while stack:
+        qn = stack.pop()
+        if qn in seen:
+            continue
+        seen.add(qn)
+        f = model.funcs[qn]
+        for chain, _, _ in f.calls:
+            callee = _resolve_call(model, chain, f)
+            if callee is not None and callee not in seen:
+                stack.append(callee)
+    return seen
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+def _mk(model, rule, severity, message, line, symbol) -> Finding:
+    snippet = (
+        model.lines[line - 1].strip()
+        if 0 < line <= len(model.lines) else ""
+    )
+    return Finding(
+        rule=rule, severity=severity, message=message, path=model.path,
+        line=line, symbol=symbol, snippet=snippet, engine="concurrency",
+    )
+
+
+def rule_shared_state_unlocked(model: ModuleModel) -> List[Finding]:
+    if not model.threads:
+        return []
+    thread_funcs = _thread_closure(model)
+    if not thread_funcs:
+        return []
+    main_funcs = _main_closure(model)
+    # collect per-attribute access sites on each side (skip __init__: it
+    # happens-before the thread starts; skip thread-safe primitives)
+    t_writes: Dict[str, List[Tuple[_Access, str]]] = {}
+    m_access: Dict[str, List[Tuple[_Access, str]]] = {}
+    for qn, f in model.funcs.items():
+        if f.name == "__init__":
+            continue
+        for a in f.accesses:
+            if a.attr in model.safe_attrs or a.attr in model.locks:
+                continue
+            if qn in thread_funcs and a.kind == "write":
+                t_writes.setdefault(a.attr, []).append((a, qn))
+            if qn in main_funcs:
+                m_access.setdefault(a.attr, []).append((a, qn))
+    out = []
+    for attr, writes in sorted(t_writes.items()):
+        others = m_access.get(attr, [])
+        if not others:
+            continue
+        # a common lock held at EVERY thread-side write and EVERY other
+        # access proves mutual exclusion; anything less is a race window
+        common = frozenset.intersection(
+            *[a.locks for a, _ in writes], *[a.locks for a, _ in others]
+        )
+        if common:
+            continue
+        # anchor at the first under-locked site (prefer the non-thread one:
+        # that is where the missing `with lock:` usually belongs, and where
+        # a justified waiver reads best)
+        anchor = next(
+            ((a, qn) for a, qn in others if not a.locks), None
+        ) or next(
+            ((a, qn) for a, qn in writes if not a.locks), (writes[0])
+        )
+        a, qn = anchor
+        out.append(_mk(
+            model, "shared-state-unlocked", SEVERITY_ERROR,
+            f"`{attr}` is written from thread code "
+            f"({writes[0][1]}) and accessed from {others[0][1]} with no "
+            "common lock — torn/lost updates under a real schedule",
+            a.line, qn,
+        ))
+    return out
+
+
+def rule_lock_order_cycle(model: ModuleModel) -> List[Finding]:
+    # edges from lexical nesting + one-level call closure: holding L1 while
+    # calling a same-module function that acquires L2 is an L1→L2 edge too
+    edges: Dict[Tuple[str, str], Tuple[int, str]] = {}
+    for qn, f in model.funcs.items():
+        for outer, inner, line in f.nest_edges:
+            edges.setdefault((outer, inner), (line, qn))
+        for chain, line, held in f.calls:
+            if not held:
+                continue
+            callee = _resolve_call(model, chain, f)
+            if callee is None:
+                continue
+            for lock, _ in model.funcs[callee].acquired:
+                for outer in held:
+                    if outer != lock:
+                        edges.setdefault((outer, lock), (line, qn))
+    graph: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+    # DFS cycle detection, reporting each cycle once (canonical rotation)
+    out, reported = [], set()
+
+    def dfs(node, stack, on_stack):
+        for nxt in sorted(graph.get(node, ())):
+            if nxt in on_stack:
+                cyc = stack[stack.index(nxt):] + [nxt]
+                key = frozenset(cyc)
+                if key in reported:
+                    continue
+                reported.add(key)
+                line, qn = edges[(node, nxt)]
+                out.append(_mk(
+                    model, "lock-order-cycle", SEVERITY_ERROR,
+                    "lock-acquisition cycle "
+                    + " -> ".join(cyc)
+                    + " — two threads taking these in opposite order "
+                    "deadlock",
+                    line, qn,
+                ))
+            elif nxt not in visited:
+                visited.add(nxt)
+                dfs(nxt, stack + [nxt], on_stack | {nxt})
+
+    visited: Set[str] = set()
+    for start in sorted(graph):
+        if start not in visited:
+            visited.add(start)
+            dfs(start, [start], {start})
+    return out
+
+
+def rule_signal_unsafe_handler(model: ModuleModel) -> List[Finding]:
+    out = []
+    for hname, hcls, _ in model.handlers:
+        for qn, f in model.funcs.items():
+            # exact class match: a module-level handler name must not drag
+            # in an unrelated same-named method (hcls is "" for both
+            # module-level and nested-in-function handlers)
+            if f.name != hname or f.cls != hcls:
+                continue
+            for chain, line, _ in f.calls:
+                if chain in _HANDLER_SAFE_CHAINS:
+                    continue
+                if any(chain.endswith(s) for s in _HANDLER_SAFE_SUFFIXES):
+                    continue
+                if chain.startswith("signal."):
+                    continue
+                out.append(_mk(
+                    model, "signal-unsafe-handler", SEVERITY_ERROR,
+                    f"signal handler calls {chain}() — only flag-sets, "
+                    "os.write/_exit/kill and signal.* are reentrant-safe "
+                    "inside a handler",
+                    line, qn,
+                ))
+    return out
+
+
+def rule_thread_leak(model: ModuleModel) -> List[Finding]:
+    out = []
+    for t in model.threads:
+        if t.daemon:
+            continue
+        joined = False
+        if t.binding:
+            needle = t.binding.split(".")[-1]
+            for f in model.funcs.values():
+                for chain, _, _ in f.calls:
+                    parts = chain.split(".")
+                    if parts[-1] == "join" and len(parts) >= 2 and \
+                            parts[-2] == needle:
+                        joined = True
+        if not joined:
+            out.append(_mk(
+                model, "thread-leak", SEVERITY_WARNING,
+                f"non-daemon thread (target={t.target}) has no reachable "
+                "join() — process exit blocks on it forever",
+                t.line, t.binding or t.target,
+            ))
+    return out
+
+
+def rule_blocking_under_lock(model: ModuleModel) -> List[Finding]:
+    out = []
+    for qn, f in model.funcs.items():
+        for chain, line, held in f.calls:
+            if not held:
+                continue
+            blocking = (
+                chain == "open"
+                or any(chain == p or chain.startswith(p)
+                       for p in _BLOCKING_PREFIXES)
+                or any(chain.endswith(s) for s in _BLOCKING_SUFFIXES)
+            )
+            if not blocking:
+                # Thread.join on a known thread attr while holding a lock:
+                # if that thread needs the same lock to finish, deadlock
+                parts = chain.split(".")
+                if parts[-1] == "join" and len(parts) >= 2:
+                    base = ".".join(parts[:-1])
+                    canon = base.replace("self.", f"{f.cls}.") if f.cls else base
+                    blocking = canon in model.thread_attrs
+            if blocking:
+                out.append(_mk(
+                    model, "blocking-under-lock", SEVERITY_WARNING,
+                    f"{chain}() while holding {sorted(held)[0]} — every "
+                    "contender stalls for the full blocking call",
+                    line, qn,
+                ))
+    return out
+
+
+ALL_RULES = (
+    rule_shared_state_unlocked,
+    rule_lock_order_cycle,
+    rule_signal_unsafe_handler,
+    rule_thread_leak,
+    rule_blocking_under_lock,
+)
+
+
+def check_source(source: str, path: str = "<string>"):
+    """Engine C over one source string → (findings, suppressed_count).
+    Raises SyntaxError upward like ``ast_rules.lint_source``."""
+    model = build_model(source, path=path)
+    findings: List[Finding] = []
+    for rule in ALL_RULES:
+        findings.extend(rule(model))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    seen, unique = set(), []
+    for f in findings:
+        key = (f.rule, f.path, f.line, f.message)
+        if key not in seen:
+            seen.add(key)
+            unique.append(f)
+    return apply_suppressions(unique, SuppressionIndex.from_source(source))
+
+
+def check_file(path: str):
+    with open(path, encoding="utf-8") as fh:
+        return check_source(fh.read(), path=path)

@@ -151,7 +151,10 @@ class DeviceMonitor:
 
     @property
     def healthy(self) -> bool:
-        return self._healthy
+        # deliberately lock-free: a single GIL-atomic bool read on the hot
+        # polling path; the probe thread's writes are serialized under
+        # _probe_lock and a stale read here only delays the trip by one poll
+        return self._healthy  # dslint: disable=shared-state-unlocked
 
     def probe_once(self) -> bool:
         with self._probe_lock:

@@ -100,12 +100,31 @@ def main() -> int:
     print("-" * 60)
     print("Static analysis (dslint):")
     try:
-        from deepspeed_tpu.analysis import AST_RULES, HLO_RULES, Baseline
+        from deepspeed_tpu.analysis import (
+            AST_RULES,
+            COLLECTIVE_RULES,
+            CONCURRENCY_RULES,
+            HLO_RULES,
+            Baseline,
+        )
+        from deepspeed_tpu.analysis import runtime_sanitizer as _dsan
         from deepspeed_tpu.tools.dslint import _find_baseline
 
         print(
-            f"engines ............. {GREEN_OK} AST ({len(AST_RULES)} rules) "
-            f"+ HLO ({len(HLO_RULES)} rules)"
+            f"engines ............. {GREEN_OK} "
+            f"A:HLO ({len(HLO_RULES)}) + B:AST ({len(AST_RULES)}) + "
+            f"C:concurrency ({len(CONCURRENCY_RULES)}) + "
+            f"D:collective ({len(COLLECTIVE_RULES)}) rules"
+        )
+        san = _dsan.active()
+        print(
+            "runtime sanitizer ... "
+            + (
+                f"{GREEN_OK} ACTIVE ({san.events} events recorded)"
+                if san is not None
+                else f"{GREEN_OK} available (off — enable via "
+                "analysis.sanitizer or dsan-marked tests)"
+            )
         )
         bl_path = _find_baseline(["deepspeed_tpu"])
         if bl_path:

@@ -68,7 +68,11 @@ class AsyncCheckpointWriter:
         self._idle = threading.Event()
         self._idle.set()
         self._thread: Optional[threading.Thread] = None
-        self._lock = threading.Lock()
+        # via the dsan shim: sanitizer-enabled runs observe this lock's
+        # schedule against the StepTracer's (ISSUE 8)
+        from ..analysis.runtime_sanitizer import maybe_lock
+
+        self._lock = maybe_lock("AsyncCheckpointWriter._lock")
         self.saves_started = 0  # the checkpoint_crash injection index
         self.saves_committed = 0
         self.errors: list = []  # (tag, exception), newest last
@@ -140,7 +144,8 @@ class AsyncCheckpointWriter:
 
     @property
     def last_error(self) -> Optional[BaseException]:
-        return self.errors[-1][1] if self.errors else None
+        with self._lock:
+            return self.errors[-1][1] if self.errors else None
 
     def close(self, timeout: Optional[float] = None) -> bool:
         ok = self.wait(timeout)
@@ -196,13 +201,19 @@ class AsyncCheckpointWriter:
                 crash_before_manifest=crash,
             )
         except BaseException as e:
-            self.errors.append((tag, e))
-            del self.errors[:-16]
+            # _write runs on the worker thread AND (blocking=True) on the
+            # caller's — the error ledger and commit counter are read from
+            # either side, so both mutate under the writer lock (dsan
+            # shared-state-unlocked)
+            with self._lock:
+                self.errors.append((tag, e))
+                del self.errors[:-16]
             if self._c_failures is not None:
                 self._c_failures.inc()
             raise
         dt = time.perf_counter() - t0
-        self.saves_committed += 1
+        with self._lock:
+            self.saves_committed += 1
         if self._h_write is not None:
             self._h_write.observe(dt)
             self._c_writes.inc()

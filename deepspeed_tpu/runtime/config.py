@@ -467,6 +467,32 @@ class TelemetryConfig(DSConfigModel):
 
 
 @dataclass
+class SanitizerConfig(DSConfigModel):
+    """analysis.sanitizer section (ISSUE 8): the runtime concurrency
+    sanitizer (``analysis/runtime_sanitizer.py``) — the dynamic half of
+    Engine C. When enabled, concurrency-bearing modules (the StepTracer,
+    the async checkpoint writer) build their locks through an instrumented
+    shim that records REAL lock-acquisition orders and cross-thread
+    attribute accesses, and ``RuntimeSanitizer.findings()`` converts
+    observed violations (lock-order cycles, unlocked shared writes) into
+    the same Finding stream dslint gates on. ``max_events`` bounds the
+    access-record table (further accesses are counted as dropped, never
+    unbounded memory). Off by default: production runs pay one None check
+    per instrumentation point; ``dsan``-marked tier-1 tests turn it on to
+    cross-check Engine C's static graph against observed schedules."""
+
+    enabled: bool = False
+    max_events: int = 65536
+
+    def __post_init__(self):
+        if self.max_events < 1:
+            raise DeepSpeedConfigError(
+                f"analysis.sanitizer.max_events must be >= 1, got "
+                f"{self.max_events}"
+            )
+
+
+@dataclass
 class AnalysisConfig(DSConfigModel):
     """analysis section (ISSUE 6 tentpole): dslint, the graph & sharding
     static-analysis plane (``deepspeed_tpu/analysis/``). Engine A verifies
@@ -495,6 +521,8 @@ class AnalysisConfig(DSConfigModel):
     upcast_allow: str = "softmax|loss|norm|logit|cumsum"
     hot_function_patterns: List[str] = field(default_factory=list)  # [] = built-in defaults
     donate_name_patterns: List[str] = field(default_factory=list)   # [] = built-in defaults
+    # ISSUE 8: the runtime concurrency sanitizer (dynamic Engine C cross-check)
+    sanitizer: SanitizerConfig = field(default_factory=SanitizerConfig)
 
     def __post_init__(self):
         if not 0.0 <= self.min_alias_fraction <= 1.0:

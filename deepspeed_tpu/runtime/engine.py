@@ -275,6 +275,13 @@ class DeepSpeedEngine:
         self.wall_clock_breakdown = config.wall_clock_breakdown
         self.global_steps = 0  # host-side count of train_batch calls
         self.monitor = None  # wired by deepspeed_tpu.initialize when configured
+        # runtime concurrency sanitizer (ISSUE 8): installed BEFORE the
+        # telemetry plane so the StepTracer's lock is built through the
+        # instrumented shim; None when disabled — every instrumentation
+        # point pays a single module-level None check
+        from ..analysis import runtime_sanitizer as _dsan
+
+        self.sanitizer = _dsan.from_config(config.analysis.sanitizer)
         # unified telemetry plane (registry + step tracer + exporters);
         # None when disabled — train_batch pays one None check, no callbacks
         from .. import telemetry as _telemetry
@@ -1998,6 +2005,13 @@ class DeepSpeedEngine:
         findings.extend(dsa.check_program_budget(
             max(1, self._jit_step_programs()), acfg.max_train_programs, ctx
         ))
+        # Engine D (ISSUE 8): collective-consistency pass over the same
+        # compiled text — channel uniqueness, start/done pairing/FIFO; the
+        # cross-program divergence check is vacuous for the single-step
+        # program set but runs through the same entry point so a future
+        # multi-program engine (pipelined collectives, ROADMAP item 4)
+        # inherits it for free
+        findings.extend(dsa.verify_program_set({"train_step": txt}))
         return findings
 
     def _introspection_analysis(self):

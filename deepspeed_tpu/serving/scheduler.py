@@ -95,7 +95,18 @@ class ServingEngine:
 
     Construct via ``InferenceEngine.serve()`` (or directly); drive with
     :meth:`submit` + :meth:`step`, or :meth:`run` to drain. ``clock`` is
-    injectable for deterministic timeout tests."""
+    injectable for deterministic timeout tests.
+
+    Concurrency contract (ISSUE 8 dsan audit): this engine is
+    **single-threaded by design** — ``submit``/``step``/``drain``/``stats``
+    all mutate ``queue``/``slots``/``completed`` and the stats counters
+    with no lock, and must run on the one scheduler thread. ``drain`` is
+    the cooperative shutdown path: the PreemptionGuard's SIGTERM handler
+    only sets a flag, and the scheduler thread calls ``drain`` at the next
+    step boundary (never from the signal frame). A future multi-threaded
+    front end must put a lock around ``submit`` and the ``completed``
+    ledger before relaxing this — Engine C will flag the first thread this
+    module grows that touches them."""
 
     def __init__(self, engine, config=None, clock=time.monotonic, fault_injector=None):
         from ..runtime.config import ServingConfig
@@ -699,10 +710,12 @@ class ServingEngine:
         findings = dsa.check_program_budget(
             len(self.executables), 2, ctx, exact=True
         )
+        texts = {}
         for name, exe in (
             ("serving_prefill", self._prefill_exec),
             ("serving_decode", self._decode_exec),
         ):
+            texts[name] = exe.as_text()
             pctx = dsa.RuleContext(
                 program=name,
                 # both pools share one shape: demand two aliased params
@@ -711,7 +724,13 @@ class ServingEngine:
                 upcast_allow=acfg.upcast_allow,
                 allgather_min_bytes=acfg.allgather_min_bytes,
             )
-            findings.extend(dsa.verify_compiled(exe, pctx))
+            findings.extend(dsa.verify_hlo_text(texts[name], pctx))
+        # Engine D (ISSUE 8): both executables run on one engine — channel
+        # uniqueness + start/done pairing per program, and (under a future
+        # TP-sharded serving mesh, ROADMAP item 3) the prefill/decode pair
+        # must agree on per-group collective order or concurrent slots
+        # desync
+        findings.extend(dsa.verify_program_set(texts))
         return findings
 
     def stats(self) -> dict:

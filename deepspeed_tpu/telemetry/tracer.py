@@ -91,6 +91,14 @@ class StepTracer:
         self._buffer: List[str] = []
         self._force_next = False
         self._closed = False
+        # emit() is called from the train step, the watchdog trip path AND
+        # the async checkpoint writer's background thread (record_event on
+        # commit/failure) — buffer appends, the size-capped rotation and
+        # close() must serialize or a roll can tear/drop records mid-append.
+        # Built through the dsan shim so sanitizer-enabled runs observe the
+        # real acquisition schedule (ISSUE 8).
+        self._lock = self._new_lock()
+        self._dsan = self._dsan_module()
         if process_index is None:
             try:
                 import jax
@@ -115,6 +123,31 @@ class StepTracer:
         self._dir_made = False  # lazily: a tracer that never emits writes nothing
         atexit.register(self.close)
 
+    @staticmethod
+    def _dsan_module():
+        """The runtime sanitizer, when importable (deferred: the analysis
+        package reads telemetry.introspect, so a module-level import here
+        would be circular)."""
+        try:
+            from ..analysis import runtime_sanitizer
+
+            return runtime_sanitizer
+        except Exception:
+            return None
+
+    @classmethod
+    def _new_lock(cls):
+        dsan = cls._dsan_module()
+        if dsan is not None:
+            return dsan.maybe_lock("StepTracer._lock")
+        import threading
+
+        return threading.Lock()
+
+    def _note_buffer_write(self) -> None:
+        if self._dsan is not None:
+            self._dsan.note_write(self, "_buffer")
+
     # -- sampling ------------------------------------------------------
     def should_sample(self, step: int) -> bool:
         if self._force_next:
@@ -135,16 +168,23 @@ class StepTracer:
         record.setdefault("ts", time.time())
         record.setdefault("host", self.process_index)
         clean = {k: _jsonable(v) for k, v in record.items()}
-        self._buffer.append(json.dumps(clean, default=str))
-        if len(self._buffer) >= self.flush_interval:
-            self.flush()
+        line = json.dumps(clean, default=str)
+        with self._lock:
+            self._note_buffer_write()
+            self._buffer.append(line)
+            if len(self._buffer) >= self.flush_interval:
+                self._flush_locked()
 
     def emit_aggregate(self, record: Dict[str, Any]) -> None:
         """Rank-0-only aggregated record (caller runs aggregate_scalars)."""
         clean = {k: _jsonable(v) for k, v in record.items()}
-        self._ensure_dir()
-        with open(self._agg_file, "a") as fh:
-            fh.write(json.dumps(clean, default=str) + "\n")
+        with self._lock:
+            self._ensure_dir()
+            # the append IS the serialized section: aggregate records are
+            # rare (rank-0, once per sampled step) and the file must not
+            # interleave with a concurrent rotation of the live trace
+            with open(self._agg_file, "a") as fh:  # dslint: disable=blocking-under-lock
+                fh.write(json.dumps(clean, default=str) + "\n")
 
     def _ensure_dir(self) -> None:
         if not self._dir_made:
@@ -152,10 +192,16 @@ class StepTracer:
             self._dir_made = True
 
     def flush(self) -> None:
+        with self._lock:
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        """Buffer → file append (+ size-capped roll); caller holds _lock."""
         if not self._buffer:
             return
-        self._ensure_dir()
+        self._note_buffer_write()
         data = "\n".join(self._buffer) + "\n"
+        self._ensure_dir()
         if self.max_bytes:
             if self._bytes_written is None:  # resumed run: adopt on-disk size
                 try:
@@ -176,10 +222,11 @@ class StepTracer:
         self._buffer = []
 
     def close(self) -> None:
-        if self._closed:
-            return
-        self.flush()
-        self._closed = True
+        with self._lock:
+            if self._closed:
+                return
+            self._flush_locked()
+            self._closed = True
         atexit.unregister(self.close)  # don't pin closed tracers for life
 
     @property

@@ -1,22 +1,28 @@
-"""``dslint`` — static-analysis CLI + CI regression gate (ISSUE 6).
+"""``dslint`` — static-analysis CLI + CI regression gate (ISSUE 6, 8).
 
     python -m deepspeed_tpu.tools.dslint deepspeed_tpu/            # full lint
     python -m deepspeed_tpu.tools.dslint --changed                 # CI gate
     python -m deepspeed_tpu.tools.dslint pkg/ --update-baseline    # re-record
+    python -m deepspeed_tpu.tools.dslint pkg/ --engines b,c        # subset
 
-Runs Engine B (AST rules) over the given files/directories and gates the
-result on the committed baseline (``.dslint-baseline.json``): findings
-already in the baseline are reported but do not fail; NEW findings exit 1.
+Runs the source engines — B (AST JAX-footgun rules) and C (AST concurrency
+sanitizer, ISSUE 8) — over ``*.py`` under the given paths, and the program
+engines — A (HLO declarations) and D (collective consistency) — over any
+``*.hlo`` post-optimization text dumps, then gates the result on the
+committed baseline (``.dslint-baseline.json``): findings already in the
+baseline are reported but do not fail; NEW findings exit 1.
 ``--update-baseline`` rewrites the ledger from the current findings —
 entries whose finding disappeared expire, so the debt only shrinks.
+``--engines a,b,c,d`` selects engines (default: all four).
 
 ``--changed`` lints just the files git reports as modified/staged/untracked
 — the cheap per-PR gate; the committed baseline makes the full run
-equivalent, so either works in CI.
+equivalent, so either works in CI. New engines ride the same fingerprints:
+old Engine B findings keep their baseline entries untouched.
 
-Engine A (HLO program rules) needs compiled executables, so it runs where
-the programs live: ``DeepSpeedEngine.verify_program()``,
-``ServingEngine.verify()``, the ``lint``-marked tier-1 tests, and bench.py.
+Engines A/D also run where live compiled programs exist:
+``DeepSpeedEngine.verify_program()``, ``ServingEngine.verify()``, the
+``lint``/``dsan``-marked tier-1 tests, and bench.py.
 
 Exit codes: 0 clean (or baseline-known only), 1 new findings, 2 usage /
 unparseable file / corrupt baseline.
@@ -33,7 +39,10 @@ from collections import Counter
 from typing import List, Optional
 
 from ..analysis import (
+    ALL_ENGINES,
     DEFAULT_BASELINE_NAME,
+    ENGINE_RULES,
+    HLO_SUFFIXES,
     Baseline,
     all_rules,
     lint_paths,
@@ -64,7 +73,8 @@ def _git_changed_files() -> List[str]:
         )
         out.update(l.strip() for l in res.stdout.splitlines() if l.strip())
     return sorted(
-        path for f in out if f.endswith(".py")
+        path for f in out
+        if f.endswith(".py") or f.endswith(HLO_SUFFIXES)
         for path in [os.path.join(top, f)] if os.path.exists(path)
     )
 
@@ -92,11 +102,13 @@ def collect(
     baseline_path: Optional[str] = None,
     hot_patterns=None,
     donate_patterns=None,
+    engines=None,
 ) -> dict:
-    """Run the source lint + baseline split; the dict the CLI/bench/env
-    report all consume. Raises SyntaxError / ValueError upward."""
+    """Run the selected engines + baseline split; the dict the CLI/bench/
+    env report all consume. Raises SyntaxError / ValueError upward."""
     findings, suppressed, files = lint_paths(
-        paths, hot_patterns=hot_patterns, donate_patterns=donate_patterns
+        paths, hot_patterns=hot_patterns, donate_patterns=donate_patterns,
+        engines=engines,
     )
     # fingerprints embed the path: normalize relative to the baseline's
     # directory so absolute-path callers (bench.py) and repo-root CLI runs
@@ -151,6 +163,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("paths", nargs="*", help="files or directories to lint")
     p.add_argument("--changed", action="store_true",
                    help="lint the files git reports as changed instead of PATHS")
+    p.add_argument("--engines", default=",".join(sorted(ALL_ENGINES)),
+                   help="comma-separated engine letters to run: a (HLO "
+                   "declarations over *.hlo dumps), b (AST JAX footguns), "
+                   "c (AST concurrency sanitizer), d (HLO collective "
+                   "consistency). Default: all")
     p.add_argument("--baseline", default=None,
                    help=f"baseline file (default: nearest {DEFAULT_BASELINE_NAME})")
     p.add_argument("--config", default=None,
@@ -167,9 +184,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="print the rule catalog and exit")
     args = p.parse_args(argv)
 
+    engines = frozenset(
+        e.strip().lower() for e in args.engines.split(",") if e.strip()
+    )
+    bad = engines - ALL_ENGINES
+    if bad or not engines:
+        print(
+            f"dslint: unknown --engines {sorted(bad)} "
+            f"(know {sorted(ALL_ENGINES)})",
+            file=sys.stderr,
+        )
+        return EXIT_USAGE
+
     if args.list_rules:
-        for rule, desc in sorted(all_rules().items()):
-            print(f"{rule:<26} {desc}")
+        for letter in sorted(engines):
+            for rule, desc in sorted(ENGINE_RULES[letter].items()):
+                print(f"{letter}  {rule:<28} {desc}")
         return EXIT_CLEAN
 
     paths = list(args.paths)
@@ -220,7 +250,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     try:
         report = collect(paths, baseline_path=baseline_path,
                          hot_patterns=hot_patterns,
-                         donate_patterns=donate_patterns)
+                         donate_patterns=donate_patterns,
+                         engines=engines)
     except SyntaxError as e:
         print(f"dslint: cannot parse {e.filename}:{e.lineno}: {e.msg}",
               file=sys.stderr)
@@ -234,6 +265,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     scanned = report.pop("_scanned")
 
     if args.update_baseline:
+        if engines != ALL_ENGINES:
+            # a subset run sees a subset of findings; recording it would
+            # expire every other engine's entries for the scanned files
+            print(
+                "dslint: --update-baseline requires the full engine set "
+                "(drop --engines)",
+                file=sys.stderr,
+            )
+            return EXIT_USAGE
         baseline.path = baseline.path or args.baseline or DEFAULT_BASELINE_NAME
         baseline.update(findings, scanned_paths=scanned)
         baseline.save()

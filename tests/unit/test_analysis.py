@@ -723,6 +723,27 @@ class TestProgramGate:
             assert len(pools) == 2
             assert all(p in aliased for p in pools)
 
+    def test_gpt2_train_step_collectives_consistent(self, train_engine):
+        """Engine D over the real dp8 train step (ISSUE 8 acceptance):
+        channel ids unique, starts/dones matched — and the check is not
+        vacuous: the program really contains collectives."""
+        from deepspeed_tpu.analysis import collective_rules as D
+
+        txt = train_engine._compiled_step().as_text()
+        assert D.verify_program_set({"train_step": txt}) == []
+        assert len(D.extract_collectives(txt)) > 0
+
+    def test_serving_programs_collectives_consistent(self, serving_engine):
+        """Engine D over both serving executables (ISSUE 8 acceptance):
+        the full program-set pass — per-program rules + the cross-program
+        order-divergence check — reports []."""
+        from deepspeed_tpu.analysis import collective_rules as D
+
+        assert D.verify_compiled_set({
+            "serving_prefill": serving_engine._prefill_exec,
+            "serving_decode": serving_engine._decode_exec,
+        }) == []
+
     def test_serving_budget_violation_fires(self, serving_engine):
         from deepspeed_tpu.analysis import check_program_budget
 
