@@ -637,6 +637,141 @@ def run_dsan_bench():
     return pr8
 
 
+def run_dsmem_bench():
+    """BENCH_pr9.json (ISSUE 9): the memory-verification plane as a
+    diffable artifact — per-program static peak HBM (Engine E's liveness
+    walk) vs XLA's own ``memory_analysis()`` accounting, the categorized
+    live-at-peak bytes, headroom against the committed
+    ``.dsmem-budgets.json`` ledger, and the re-measured runtime-sanitizer
+    overhead on the instrumented StepTracer emit micro-path after the
+    ISSUE 9 no-op-passthrough fix (three modes: uninstrumented /
+    shim-disabled / shim-enabled — disabled must be free)."""
+    import tempfile
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.analysis import memory_rules as dsmem
+    from deepspeed_tpu.analysis import runtime_sanitizer as _dsan
+    from deepspeed_tpu.models import gpt2
+    from deepspeed_tpu.parallel.topology import MeshSpec
+    from deepspeed_tpu.runtime.config import AnalysisConfig, DeepSpeedConfig
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+    from deepspeed_tpu.telemetry.tracer import StepTracer
+
+    mcfg = AnalysisConfig().memory
+    programs = {}
+
+    def record(name, analysis, compiled, findings):
+        budget = dsmem.resolve_budget(mcfg, name)
+        xla = dsmem.xla_peak_bytes(compiled)
+        est = analysis.peak_bytes
+        programs[name] = {
+            "peak_bytes_est": est,
+            "xla_peak_bytes": xla,
+            "delta_vs_xla_pct": (
+                round(100.0 * (est - xla) / xla, 2) if xla else None
+            ),
+            "by_category": {
+                k: v for k, v in analysis.by_category.items() if v
+            },
+            "kv_pool_bytes": analysis.by_category.get("kv-pool", 0),
+            "budget_bytes": budget,
+            "headroom_pct": dsmem.headroom_pct(budget, est),
+            "findings": len(findings),
+        }
+
+    # -- the real train step ------------------------------------------
+    cfg = gpt2.get_config("gpt2-tiny", attn_impl="jnp")
+    n_dev = len(jax.devices())
+    mesh = MeshSpec(dp=n_dev).build_mesh()
+    ds = DeepSpeedConfig.load({
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 0},
+        "steps_per_print": 10**9,
+    }, dp_world_size=n_dev)
+    engine = DeepSpeedEngine(gpt2.make_module(cfg), ds, mesh=mesh, seed=0)
+    rs = np.random.RandomState(0)
+    batch = {"input_ids": rs.randint(
+        0, cfg.vocab_size, size=(engine.train_batch_size, 16)
+    ).astype(np.int32)}
+    engine.train_batch(batch)
+    train_findings = engine.verify_program()
+    record("train_step", engine._memory_analysis, engine._compiled_step(),
+           [f for f in train_findings if f.engine == "mem"])
+
+    # -- both serving executables -------------------------------------
+    from deepspeed_tpu.inference.engine import InferenceEngine
+
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+    ieng = InferenceEngine(gpt2.make_module(cfg), params=params,
+                           dtype=jnp.float32)
+    serving = ieng.serve({
+        "max_slots": 4, "page_size": 4, "num_pages": 64,
+        "max_prompt_len": 12, "max_new_tokens": 8,
+        "kv_cache_dtype": "float32",
+    })
+    sfindings = serving.verify()
+    for name, exe in (("serving_prefill", serving._prefill_exec),
+                      ("serving_decode", serving._decode_exec)):
+        record(name, serving._memory_analyses[name], exe,
+               [f for f in sfindings
+                if f.engine == "mem" and f.symbol == name])
+
+    # -- sanitizer overhead re-measure (ISSUE 9 satellite) -------------
+    def _emit_loop(n=400):
+        with tempfile.TemporaryDirectory() as td:
+            t = StepTracer(os.path.join(td, "t.jsonl"),
+                           flush_interval=20, process_index=0)
+            t0 = _time.perf_counter()
+            for i in range(n):
+                t.emit({"kind": "train_step", "step": i, "loss": 1.0})
+            t.close()
+            return _time.perf_counter() - t0
+
+    # uninstrumented reference: the tracer never sees the dsan module
+    orig_mod = StepTracer.__dict__["_dsan_module"]  # the staticmethod object
+    StepTracer._dsan_module = staticmethod(lambda: None)
+    try:
+        raw_s = min(_emit_loop() for _ in range(3))
+    finally:
+        StepTracer._dsan_module = orig_mod
+    disabled_s = min(_emit_loop() for _ in range(3))  # shim present, off
+    _dsan.enable(_dsan.RuntimeSanitizer())
+    try:
+        enabled_s = min(_emit_loop() for _ in range(3))
+    finally:
+        _dsan.disable()
+
+    budget_file = dsmem.find_budget_file()
+    pr9 = {
+        "schema": "bench_pr9_dsmem_v1",
+        "backend": jax.default_backend(),
+        "n_devices": n_dev,
+        "programs": programs,
+        "budget_file": budget_file,
+        "dsmem_new_findings": sum(p["findings"] for p in programs.values()),
+        "sanitizer_emit_uninstrumented_us": round(raw_s / 400 * 1e6, 2),
+        "sanitizer_emit_disabled_us": round(disabled_s / 400 * 1e6, 2),
+        "sanitizer_emit_enabled_us": round(enabled_s / 400 * 1e6, 2),
+        # the fixed number: the instrumented path with the sanitizer OFF
+        # must cost the same as no instrumentation at all
+        "sanitizer_overhead_disabled_pct": round(
+            100.0 * (disabled_s - raw_s) / raw_s, 2
+        ),
+        "sanitizer_overhead_enabled_pct": round(
+            100.0 * (enabled_s - disabled_s) / disabled_s, 2
+        ),
+    }
+    with open(os.path.join(_BENCH_DIR, "BENCH_pr9.json"), "w") as fh:
+        json.dump(pr9, fh, indent=1)
+        fh.write("\n")
+    return pr9
+
+
 def run_dslint_bench():
     """BENCH_pr6.json (ISSUE 6): the dslint static-analysis finding count as
     a diffable run-over-run benchmark artifact — lint debt growing between
@@ -1143,6 +1278,18 @@ def main():
         result["sanitizer_overhead_pct"] = pr8["sanitizer_overhead_pct"]
     except Exception as e:
         result["pr8_error"] = f"{type(e).__name__}: {e}"
+    # --- BENCH_pr9.json (ISSUE 9): memory-verification plane — per-program
+    # static peak vs memory_analysis(), budget headroom, sanitizer overhead
+    # re-measure. BENCH_DSMEM=0 opts out (it compiles a second tiny engine).
+    if os.environ.get("BENCH_DSMEM", "1") == "1":
+        try:
+            pr9 = run_dsmem_bench()
+            result["pr9_artifact"] = "BENCH_pr9.json"
+            result["dsmem_new_findings"] = pr9["dsmem_new_findings"]
+            result["sanitizer_overhead_disabled_pct"] = \
+                pr9["sanitizer_overhead_disabled_pct"]
+        except Exception as e:
+            result["pr9_error"] = f"{type(e).__name__}: {e}"
     # --- BENCH_pr7.json (ISSUE 7): fault-tolerance plane — async-save
     # overhead per step + corrupt-tag recovery time. BENCH_RESILIENCE=0
     # opts out (it compiles a second tiny engine on CPU runs).
@@ -1163,11 +1310,21 @@ if __name__ == "__main__":
     # probe/training) — prints the BENCH_pr3.json content as the one JSON line.
     # BENCH_RESILIENCE_ONLY=1: just the fault-tolerance bench (BENCH_pr7.json).
     # BENCH_DSAN_ONLY=1: just the sanitizer-plane bench (BENCH_pr8.json).
+    # BENCH_DSMEM_ONLY=1: just the memory-plane bench (BENCH_pr9.json) —
+    # pins the CPU host to 8 devices so the measured peaks line up with the
+    # committed tier-1 budgets.
     if os.environ.get("BENCH_SERVING_ONLY", "0") == "1":
         print(json.dumps(run_serving_bench()))
     elif os.environ.get("BENCH_RESILIENCE_ONLY", "0") == "1":
         print(json.dumps(run_resilience_bench()))
     elif os.environ.get("BENCH_DSAN_ONLY", "0") == "1":
         print(json.dumps(run_dsan_bench()))
+    elif os.environ.get("BENCH_DSMEM_ONLY", "0") == "1":
+        _flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in _flags:
+            os.environ["XLA_FLAGS"] = (
+                _flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        print(json.dumps(run_dsmem_bench()))
     else:
         main()

@@ -19,12 +19,24 @@ Four engines over one findings/severity/suppression model:
   verifier — channel-id uniqueness, async start/done pairing and FIFO
   order, and cross-program collective-order agreement on shared mesh
   groups (the SPMD desync/deadlock shape).
+- **Engine E** (``memory_rules``, ISSUE 9): the static HBM liveness
+  verifier — a def-use live-range walk over the scheduled post-opt HLO
+  computes peak resident bytes and a categorized live-at-peak ledger,
+  gated against committed per-program byte budgets
+  (``.dsmem-budgets.json``): over-budget peaks, missed donations,
+  oversized collective scratch, layout padding waste.
+- **Engine F** (``sharding_rules``, ISSUE 9): the pre-compile sharding-spec
+  verifier — ``match_partition_rules``-style regex tables checked against
+  real ``jax.eval_shape`` param trees and the mesh: dead rules, rank/axis
+  mismatches, silently replicated large leaves.
 
 Front ends: the ``python -m deepspeed_tpu.tools.dslint`` CLI (with the
-committed-baseline CI gate and ``--engines a,b,c,d`` selection), the
-``lint``/``dsan``-marked tier-1 tests, and ``bench.py``'s finding counters.
-See ``docs/ANALYSIS.md`` for the rule catalog and the suppression /
-baseline workflow.
+committed-baseline CI gate and ``--engines a,b,c,d,e,f`` selection), the
+``lint``/``dsan``/``dsmem``-marked tier-1 tests, and ``bench.py``'s
+finding counters. Engine F has no file form — it runs where live param
+trees exist (``engine.verify_program()``, the dsmem tests). See
+``docs/ANALYSIS.md`` for the rule catalog and the suppression / baseline
+workflow.
 """
 
 from .ast_rules import (  # noqa: F401
@@ -63,6 +75,26 @@ from .hlo_rules import (  # noqa: F401
     verify_hlo_text,
 )
 from .hlo_rules import RULES as HLO_RULES  # noqa: F401
+from .memory_rules import (  # noqa: F401
+    DEFAULT_BUDGET_NAME,
+    MemoryAnalysis,
+    MemoryRuleContext,
+    analyze_memory_text,
+    find_budget_file,
+    load_budgets,
+    resolve_budget,
+    verify_memory_compiled,
+    verify_memory_text,
+    xla_peak_bytes,
+)
+from .memory_rules import RULES as MEMORY_RULES  # noqa: F401
+from .sharding_rules import (  # noqa: F401
+    ShardingRuleContext,
+    match_partition_rules,
+    verify_spec_table,
+    verify_tree_shardings,
+)
+from .sharding_rules import RULES as SHARDING_RULES  # noqa: F401
 
 # engine letter → rule catalog (the CLI's --engines selector)
 ENGINE_RULES = {
@@ -70,6 +102,8 @@ ENGINE_RULES = {
     "b": AST_RULES,
     "c": CONCURRENCY_RULES,
     "d": COLLECTIVE_RULES,
+    "e": MEMORY_RULES,
+    "f": SHARDING_RULES,
 }
 ALL_ENGINES = frozenset(ENGINE_RULES)
 
@@ -92,8 +126,11 @@ def lint_paths(paths, hot_patterns=None, donate_patterns=None, engines=None):
 
     ``*.py`` files go through the source engines (B and/or C per
     ``engines``); ``*.hlo`` text dumps go through the program engines (A
-    with a default declaration context and/or D, including the
-    cross-program order-divergence check over every dump in the run).
+    with a default declaration context, D — including the cross-program
+    order-divergence check over every dump in the run — and E, whose
+    budget gate resolves the dump's program name against the nearest
+    committed ``.dsmem-budgets.json``). Engine F needs a live param tree
+    and has no file form.
 
     Unparseable files surface as SyntaxError, bogus path arguments as
     ValueError — callers decide whether that is fatal (the CLI reports
@@ -144,6 +181,17 @@ def lint_paths(paths, hot_patterns=None, donate_patterns=None, engines=None):
     for f in hlo_files:
         with open(f, encoding="utf-8") as fh:
             hlo_texts[f] = fh.read()
+
+    if "e" in engines and hlo_texts:
+        # Engine E gates each dump's program name against the nearest
+        # committed ledger (resolved upward from the dump itself, so a
+        # dump in another checkout meets THAT repo's budgets); everything
+        # else in the context stays at defaults
+        class _DumpBudgetCfg:
+            budgets = {}
+            budget_file = ""
+            default_budget_bytes = 0
+
     for f, txt in hlo_texts.items():
         program = os.path.splitext(os.path.basename(f))[0]
         if "a" in engines:
@@ -153,6 +201,17 @@ def lint_paths(paths, hot_patterns=None, donate_patterns=None, engines=None):
             findings.extend(got)
         if "d" in engines:
             got = verify_collective_text(txt, program)
+            for x in got:
+                x.path = f
+            findings.extend(got)
+        if "e" in engines:
+            ectx = MemoryRuleContext(
+                program=program,
+                budget_bytes=resolve_budget(
+                    _DumpBudgetCfg, program, search_from=f
+                ),
+            )
+            got, _ = verify_memory_text(txt, ectx)
             for x in got:
                 x.path = f
             findings.extend(got)

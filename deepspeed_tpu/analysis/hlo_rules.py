@@ -148,13 +148,16 @@ def _aliased_params(txt: str) -> FrozenSet[int]:
     """Parameter numbers the module header aliases to an output.
 
     The table nests braces (``{ {0}: (1, {}, may-alias) }``), so the body
-    is cut by brace matching, not regex."""
+    is cut by brace matching, not regex. The ONE alias-table parser —
+    Engine E (``memory_rules``) reuses it so the two readers of the same
+    header cannot drift. The scan cap covers a few thousand donated
+    leaves; a table that big prints ~16 chars per entry."""
     start = txt.find("input_output_alias={")
     if start < 0:
         return frozenset()
     i = txt.find("{", start)
     depth, end = 0, len(txt)
-    for j in range(i, min(len(txt), i + 8192)):
+    for j in range(i, min(len(txt), i + 65536)):
         if txt[j] == "{":
             depth += 1
         elif txt[j] == "}":

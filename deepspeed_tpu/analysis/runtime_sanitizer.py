@@ -22,8 +22,13 @@ a rule firing in both is a confirmed bug, one firing only statically is a
 candidate for a justified waiver.
 
 Cost: one tuple append per lock acquire and one dict update per annotated
-access — everything is a no-op (module-level None check) when no sanitizer
-is installed, so production runs pay a single branch.
+access — and ONLY while a sanitizer is installed. When
+``analysis.sanitizer`` is disabled, ``note_read``/``note_write`` are
+rebound to empty no-op functions and :class:`SanitizedLock` skips its
+recording branch, so the instrumented hot paths (``StepTracer.emit``, the
+checkpoint writer) pay nothing but the call itself (ISSUE 9 satellite —
+BENCH_pr8 measured 35.7% overhead on the instrumented emit micro-path with
+the recorder active; BENCH_pr9 re-measures both modes).
 """
 
 from __future__ import annotations
@@ -44,15 +49,20 @@ _ACTIVE: Optional["RuntimeSanitizer"] = None
 
 
 def enable(sanitizer: "RuntimeSanitizer") -> "RuntimeSanitizer":
-    """Install ``sanitizer`` as the process-wide active recorder."""
-    global _ACTIVE
+    """Install ``sanitizer`` as the process-wide active recorder (and swap
+    the live ``note_*`` implementations in)."""
+    global _ACTIVE, note_read, note_write
     _ACTIVE = sanitizer
+    note_read, note_write = _note_read_active, _note_write_active
     return sanitizer
 
 
 def disable() -> None:
-    global _ACTIVE
+    """Uninstall the recorder and rebind ``note_*`` to the no-ops, so
+    disabled runs pay nothing on the instrumented paths (ISSUE 9)."""
+    global _ACTIVE, note_read, note_write
     _ACTIVE = None
+    note_read, note_write = _note_noop, _note_noop
 
 
 def active() -> Optional["RuntimeSanitizer"]:
@@ -79,21 +89,37 @@ def from_config(config) -> Optional["RuntimeSanitizer"]:
 
 def maybe_lock(name: str):
     """A lock for ``name``: instrumented under an active sanitizer, a plain
-    ``threading.Lock`` otherwise. Concurrency-bearing modules create their
-    locks through this so dsan test runs observe their schedules for free."""
+    ``threading.Lock`` otherwise (the zero-cost passthrough). A
+    ``SanitizedLock`` created while enabled also stops recording the moment
+    its sanitizer is uninstalled, so a long-lived lock never pins a dead
+    recorder's overhead."""
     if _ACTIVE is not None:
         return _ACTIVE.lock(name)
     return threading.Lock()
 
 
-def note_read(owner, attr: str) -> None:
-    if _ACTIVE is not None:
-        _ACTIVE.note(owner, attr, "read")
+def _note_noop(owner, attr: str) -> None:
+    """The disabled-mode ``note_*``: an empty function — no global read,
+    no branch. ``enable()``/``disable()`` rebind the module-level names."""
 
 
-def note_write(owner, attr: str) -> None:
-    if _ACTIVE is not None:
-        _ACTIVE.note(owner, attr, "write")
+def _note_read_active(owner, attr: str) -> None:
+    san = _ACTIVE
+    if san is not None:
+        san.note(owner, attr, "read")
+
+
+def _note_write_active(owner, attr: str) -> None:
+    san = _ACTIVE
+    if san is not None:
+        san.note(owner, attr, "write")
+
+
+# live bindings: enable()/disable() swap these between the active
+# implementations and the no-op (import the MODULE, not the function, to
+# observe the swap — tracer.py and writer.py already do)
+note_read = _note_noop
+note_write = _note_noop
 
 
 class SanitizedLock:
@@ -106,11 +132,18 @@ class SanitizedLock:
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
         ok = self._lock.acquire(blocking, timeout)
-        if ok:
+        # record only while OUR sanitizer is still the installed one — a
+        # lock that outlives its sanitizer degrades to a plain mutex
+        # (ISSUE 9: no-op passthrough when analysis.sanitizer is disabled)
+        if ok and _ACTIVE is self._san:
             self._san._on_acquire(self.name)
         return ok
 
     def release(self) -> None:
+        # unconditional: _on_release only pops this lock from the thread's
+        # held tuple (a no-op if acquire skipped the push), so a disable()
+        # that lands mid-hold cannot strand a stale held entry that would
+        # fabricate order edges after a later re-enable()
         self._san._on_release(self.name)
         self._lock.release()
 

@@ -1,0 +1,295 @@
+"""Engine F: sharding-spec verification — regex spec tables vs real trees.
+
+The TP/disaggregated-serving refactor (ROADMAP item 3) will map checkpoints
+onto a sharded serving model through ``match_partition_rules``-style tables:
+an ordered list of ``(regex, partition_spec)`` pairs, first match wins, one
+spec per parameter path. Every production JAX codebase that uses this
+pattern hits the same three footguns, one checkpoint at a time:
+
+- a typo'd or stale regex matches NOTHING — the parameter it was written
+  for falls through the table and is silently replicated on every device
+  (``unmatched-param-rule``);
+- a spec names more dims than the leaf has, an axis the mesh doesn't have,
+  or an axis whose size doesn't divide the dim — the first ``device_put``
+  raises, or worse, silently pads (``spec-rank-mismatch``);
+- a large leaf ends up with NO sharded dim after the table + mesh degrade
+  — a multi-hundred-MB embedding quietly resident N times
+  (``replicated-large-leaf``).
+
+This engine checks the table *pre-compile*: evaluate the tree's shapes with
+``jax.eval_shape`` (or pass real arrays — only ``.shape``/``.dtype`` are
+read), resolve each leaf's spec through the table exactly the way
+``match_partition_rules`` will, degrade axes the mesh cannot implement
+(missing or size 1 — the same degrade ``logical_to_spec`` applies), and
+report the three findings above with the leaf path as the symbol. No
+compile, no device, no checkpoint load.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .findings import SEVERITY_ERROR, SEVERITY_WARNING, Finding
+
+RULES = {
+    "unmatched-param-rule":
+        "spec-table regex matches no parameter (its target is silently "
+        "replicated)",
+    "spec-rank-mismatch":
+        "partition spec incompatible with the leaf (rank / unknown mesh "
+        "axis / indivisible dim)",
+    "replicated-large-leaf":
+        "large parameter resolves to fully replicated (no sharded dim)",
+}
+
+# a spec entry: None (replicated dim), one axis name, or a tuple of axes
+SpecEntry = Any
+SpecRule = Tuple[str, Sequence[SpecEntry]]
+
+
+@dataclass
+class ShardingRuleContext:
+    """What the spec table is verified against."""
+
+    program: str = "params"
+    mesh_axes: Mapping[str, int] = field(default_factory=dict)
+    replicated_min_bytes: int = 1 << 20
+    # scalars / tiny leaves are never sharded; below this they are exempt
+    # from every rule (match_partition_rules' own scalar exemption)
+    min_shardable_elements: int = 2
+
+
+def tree_paths(tree) -> Dict[str, Any]:
+    """Flatten a pytree into ``{"a/b/0/c": leaf}`` slash-joined paths —
+    the exact naming ``match_partition_rules`` tables are written against."""
+    import jax
+
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    out: Dict[str, Any] = {}
+    for keypath, leaf in flat:
+        parts = []
+        for k in keypath:
+            if hasattr(k, "key"):
+                parts.append(str(k.key))
+            elif hasattr(k, "idx"):
+                parts.append(str(k.idx))
+            elif hasattr(k, "name"):
+                parts.append(str(k.name))
+            else:
+                parts.append(str(k))
+        out["/".join(parts)] = leaf
+    return out
+
+
+def _leaf_bytes(leaf) -> int:
+    shape = tuple(getattr(leaf, "shape", ()) or ())
+    dt = getattr(leaf, "dtype", None)
+    itemsize = np.dtype(dt).itemsize if dt is not None else 4
+    return int(np.prod(shape, dtype=np.int64)) * itemsize if shape else itemsize
+
+
+def _spec_entries(spec) -> List[SpecEntry]:
+    """Normalize a spec (PartitionSpec, tuple, list, None) to a list."""
+    if spec is None:
+        return []
+    return list(spec)
+
+
+def _compile_table(rules: Sequence[SpecRule]):
+    return [(pat, re.compile(pat), _spec_entries(spec))
+            for pat, spec in rules]
+
+
+def _first_match(compiled, path: str):
+    """The one first-match-wins resolution (the SNIPPETS.md idiom):
+    → (spec, matched). Both the production resolver and the verifier go
+    through here, so they cannot disagree about which rule a path takes."""
+    for _pat, rx, spec in compiled:
+        if rx.search(path):
+            return spec, True
+    return (), False
+
+
+def match_partition_rules(
+    rules: Sequence[SpecRule], tree
+) -> Dict[str, Sequence[SpecEntry]]:
+    """path → spec via first-match-wins ``re.search``. Unmatched leaves map
+    to ``()`` (replicated) rather than raising — the verifier reports them
+    instead so ALL problems surface in one run."""
+    compiled = _compile_table(rules)
+    return {
+        path: _first_match(compiled, path)[0]
+        for path in tree_paths(tree)
+    }
+
+
+def resolve_spec(
+    spec: Sequence[SpecEntry],
+    shape: Sequence[int],
+    mesh_axes: Mapping[str, int],
+) -> List[Optional[Tuple[str, ...]]]:
+    """The EFFECTIVE per-dim sharding after the mesh degrade: axes the mesh
+    does not have, or of size 1, drop to replicated (``logical_to_spec``'s
+    behavior). Returns one entry per leaf dim: a tuple of live axes or
+    None."""
+    out: List[Optional[Tuple[str, ...]]] = []
+    for d in range(len(shape)):
+        entry = spec[d] if d < len(spec) else None
+        axes = entry if isinstance(entry, (tuple, list)) else (
+            (entry,) if entry is not None else ()
+        )
+        live = tuple(
+            a for a in axes
+            if a is not None and int(mesh_axes.get(a, 1)) > 1
+        )
+        out.append(live or None)
+    return out
+
+
+def _finding(ctx, rule, severity, message, symbol=""):
+    return Finding(
+        rule=rule, severity=severity, message=message,
+        path=f"spec://{ctx.program}", line=0,
+        symbol=symbol or ctx.program, snippet=message[:160], engine="spec",
+    )
+
+
+def verify_spec_table(
+    rules: Sequence[SpecRule],
+    tree,
+    ctx: Optional[ShardingRuleContext] = None,
+) -> List[Finding]:
+    """Every Engine-F rule over one spec table + one (abstract) param tree.
+
+    ``tree`` may be real arrays, ``jax.eval_shape`` output, or any pytree
+    of ``.shape``/``.dtype`` carriers."""
+    ctx = ctx or ShardingRuleContext()
+    mesh_axes = dict(ctx.mesh_axes)
+    paths = tree_paths(tree)
+    findings: List[Finding] = []
+
+    compiled = _compile_table(rules)
+
+    # -- unmatched-param-rule: dead table entries -----------------------
+    for pat, rx, _spec in compiled:
+        if not any(rx.search(p) for p in paths):
+            findings.append(_finding(
+                ctx, "unmatched-param-rule", SEVERITY_ERROR,
+                f"spec-table rule {pat!r} matches no parameter path — the "
+                "param it was written for falls through the table and is "
+                "silently replicated",
+                symbol=pat,
+            ))
+
+    for path, leaf in paths.items():
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        if int(np.prod(shape, dtype=np.int64) if shape else 1) < \
+                ctx.min_shardable_elements:
+            continue  # scalars are never sharded; exempt
+        spec, matched = _first_match(compiled, path)
+
+        # -- spec-rank-mismatch -----------------------------------------
+        bad = False
+        if len(spec) > len(shape):
+            findings.append(_finding(
+                ctx, "spec-rank-mismatch", SEVERITY_ERROR,
+                f"spec {tuple(spec)!r} names {len(spec)} dims but "
+                f"{path} has rank {len(shape)} (shape {shape})",
+                symbol=path,
+            ))
+            bad = True
+        else:
+            for d, entry in enumerate(spec):
+                axes = entry if isinstance(entry, (tuple, list)) else (
+                    (entry,) if entry is not None else ()
+                )
+                for a in axes:
+                    if a is None:
+                        continue
+                    if a not in mesh_axes:
+                        findings.append(_finding(
+                            ctx, "spec-rank-mismatch", SEVERITY_ERROR,
+                            f"{path} dim {d} names mesh axis {a!r} but the "
+                            f"mesh has axes {sorted(mesh_axes)}",
+                            symbol=path,
+                        ))
+                        bad = True
+                    elif int(mesh_axes[a]) > 1 and \
+                            shape[d] % int(mesh_axes[a]) != 0:
+                        findings.append(_finding(
+                            ctx, "spec-rank-mismatch", SEVERITY_ERROR,
+                            f"{path} dim {d} (size {shape[d]}) is not "
+                            f"divisible by mesh axis {a!r} "
+                            f"(size {mesh_axes[a]})",
+                            symbol=path,
+                        ))
+                        bad = True
+        if bad:
+            continue  # a broken spec's replication status is meaningless
+
+        # -- replicated-large-leaf --------------------------------------
+        nbytes = _leaf_bytes(leaf)
+        if nbytes < ctx.replicated_min_bytes:
+            continue
+        effective = resolve_spec(spec, shape, mesh_axes)
+        if not any(e for e in effective):
+            why = (
+                f"rule matched but every axis degrades on mesh "
+                f"{dict(mesh_axes)}" if matched
+                else "no spec-table rule matches this path"
+            )
+            findings.append(_finding(
+                ctx, "replicated-large-leaf", SEVERITY_WARNING,
+                f"{path} ({nbytes / 1e6:.2f} MB, shape {shape}) resolves "
+                f"to fully replicated — {why}; every device pays "
+                f"{nbytes / 1e6:.2f} MB for it",
+                symbol=path,
+            ))
+    return findings
+
+
+def verify_tree_shardings(
+    tree, ctx: Optional[ShardingRuleContext] = None
+) -> List[Finding]:
+    """``replicated-large-leaf`` over a tree of REAL sharded arrays: reads
+    each leaf's actual ``.sharding`` spec (the propagated truth after
+    ``device_put``) instead of a declared table. The post-compile
+    cross-check to :func:`verify_spec_table`'s pre-compile one."""
+    ctx = ctx or ShardingRuleContext()
+    findings: List[Finding] = []
+    for path, leaf in tree_paths(tree).items():
+        nbytes = _leaf_bytes(leaf)
+        if nbytes < ctx.replicated_min_bytes:
+            continue
+        sharding = getattr(leaf, "sharding", None)
+        spec = getattr(sharding, "spec", None)
+        if spec is None:
+            continue
+        effective = resolve_spec(
+            _spec_entries(spec), tuple(leaf.shape), ctx.mesh_axes
+        )
+        if not any(e for e in effective):
+            findings.append(_finding(
+                ctx, "replicated-large-leaf", SEVERITY_WARNING,
+                f"{path} ({nbytes / 1e6:.2f} MB) is resident fully "
+                "replicated on every device (propagated sharding "
+                f"{tuple(_spec_entries(spec))!r})",
+                symbol=path,
+            ))
+    return findings
+
+
+def rules_from_config(scfg) -> List[SpecRule]:
+    """``analysis.sharding.rules`` JSON (``[[regex, [axes...]], ...]``) →
+    the SpecRule list (JSON ``null`` → replicated dim)."""
+    out: List[SpecRule] = []
+    for entry in getattr(scfg, "rules", None) or ():
+        pat, spec = entry[0], entry[1]
+        out.append((str(pat), [
+            tuple(a) if isinstance(a, list) else a for a in (spec or ())
+        ]))
+    return out

@@ -110,11 +110,15 @@ def main() -> int:
         from deepspeed_tpu.analysis import runtime_sanitizer as _dsan
         from deepspeed_tpu.tools.dslint import _find_baseline
 
+        from deepspeed_tpu.analysis import MEMORY_RULES, SHARDING_RULES
+
         print(
             f"engines ............. {GREEN_OK} "
             f"A:HLO ({len(HLO_RULES)}) + B:AST ({len(AST_RULES)}) + "
             f"C:concurrency ({len(CONCURRENCY_RULES)}) + "
-            f"D:collective ({len(COLLECTIVE_RULES)}) rules"
+            f"D:collective ({len(COLLECTIVE_RULES)}) + "
+            f"E:memory ({len(MEMORY_RULES)}) + "
+            f"F:sharding ({len(SHARDING_RULES)}) rules"
         )
         san = _dsan.active()
         print(
@@ -141,6 +145,66 @@ def main() -> int:
         )
     except Exception as e:
         print(f"analysis ............ {RED_NO} ({type(e).__name__}: {e})")
+    print("-" * 60)
+    print("Memory (dsmem):")
+    try:
+        import json
+        import os
+
+        from deepspeed_tpu.analysis import (
+            MEMORY_RULES,
+            SHARDING_RULES,
+            find_budget_file,
+            load_budgets,
+        )
+        from deepspeed_tpu.analysis.memory_rules import headroom_pct
+
+        print(
+            f"engine E/F rules .... {GREEN_OK} "
+            f"{len(MEMORY_RULES)} memory (hbm-over-budget, "
+            f"donation-missed-bytes, ...) + {len(SHARDING_RULES)} sharding"
+        )
+        budget_path = find_budget_file()
+        if budget_path:
+            budgets = load_budgets(budget_path)
+            # the bench artifact next to the ledger carries the measured
+            # per-program peaks (env_report stays cheap: no compiles here)
+            peaks, kv_bytes = {}, {}
+            bench_path = os.path.join(
+                os.path.dirname(os.path.abspath(budget_path)),
+                "BENCH_pr9.json",
+            )
+            if os.path.exists(bench_path):
+                try:
+                    with open(bench_path, encoding="utf-8") as fh:
+                        doc = json.load(fh)
+                    for prog, rec in (doc.get("programs") or {}).items():
+                        peaks[prog] = rec.get("peak_bytes_est")
+                        kv_bytes[prog] = rec.get("kv_pool_bytes", 0)
+                except Exception:
+                    pass
+            print(f"budget ledger ....... {budget_path}: "
+                  f"{len(budgets)} program(s)")
+            for prog in sorted(budgets):
+                b = budgets[prog]
+                peak = peaks.get(prog)
+                head = headroom_pct(b, peak) if peak else None
+                if peak and head is not None:
+                    extra = (f"peak {peak / 1e6:.2f} MB, "
+                             f"headroom {head:+.1f}%")
+                    if kv_bytes.get(prog):
+                        extra += f", kv pool {kv_bytes[prog] / 1e6:.2f} MB"
+                else:
+                    extra = "peak unmeasured — run bench.py"
+                print(f"  {prog:<18} budget {b / 1e6:.2f} MB ({extra})")
+        else:
+            print("budget ledger ....... none (hbm-over-budget gate off)")
+        print(
+            "verify .............. engine.memory_report() / "
+            "ServingEngine.memory_report(); CLI: dslint dumps/ --engines e"
+        )
+    except Exception as e:
+        print(f"dsmem ............... {RED_NO} ({type(e).__name__}: {e})")
     print("-" * 60)
     return 0
 

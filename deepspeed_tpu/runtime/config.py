@@ -493,6 +493,90 @@ class SanitizerConfig(DSConfigModel):
 
 
 @dataclass
+class MemoryAnalysisConfig(DSConfigModel):
+    """analysis.memory section (ISSUE 9 tentpole): Engine E, the static HBM
+    liveness verifier (``analysis/memory_rules.py``). A def-use live-range
+    walk over the compiled program's scheduled post-opt HLO computes its
+    peak resident bytes and a categorized live-at-peak ledger
+    (params / kv-pool / activations / collective-scratch / temp), pinned
+    within 10% of ``compiled.memory_analysis()`` on the real programs.
+    ``budgets`` maps program name -> committed byte budget
+    (``hbm-over-budget`` fires above it); absent entries fall back to the
+    committed ``budget_file`` ledger (``.dsmem-budgets.json``, found by the
+    same upward walk as the dslint baseline), then ``default_budget_bytes``
+    (0 = no gate). ``donation_min_bytes`` floors ``donation-missed-bytes``
+    (undonated inputs dead before the peak); ``scratch_max_fraction`` /
+    ``scratch_min_bytes`` bound ``oversized-collective-scratch``;
+    ``padding_waste_min_ratio`` / ``padding_waste_min_bytes`` bound
+    ``padding-waste`` on tiled layouts."""
+
+    enabled: bool = True
+    budgets: Dict[str, int] = field(default_factory=dict)
+    budget_file: str = ".dsmem-budgets.json"
+    default_budget_bytes: int = 0
+    check_donation: bool = True
+    donation_min_bytes: int = 1 << 16
+    scratch_max_fraction: float = 0.25
+    scratch_min_bytes: int = 1 << 20
+    padding_waste_min_ratio: float = 1.5
+    padding_waste_min_bytes: int = 1 << 16
+
+    def __post_init__(self):
+        if not 0.0 <= self.scratch_max_fraction <= 1.0:
+            raise DeepSpeedConfigError(
+                "analysis.memory.scratch_max_fraction must be in [0, 1], "
+                f"got {self.scratch_max_fraction}"
+            )
+        if self.padding_waste_min_ratio < 1.0:
+            raise DeepSpeedConfigError(
+                "analysis.memory.padding_waste_min_ratio must be >= 1, "
+                f"got {self.padding_waste_min_ratio}"
+            )
+        for prog, b in (self.budgets or {}).items():
+            if int(b) <= 0:
+                raise DeepSpeedConfigError(
+                    f"analysis.memory.budgets[{prog!r}] must be a positive "
+                    f"byte count, got {b}"
+                )
+
+
+@dataclass
+class ShardingAnalysisConfig(DSConfigModel):
+    """analysis.sharding section (ISSUE 9 tentpole): Engine F, the
+    pre-compile sharding-spec verifier (``analysis/sharding_rules.py``).
+    ``rules`` is a ``match_partition_rules``-style table —
+    ``[[regex, [axis, null, ...]], ...]``, first match wins against the
+    slash-joined parameter path — checked against the real param tree's
+    ``jax.eval_shape`` shapes and the engine's mesh: dead regexes
+    (``unmatched-param-rule``), rank/axis/divisibility breaks
+    (``spec-rank-mismatch``), and large leaves that resolve to fully
+    replicated (``replicated-large-leaf``, floored at
+    ``replicated_min_bytes``). Empty ``rules`` skips the engine — the
+    TP-serving refactor (ROADMAP item 3) commits its table here."""
+
+    enabled: bool = True
+    rules: List[List] = field(default_factory=list)
+    replicated_min_bytes: int = 1 << 20
+
+    def __post_init__(self):
+        import re as _re
+
+        for i, entry in enumerate(self.rules or []):
+            if not isinstance(entry, (list, tuple)) or len(entry) != 2:
+                raise DeepSpeedConfigError(
+                    f"analysis.sharding.rules[{i}] must be "
+                    f"[regex, [axes...]], got {entry!r}"
+                )
+            try:
+                _re.compile(entry[0])
+            except _re.error as e:
+                raise DeepSpeedConfigError(
+                    f"analysis.sharding.rules[{i}] regex {entry[0]!r} "
+                    f"does not compile: {e}"
+                )
+
+
+@dataclass
 class AnalysisConfig(DSConfigModel):
     """analysis section (ISSUE 6 tentpole): dslint, the graph & sharding
     static-analysis plane (``deepspeed_tpu/analysis/``). Engine A verifies
@@ -523,6 +607,13 @@ class AnalysisConfig(DSConfigModel):
     donate_name_patterns: List[str] = field(default_factory=list)   # [] = built-in defaults
     # ISSUE 8: the runtime concurrency sanitizer (dynamic Engine C cross-check)
     sanitizer: SanitizerConfig = field(default_factory=SanitizerConfig)
+    # ISSUE 9: Engine E (static HBM liveness) + Engine F (sharding specs)
+    memory: MemoryAnalysisConfig = field(
+        default_factory=MemoryAnalysisConfig
+    )
+    sharding: ShardingAnalysisConfig = field(
+        default_factory=ShardingAnalysisConfig
+    )
 
     def __post_init__(self):
         if not 0.0 <= self.min_alias_fraction <= 1.0:

@@ -2012,7 +2012,66 @@ class DeepSpeedEngine:
         # multi-program engine (pipelined collectives, ROADMAP item 4)
         # inherits it for free
         findings.extend(dsa.verify_program_set({"train_step": txt}))
+        # Engine E (ISSUE 9): static HBM liveness over the same text — the
+        # peak-vs-budget gate plus donation/scratch/padding byte rules;
+        # the analysis is kept for memory_report() / bench / env_report
+        mcfg = getattr(acfg, "memory", None)
+        if mcfg is not None and mcfg.enabled:
+            from ..analysis import memory_rules as dsmem
+
+            ectx = dsmem.context_from_config(mcfg, "train_step")
+            mem_findings, ana = dsmem.verify_memory_text(txt, ectx)
+            findings.extend(mem_findings)
+            # keyed like _introspection_analysis: a retrace compiles a new
+            # program, whose profile must not be served from this cache
+            self._memory_analysis = ana
+            self._memory_analysis_key = self._jit_step_programs()
+        # Engine F (ISSUE 9): the committed sharding-spec table (if any)
+        # checked against the REAL param tree and this engine's mesh —
+        # dead rules, rank/axis breaks, silently replicated large leaves
+        scfg = getattr(acfg, "sharding", None)
+        if scfg is not None and scfg.enabled and scfg.rules:
+            from ..analysis import sharding_rules as dsspec
+
+            fctx = dsspec.ShardingRuleContext(
+                program="train_params",
+                mesh_axes=dict(self.mesh.shape) if self.mesh else {},
+                replicated_min_bytes=scfg.replicated_min_bytes,
+            )
+            findings.extend(dsspec.verify_spec_table(
+                dsspec.rules_from_config(scfg), self.state.params, fctx
+            ))
         return findings
+
+    def memory_report(self) -> Optional[Dict]:
+        """The dsmem (Engine E) profile of the compiled train step: peak
+        HBM, budget + headroom, and the categorized live-at-peak ledger.
+        Runs ``verify_program()`` if no analysis is cached for the CURRENT
+        step program (a retrace invalidates the cache); None when the
+        analysis plane is disabled or the step is not the standard jitted
+        path."""
+        stale = (
+            getattr(self, "_memory_analysis", None) is None
+            or getattr(self, "_memory_analysis_key", None)
+            != self._jit_step_programs()
+        )
+        if stale:
+            try:
+                self.verify_program()
+            except ValueError:
+                return None
+        ana = getattr(self, "_memory_analysis", None)
+        if ana is None:
+            return None
+        from ..analysis import memory_rules as dsmem
+
+        budget = dsmem.resolve_budget(
+            self.config.analysis.memory, "train_step"
+        )
+        report = ana.to_dict()
+        report["budget_bytes"] = budget
+        report["headroom_pct"] = dsmem.headroom_pct(budget, ana.peak_bytes)
+        return report
 
     def _introspection_analysis(self):
         """Per-category HLO cost analysis of the current step program

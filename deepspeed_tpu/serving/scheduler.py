@@ -731,7 +731,50 @@ class ServingEngine:
         # must agree on per-group collective order or concurrent slots
         # desync
         findings.extend(dsa.verify_program_set(texts))
+        # Engine E (ISSUE 9): static HBM liveness per executable against
+        # the committed budgets — the KV page pool is the dominant
+        # consumer, so a doubled pool or a lost donation fails the gate
+        # here before it OOMs under load. check_donation=False: serving
+        # weights are shared across every call by design (only the pools
+        # are donated, and those are already aliased).
+        mcfg = getattr(acfg, "memory", None)
+        if mcfg is not None and getattr(mcfg, "enabled", True):
+            from ..analysis import memory_rules as dsmem
+
+            self._memory_analyses = {}
+            self._memory_cfg = mcfg
+            for name in ("serving_prefill", "serving_decode"):
+                ectx = dsmem.context_from_config(
+                    mcfg, name,
+                    check_donation=False,
+                    kv_pool_dims=(pool_dims,),
+                )
+                mem_findings, ana = dsmem.verify_memory_text(
+                    texts[name], ectx
+                )
+                findings.extend(mem_findings)
+                self._memory_analyses[name] = ana
         return findings
+
+    def memory_report(self) -> dict:
+        """The dsmem (Engine E) profile of both serving executables: peak
+        HBM, budget + headroom, KV page-pool bytes. Compiles + verifies on
+        first use."""
+        if not getattr(self, "_memory_analyses", None):
+            self.verify()
+        from ..analysis import memory_rules as dsmem
+        from ..runtime.config import AnalysisConfig
+
+        mcfg = getattr(self, "_memory_cfg", None) or AnalysisConfig().memory
+        out = {}
+        for name, ana in (self._memory_analyses or {}).items():
+            budget = dsmem.resolve_budget(mcfg, name)
+            rec = ana.to_dict()
+            rec["budget_bytes"] = budget
+            rec["headroom_pct"] = dsmem.headroom_pct(budget, ana.peak_bytes)
+            rec["kv_pool_bytes"] = ana.by_category.get("kv-pool", 0)
+            out[name] = rec
+        return out
 
     def stats(self) -> dict:
         """p50/p95/p99 + mean/count summaries of TTFT, TPOT and decode-step

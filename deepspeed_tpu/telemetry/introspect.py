@@ -336,6 +336,102 @@ def parse_instruction(line: str):
     return None, 0, None
 
 
+_NAMED_INSTR = re.compile(
+    r"^\s*(?P<root>ROOT\s+)?%(?P<name>[\w.\-]+)\s*=\s*(?P<rest>.*)$"
+)
+_RESTYPE_PLAIN = re.compile(r"[\w]+\[[0-9,]*\](\{[^}]*\})?(\S*)")
+_OP_AFTER_TYPE = re.compile(r"\s*(?P<op>[\w\-]+)\(")
+
+
+@dataclass
+class NamedInstruction:
+    """One parsed HLO instruction with buffer-level detail (ISSUE 9).
+
+    The dsmem liveness walker (``analysis/memory_rules.py``) needs more than
+    :func:`parse_instruction`'s (op, bytes) view: the instruction NAME (the
+    def in the def-use chain), the operand names (the uses), the typed
+    result shapes (tuple elements are separate buffers), the attribute tail
+    (``index=``/``body=``/``metadata=``) and whether this is the ROOT.
+    Shares the byte/shape grammar above so the three HLO readers (cost walk,
+    Engine A/D rules, Engine E liveness) cannot drift."""
+
+    name: str
+    op: str
+    result_shapes: List[tuple]   # [(dtype, dims), ...]; >1 for tuple results
+    result_bytes: int            # sum over known-dtype result shapes
+    operands: List[str]          # %names referenced inside the call parens
+    attrs: str                   # text after the call parens (index=, body=)
+    is_root: bool
+    line: str
+
+
+def parse_named_instruction(line: str) -> Optional[NamedInstruction]:
+    """One HLO instruction line → :class:`NamedInstruction`, or None for
+    non-instruction lines (headers, braces, comments)."""
+    m = _NAMED_INSTR.match(line.strip())
+    if not m:
+        return None
+    name, rest = m.group("name"), m.group("rest")
+    if rest.startswith("("):
+        depth = 0
+        end = -1
+        for i, ch in enumerate(rest):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+                if depth == 0:
+                    end = i
+                    break
+        if end < 0:
+            return None
+        restype, tail = rest[: end + 1], rest[end + 1:]
+    else:
+        tm = _RESTYPE_PLAIN.match(rest)
+        if not tm:
+            return None
+        restype, tail = rest[: tm.end()], rest[tm.end():]
+    om = _OP_AFTER_TYPE.match(tail)
+    if not om:
+        return None
+    call_start = tail.find("(")
+    depth, call_end = 0, len(tail)
+    for i in range(call_start, len(tail)):
+        if tail[i] == "(":
+            depth += 1
+        elif tail[i] == ")":
+            depth -= 1
+            if depth == 0:
+                call_end = i
+                break
+    shapes = _SHAPE.findall(restype)
+    return NamedInstruction(
+        name=name,
+        op=om.group("op"),
+        result_shapes=shapes,
+        result_bytes=sum(
+            _shape_bytes(dt, dd) for dt, dd in shapes if dt in _DTYPE_BYTES
+        ),
+        operands=re.findall(r"%([\w.\-]+)", tail[call_start:call_end]),
+        attrs=tail[call_end + 1:],
+        is_root=m.group("root") is not None,
+        line=line,
+    )
+
+
+def split_computations(txt: str) -> Dict[str, List[str]]:
+    """Public alias of the computation splitter (ISSUE 9): computation name
+    → its instruction lines. The ENTRY computation's name is recoverable by
+    scanning for a line starting with ``ENTRY``; see ``entry_computation``."""
+    return _split_computations(txt)
+
+
+def entry_computation(txt: str) -> Optional[str]:
+    """Name of the ENTRY computation in ``txt`` (None if absent)."""
+    m = re.search(r"^\s*ENTRY\s+%?([\w.\-]+)\s*\(", txt, re.M)
+    return m.group(1) if m else None
+
+
 def analyze_hlo_text(txt: str, loop_iterations: int = 1) -> HloAnalysis:
     """Walk post-optimization HLO text into a per-category cost breakdown.
 

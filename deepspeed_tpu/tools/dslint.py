@@ -1,19 +1,23 @@
-"""``dslint`` — static-analysis CLI + CI regression gate (ISSUE 6, 8).
+"""``dslint`` — static-analysis CLI + CI regression gate (ISSUE 6, 8, 9).
 
     python -m deepspeed_tpu.tools.dslint deepspeed_tpu/            # full lint
     python -m deepspeed_tpu.tools.dslint --changed                 # CI gate
     python -m deepspeed_tpu.tools.dslint pkg/ --update-baseline    # re-record
     python -m deepspeed_tpu.tools.dslint pkg/ --engines b,c        # subset
+    python -m deepspeed_tpu.tools.dslint dumps/ --engines e,f      # memory
 
 Runs the source engines — B (AST JAX-footgun rules) and C (AST concurrency
 sanitizer, ISSUE 8) — over ``*.py`` under the given paths, and the program
-engines — A (HLO declarations) and D (collective consistency) — over any
-``*.hlo`` post-optimization text dumps, then gates the result on the
-committed baseline (``.dslint-baseline.json``): findings already in the
+engines — A (HLO declarations), D (collective consistency) and E (static
+HBM liveness vs the committed ``.dsmem-budgets.json`` ledger, ISSUE 9) —
+over any ``*.hlo`` post-optimization text dumps, then gates the result on
+the committed baseline (``.dslint-baseline.json``): findings already in the
 baseline are reported but do not fail; NEW findings exit 1.
 ``--update-baseline`` rewrites the ledger from the current findings —
 entries whose finding disappeared expire, so the debt only shrinks.
-``--engines a,b,c,d`` selects engines (default: all four).
+``--engines a,b,c,d,e,f`` selects engines (default: all six; Engine F
+needs a live param tree — it runs via ``engine.verify_program()`` and the
+dsmem tests, the CLI only lists its catalog).
 
 ``--changed`` lints just the files git reports as modified/staged/untracked
 — the cheap per-PR gate; the committed baseline makes the full run
@@ -167,7 +171,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="comma-separated engine letters to run: a (HLO "
                    "declarations over *.hlo dumps), b (AST JAX footguns), "
                    "c (AST concurrency sanitizer), d (HLO collective "
-                   "consistency). Default: all")
+                   "consistency), e (static HBM liveness + budgets over "
+                   "*.hlo dumps), f (sharding-spec tables — live trees "
+                   "only, catalog via --list-rules). Default: all")
     p.add_argument("--baseline", default=None,
                    help=f"baseline file (default: nearest {DEFAULT_BASELINE_NAME})")
     p.add_argument("--config", default=None,

@@ -1,0 +1,657 @@
+"""dsmem — the memory-verification plane (ISSUE 9): Engine E static HBM
+liveness (def-use live-range walk, budgets, donation/scratch/padding rules),
+Engine F sharding-spec tables, the CLI/baseline integration, and the
+acceptance pins: Engine E's peak within 10% of ``compiled.memory_analysis()``
+on the real gpt2-tiny train step + both serving executables, all three clean
+against the committed ``.dsmem-budgets.json``, and the gate firing on an
+injected budget regression (doubled KV page pool).
+
+Every rule has a seeded-violation case (fires) and a clean equivalent
+(quiet), per the acceptance criteria.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu import analysis as dsa
+from deepspeed_tpu.analysis import memory_rules as E
+from deepspeed_tpu.analysis import sharding_rules as F
+from deepspeed_tpu.tools import dslint
+
+warnings.filterwarnings("ignore")
+
+pytestmark = pytest.mark.dsmem
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+BUDGET_FILE = os.path.join(REPO_ROOT, E.DEFAULT_BUDGET_NAME)
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+def _hlo(body, header_extra=""):
+    return (
+        f"HloModule fixture, is_scheduled=true{header_extra}\n\n" + body
+    )
+
+
+# ---------------------------------------------------------------------------
+# the liveness walker vs hand-computed peaks
+# ---------------------------------------------------------------------------
+
+STRAIGHT_LINE = _hlo("""\
+ENTRY %main (p0: f32[1024], p1: f32[1024]) -> f32[1024] {
+  %p0 = f32[1024]{0} parameter(0)
+  %p1 = f32[1024]{0} parameter(1)
+  %a = f32[1024]{0} add(f32[1024]{0} %p0, f32[1024]{0} %p1)
+  %b = f32[1024]{0} multiply(f32[1024]{0} %a, f32[1024]{0} %a)
+  ROOT %c = f32[1024]{0} add(f32[1024]{0} %b, f32[1024]{0} %p0)
+}
+""")
+
+WHILE_LOOP = _hlo("""\
+%body (arg: (s32[], f32[256])) -> (s32[], f32[256]) {
+  %arg = (s32[], f32[256]{0}) parameter(0)
+  %i = s32[] get-tuple-element((s32[], f32[256]{0}) %arg), index=0
+  %x = f32[256]{0} get-tuple-element((s32[], f32[256]{0}) %arg), index=1
+  %t = f32[1024]{0} broadcast(f32[256]{0} %x), dimensions={0}
+  %y = f32[256]{0} slice(f32[1024]{0} %t), slice={[0:256]}
+  %one = s32[] constant(1)
+  %i2 = s32[] add(s32[] %i, s32[] %one)
+  ROOT %out = (s32[], f32[256]{0}) tuple(s32[] %i2, f32[256]{0} %y)
+}
+
+%cond (arg: (s32[], f32[256])) -> pred[] {
+  %carg = (s32[], f32[256]{0}) parameter(0)
+  %ci = s32[] get-tuple-element((s32[], f32[256]{0}) %carg), index=0
+  %n = s32[] constant(4)
+  ROOT %lt = pred[] compare(s32[] %ci, s32[] %n), direction=LT
+}
+
+ENTRY %main (p0: f32[256]) -> f32[256] {
+  %p0 = f32[256]{0} parameter(0)
+  %zero = s32[] constant(0)
+  %init = f32[256]{0} copy(f32[256]{0} %p0)
+  %tup = (s32[], f32[256]{0}) tuple(s32[] %zero, f32[256]{0} %init)
+  %w = (s32[], f32[256]{0}) while((s32[], f32[256]{0}) %tup), condition=%cond, body=%body
+  ROOT %res = f32[256]{0} get-tuple-element((s32[], f32[256]{0}) %w), index=1
+}
+""")
+
+
+class TestLivenessWalker:
+    def test_straight_line_hand_computed(self):
+        """a (4 KB) dies feeding b, b dies feeding c, c is the output —
+        peak internal set is two 4 KB buffers, args are two more."""
+        ana = E.analyze_memory_text(
+            STRAIGHT_LINE, E.MemoryRuleContext(program="t")
+        )
+        assert ana.args_bytes == 2 * 4096
+        assert ana.walk_peak_bytes == 2 * 4096
+        assert ana.peak_bytes == 4 * 4096
+        assert sum(ana.by_category.values()) == ana.peak_bytes
+
+    def test_while_carried_buffer_counted_once_plus_body_peak(self):
+        """The carried buffers (1 KB copy + 4 B counter) are charged once
+        (in-place while) and the body's internal peak (4 KB broadcast +
+        1 KB slice) rides on top at the while instruction."""
+        ana = E.analyze_memory_text(
+            WHILE_LOOP, E.MemoryRuleContext(program="w")
+        )
+        assert ana.args_bytes == 1024
+        # carried: init (1024) + zero (4); body transient: 4096 + 1024
+        assert ana.walk_peak_bytes == 1024 + 4 + 4096 + 1024
+        assert ana.peak_bytes == 1024 + 6148
+
+    def test_tuple_elements_tracked_per_element(self):
+        """A GTE of one element must not pin the other element alive."""
+        txt = _hlo("""\
+ENTRY %main (p0: f32[1024]) -> f32[4] {
+  %p0 = f32[1024]{0} parameter(0)
+  %big = f32[8192]{0} broadcast(f32[1024]{0} %p0), dimensions={0}
+  %small = f32[4]{0} slice(f32[1024]{0} %p0), slice={[0:4]}
+  %tup = (f32[8192]{0}, f32[4]{0}) tuple(f32[8192]{0} %big, f32[4]{0} %small)
+  %keep = f32[4]{0} get-tuple-element((f32[8192]{0}, f32[4]{0}) %tup), index=1
+  %pad0 = f32[4]{0} add(f32[4]{0} %keep, f32[4]{0} %keep)
+  %pad1 = f32[4]{0} add(f32[4]{0} %pad0, f32[4]{0} %pad0)
+  ROOT %out = f32[4]{0} add(f32[4]{0} %pad1, f32[4]{0} %keep)
+}
+""")
+        ana = E.analyze_memory_text(txt, E.MemoryRuleContext(program="t"))
+        # big (32 KB) dies at the tuple build; it must NOT stay live
+        # through the later GTE-of-element-1 uses
+        assert ana.walk_peak_bytes < 2 * 32768
+        assert ana.walk_peak_bytes >= 32768  # but it did exist once
+
+    def test_predicated_conditional_charges_branch_peak(self):
+        """Both HLO conditional forms must charge the max branch peak:
+        true_computation=/false_computation= (bool predicate) as well as
+        branch_computations={...}."""
+        txt = _hlo("""\
+%ctrue (a: f32[256]) -> f32[256] {
+  %a = f32[256]{0} parameter(0)
+  %big = f32[8192]{0} broadcast(f32[256]{0} %a), dimensions={0}
+  ROOT %r = f32[256]{0} slice(f32[8192]{0} %big), slice={[0:256]}
+}
+
+%cfalse (b: f32[256]) -> f32[256] {
+  %b = f32[256]{0} parameter(0)
+  ROOT %r2 = f32[256]{0} add(f32[256]{0} %b, f32[256]{0} %b)
+}
+
+ENTRY %main (p: pred[], x: f32[256]) -> f32[256] {
+  %p = pred[] parameter(0)
+  %x = f32[256]{0} parameter(1)
+  ROOT %c = f32[256]{0} conditional(pred[] %p, f32[256]{0} %x, f32[256]{0} %x), true_computation=%ctrue, false_computation=%cfalse
+}
+""")
+        ana = E.analyze_memory_text(txt, E.MemoryRuleContext(program="c"))
+        # max(branch peaks): true branch broadcast (32 KB) + its root slice
+        assert ana.walk_peak_bytes >= 32768
+
+    def test_views_do_not_allocate(self):
+        txt = _hlo("""\
+ENTRY %main (p0: f32[1024]) -> f32[1024] {
+  %p0 = f32[1024]{0} parameter(0)
+  %v = f32[1024]{0} bitcast(f32[1024]{0} %p0)
+  ROOT %r = f32[1024]{0} add(f32[1024]{0} %v, f32[1024]{0} %v)
+}
+""")
+        ana = E.analyze_memory_text(txt, E.MemoryRuleContext(program="t"))
+        assert ana.walk_peak_bytes == 4096  # only the output buffer
+
+
+# ---------------------------------------------------------------------------
+# Engine E rules: positive + clean per rule
+# ---------------------------------------------------------------------------
+
+class TestHbmOverBudget:
+    def test_fires_above_budget_and_names_categories(self):
+        f, ana = E.verify_memory_text(
+            STRAIGHT_LINE,
+            E.MemoryRuleContext(program="t", budget_bytes=10000),
+        )
+        assert rules_of(f) == ["hbm-over-budget"]
+        assert "params" in f[0].message
+        assert f[0].engine == "mem"
+
+    def test_clean_within_budget_and_zero_budget_off(self):
+        f, _ = E.verify_memory_text(
+            STRAIGHT_LINE,
+            E.MemoryRuleContext(program="t", budget_bytes=1 << 20),
+        )
+        assert f == []
+        f, _ = E.verify_memory_text(
+            STRAIGHT_LINE, E.MemoryRuleContext(program="t", budget_bytes=0)
+        )
+        assert f == []
+
+
+DONATION_BODY = """\
+ENTRY %main (p0: f32[32768], p1: f32[32768]) -> f32[32768] {
+  %p0 = f32[32768]{0} parameter(0)
+  %p1 = f32[32768]{0} parameter(1)
+  %a = f32[32768]{0} add(f32[32768]{0} %p0, f32[32768]{0} %p1)
+  %b = f32[32768]{0} multiply(f32[32768]{0} %a, f32[32768]{0} %a)
+  ROOT %c = f32[32768]{0} add(f32[32768]{0} %b, f32[32768]{0} %b)
+}
+"""
+
+
+class TestDonationMissed:
+    def test_dead_before_peak_undonated_fires(self):
+        f, ana = E.verify_memory_text(
+            _hlo(DONATION_BODY), E.MemoryRuleContext(program="t")
+        )
+        assert rules_of(f) == ["donation-missed-bytes"] * 2
+        assert {n for n, _, _ in ana.donation_candidates} == {"p0", "p1"}
+
+    def test_aliased_param_is_exempt(self):
+        f, ana = E.verify_memory_text(
+            _hlo(DONATION_BODY,
+                 ", input_output_alias={ {}: (0, {}, may-alias) }"),
+            E.MemoryRuleContext(program="t"),
+        )
+        assert rules_of(f) == ["donation-missed-bytes"]  # only p1 now
+        assert ana.aliased_bytes == 131072
+
+    def test_threshold_and_opt_out(self):
+        f, _ = E.verify_memory_text(
+            _hlo(DONATION_BODY),
+            E.MemoryRuleContext(program="t", donation_min_bytes=1 << 20),
+        )
+        assert f == []
+        f, _ = E.verify_memory_text(
+            _hlo(DONATION_BODY),
+            E.MemoryRuleContext(program="t", check_donation=False),
+        )
+        assert f == []
+
+
+COLLECTIVE_BODY = _hlo("""\
+ENTRY %main (p0: f32[262144]) -> f32[262144] {
+  %p0 = f32[262144]{0} parameter(0)
+  %ar = f32[262144]{0} all-reduce(f32[262144]{0} %p0), replica_groups={}, to_apply=%sum
+  ROOT %r = f32[262144]{0} add(f32[262144]{0} %ar, f32[262144]{0} %ar)
+}
+""")
+
+
+class TestOversizedCollectiveScratch:
+    def test_fires_above_fraction(self):
+        f, ana = E.verify_memory_text(
+            COLLECTIVE_BODY,
+            E.MemoryRuleContext(program="t", check_donation=False),
+        )
+        assert rules_of(f) == ["oversized-collective-scratch"]
+        assert ana.by_category["collective-scratch"] == 1048576
+
+    def test_clean_below_fraction_or_floor(self):
+        f, _ = E.verify_memory_text(
+            COLLECTIVE_BODY,
+            E.MemoryRuleContext(program="t", check_donation=False,
+                                scratch_max_fraction=0.9),
+        )
+        assert f == []
+        f, _ = E.verify_memory_text(
+            COLLECTIVE_BODY,
+            E.MemoryRuleContext(program="t", check_donation=False,
+                                scratch_min_bytes=1 << 30),
+        )
+        assert f == []
+
+
+class TestPaddingWaste:
+    PADDED = _hlo("""\
+ENTRY %main (p0: bf16[1024,1]) -> bf16[1024,1] {
+  %p0 = bf16[1024,1]{1,0} parameter(0)
+  ROOT %x = bf16[1024,1]{1,0:T(8,128)(2,1)} copy(bf16[1024,1]{1,0} %p0)
+}
+""")
+
+    def test_tiled_layout_fires(self):
+        f, _ = E.verify_memory_text(
+            self.PADDED, E.MemoryRuleContext(program="t")
+        )
+        assert rules_of(f) == ["padding-waste"]
+        assert "128.0x" in f[0].message
+
+    def test_untiled_and_below_ratio_clean(self):
+        f, _ = E.verify_memory_text(
+            STRAIGHT_LINE, E.MemoryRuleContext(program="t")
+        )
+        assert f == []
+        f, _ = E.verify_memory_text(
+            self.PADDED,
+            E.MemoryRuleContext(program="t", padding_waste_min_bytes=1 << 30),
+        )
+        assert f == []
+
+    def test_padded_bytes_math(self):
+        # [1024,1] bf16 under T(8,128): minor dim 1 -> 128, next 1024 -> 1024
+        assert E.padded_bytes("bf16", "1024,1", "1,0", "T(8,128)(2,1)") \
+            == 1024 * 128 * 2
+        # no tile spec -> logical bytes
+        assert E.padded_bytes("f32", "16,16", "1,0", "") == 1024
+
+
+class TestCategorization:
+    def test_kv_pool_dims_and_activation_hint(self):
+        txt = _hlo("""\
+ENTRY %main (pool: f32[2,64,4,4,16], p1: f32[1024]) -> f32[1024] {
+  %pool = f32[2,64,4,4,16]{4,3,2,1,0} parameter(0)
+  %p1 = f32[1024]{0} parameter(1)
+  %act = f32[1024]{0} add(f32[1024]{0} %p1, f32[1024]{0} %p1), metadata={op_name="jit(step)/transformer/mlp" source_file="/x/models/gpt2.py"}
+  ROOT %r = f32[1024]{0} add(f32[1024]{0} %act, f32[1024]{0} %act)
+}
+""")
+        ana = E.analyze_memory_text(
+            txt, E.MemoryRuleContext(program="t",
+                                     kv_pool_dims=("2,64,4,4,16",))
+        )
+        assert ana.by_category["kv-pool"] == 2 * 64 * 4 * 4 * 16 * 4
+        assert ana.by_category["activations"] == 4096
+
+
+# ---------------------------------------------------------------------------
+# Engine F: spec tables on the REAL gpt2 param tree
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def gpt2_tree():
+    from deepspeed_tpu.models import gpt2
+
+    cfg = gpt2.get_config("gpt2-tiny", attn_impl="jnp")
+    return jax.eval_shape(
+        lambda: gpt2.init_params(cfg, jax.random.PRNGKey(0))
+    )
+
+
+GOOD_TABLE = [
+    (r"wte", ("tp", None)),
+    (r"wpe", (None, None)),
+    (r"attn/c_attn_w", (None, None, "tp")),
+    (r"attn/c_proj_w", (None, "tp", None)),
+    (r"mlp/c_fc_w", (None, None, "tp")),
+    (r"mlp/c_proj_w", (None, "tp", None)),
+    (r".*", ()),  # everything small: replicated
+]
+
+
+class TestShardingRules:
+    def test_good_table_on_real_tree_is_clean(self, gpt2_tree):
+        ctx = F.ShardingRuleContext(
+            mesh_axes={"tp": 8}, replicated_min_bytes=1 << 16
+        )
+        assert F.verify_spec_table(GOOD_TABLE, gpt2_tree, ctx) == []
+
+    def test_dead_rule_fires_unmatched(self, gpt2_tree):
+        table = GOOD_TABLE[:1] + [(r"attn/qkv_w_typo", ("tp",))] + \
+            GOOD_TABLE[1:]
+        ctx = F.ShardingRuleContext(mesh_axes={"tp": 8})
+        fs = F.verify_spec_table(table, gpt2_tree, ctx)
+        assert rules_of(fs) == ["unmatched-param-rule"]
+        assert "qkv_w_typo" in fs[0].message
+
+    def test_rank_axis_and_divisibility_mismatches(self, gpt2_tree):
+        ctx = F.ShardingRuleContext(mesh_axes={"tp": 8})
+        # rank: wpe is [128, 64]; a 3-dim spec cannot apply
+        fs = F.verify_spec_table(
+            [(r"wpe", ("tp", "tp", "tp"))], gpt2_tree, ctx
+        )
+        assert "spec-rank-mismatch" in rules_of(fs)
+        # unknown mesh axis
+        fs = F.verify_spec_table([(r"wte", ("model", None))], gpt2_tree, ctx)
+        assert any(
+            f.rule == "spec-rank-mismatch" and "'model'" in f.message
+            for f in fs
+        )
+        # indivisible: vocab 512 over an axis of 7
+        fs = F.verify_spec_table(
+            [(r"wte", ("tp", None))], gpt2_tree,
+            F.ShardingRuleContext(mesh_axes={"tp": 7}),
+        )
+        assert any(
+            f.rule == "spec-rank-mismatch" and "divisible" in f.message
+            for f in fs
+        )
+
+    def test_replicated_large_leaf_unmatched_and_degraded(self, gpt2_tree):
+        # wte (512x64 f32 = 131072 B) with no matching rule
+        ctx = F.ShardingRuleContext(
+            mesh_axes={"tp": 8}, replicated_min_bytes=1 << 16
+        )
+        fs = F.verify_spec_table([], gpt2_tree, ctx)
+        assert "replicated-large-leaf" in rules_of(fs)
+        assert any("wte" in f.symbol for f in fs)
+        # matched, but the axis degrades on a size-1 mesh
+        fs = F.verify_spec_table(
+            GOOD_TABLE, gpt2_tree,
+            F.ShardingRuleContext(mesh_axes={"tp": 1},
+                                  replicated_min_bytes=1 << 16),
+        )
+        assert "replicated-large-leaf" in rules_of(fs)
+
+    def test_match_partition_rules_first_match_wins(self, gpt2_tree):
+        specs = F.match_partition_rules(GOOD_TABLE, gpt2_tree)
+        assert specs["wte"] == ["tp", None]
+        assert specs["blocks/attn/c_attn_w"] == [None, None, "tp"]
+        assert specs["blocks/ln_1/scale"] == []  # catch-all
+
+    def test_verify_tree_shardings_reads_propagated_specs(self):
+        class Leaf:
+            shape = (1024, 1024)
+            dtype = np.float32
+
+            class sharding:
+                spec = (None, None)
+
+        ctx = F.ShardingRuleContext(
+            mesh_axes={"tp": 8}, replicated_min_bytes=1 << 20
+        )
+        fs = F.verify_tree_shardings({"w": Leaf()}, ctx)
+        assert rules_of(fs) == ["replicated-large-leaf"]
+
+        class Sharded(Leaf):
+            class sharding:
+                spec = ("tp", None)
+
+        assert F.verify_tree_shardings({"w": Sharded()}, ctx) == []
+
+
+# ---------------------------------------------------------------------------
+# CLI + config integration
+# ---------------------------------------------------------------------------
+
+class TestCliIntegration:
+    def test_list_rules_covers_engines_e_f(self, capsys):
+        assert dslint.main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rule in list(dsa.MEMORY_RULES) + list(dsa.SHARDING_RULES):
+            assert rule in out
+
+    def test_engine_e_gates_hlo_dumps_on_committed_budgets(
+        self, tmp_path, monkeypatch, capsys
+    ):
+        monkeypatch.chdir(tmp_path)
+        (tmp_path / "prog.hlo").write_text(STRAIGHT_LINE)
+        # budget for this dump's program name, deliberately too small
+        (tmp_path / E.DEFAULT_BUDGET_NAME).write_text(
+            json.dumps({"prog": 1000})
+        )
+        assert dslint.main(["prog.hlo", "--no-baseline",
+                            "--engines", "e"]) == 1
+        assert "hbm-over-budget" in capsys.readouterr().out
+        # raise the budget: clean
+        (tmp_path / E.DEFAULT_BUDGET_NAME).write_text(
+            json.dumps({"prog": 10 ** 9})
+        )
+        assert dslint.main(["prog.hlo", "--no-baseline",
+                            "--engines", "e"]) == 0
+
+    def test_update_baseline_refuses_engine_subsets(
+        self, tmp_path, monkeypatch
+    ):
+        monkeypatch.chdir(tmp_path)
+        (tmp_path / "prog.hlo").write_text(STRAIGHT_LINE)
+        for subset in ("e", "e,f", "a,b,c,d,e"):
+            assert dslint.main(
+                ["prog.hlo", "--update-baseline", "--engines", subset]
+            ) == 2
+
+    def test_corrupt_budget_file_is_loud(self, tmp_path):
+        p = tmp_path / E.DEFAULT_BUDGET_NAME
+        p.write_text("{broken")
+        with pytest.raises(ValueError):
+            E.load_budgets(str(p))
+
+    def test_budget_resolution_order(self, tmp_path):
+        p = tmp_path / E.DEFAULT_BUDGET_NAME
+        p.write_text(json.dumps({"_comment": "x", "prog": 123}))
+
+        class M:
+            budgets = {"other": 7}
+            budget_file = str(p)
+            default_budget_bytes = 55
+
+        assert E.resolve_budget(M, "prog") == 123       # ledger file
+        assert E.resolve_budget(M, "other") == 7        # explicit wins
+        assert E.resolve_budget(M, "absent") == 55      # default fallback
+
+    def test_config_sections_validate(self):
+        from deepspeed_tpu.runtime.config import (
+            DeepSpeedConfig,
+            DeepSpeedConfigError,
+            MemoryAnalysisConfig,
+            ShardingAnalysisConfig,
+        )
+
+        ds = DeepSpeedConfig.load({
+            "train_micro_batch_size_per_gpu": 1,
+            "analysis": {
+                "memory": {"budgets": {"train_step": 4_000_000}},
+                "sharding": {"rules": [["wte", ["tp", None]]]},
+            },
+        })
+        assert ds.analysis.memory.budgets == {"train_step": 4_000_000}
+        assert ds.analysis.sharding.rules == [["wte", ["tp", None]]]
+        with pytest.raises(DeepSpeedConfigError):
+            MemoryAnalysisConfig(scratch_max_fraction=1.5)
+        with pytest.raises(DeepSpeedConfigError):
+            MemoryAnalysisConfig(budgets={"x": 0})
+        with pytest.raises(DeepSpeedConfigError):
+            ShardingAnalysisConfig(rules=[["(", ["tp"]]])
+        with pytest.raises(DeepSpeedConfigError):
+            ShardingAnalysisConfig(rules=[["ok"]])
+
+    def test_env_report_memory_section(self):
+        res = subprocess.run(
+            [sys.executable, "-m", "deepspeed_tpu.env_report"],
+            capture_output=True, text=True, timeout=300,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=REPO_ROOT,
+        )
+        assert res.returncode == 0
+        assert "Memory (dsmem)" in res.stdout
+        assert "E:memory" in res.stdout and "F:sharding" in res.stdout
+        assert "budget ledger" in res.stdout
+        assert "train_step" in res.stdout  # the committed ledger's programs
+
+
+# ---------------------------------------------------------------------------
+# acceptance: the real programs vs memory_analysis() + the committed budgets
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def gpt2_tiny_cfg():
+    from deepspeed_tpu.models import gpt2
+
+    return gpt2.get_config("gpt2-tiny", attn_impl="jnp")
+
+
+@pytest.fixture(scope="module")
+def train_engine(gpt2_tiny_cfg):
+    from deepspeed_tpu.models import gpt2
+    from deepspeed_tpu.parallel.topology import MeshSpec
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+    ds = DeepSpeedConfig.load({
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 0},
+        "mesh": {"dp": 8},
+        "steps_per_print": 10**9,
+        "analysis": {"memory": {"budget_file": BUDGET_FILE}},
+    }, dp_world_size=8)
+    mesh = MeshSpec(dp=8).build_mesh()
+    engine = DeepSpeedEngine(
+        gpt2.make_module(gpt2_tiny_cfg), ds, mesh=mesh, seed=0
+    )
+    batch = {
+        "input_ids": np.arange(16 * 16, dtype=np.int32).reshape(16, 16)
+        % gpt2_tiny_cfg.vocab_size
+    }
+    engine.train_batch(batch)
+    return engine
+
+
+def _serving(gpt2_tiny_cfg, num_pages):
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.models import gpt2
+
+    params = gpt2.init_params(gpt2_tiny_cfg, jax.random.PRNGKey(0))
+    eng = InferenceEngine(
+        gpt2.make_module(gpt2_tiny_cfg), params=params, dtype=jnp.float32
+    )
+    return eng.serve({
+        "max_slots": 4, "page_size": 4, "num_pages": num_pages,
+        "max_prompt_len": 12, "max_new_tokens": 8,
+        "kv_cache_dtype": "float32",
+    })
+
+
+@pytest.fixture(scope="module")
+def serving_engine(gpt2_tiny_cfg):
+    return _serving(gpt2_tiny_cfg, num_pages=64)
+
+
+SERVING_ACFG = {"memory": {"budget_file": BUDGET_FILE}}
+
+
+class TestAcceptance:
+    def test_committed_budget_ledger_exists(self):
+        assert os.path.exists(BUDGET_FILE), "committed budget ledger missing"
+        budgets = E.load_budgets(BUDGET_FILE)
+        assert {"train_step", "serving_prefill", "serving_decode"} <= \
+            set(budgets)
+
+    def test_train_step_peak_within_10pct_and_in_budget(self, train_engine):
+        assert train_engine.verify_program() == []
+        ana = train_engine._memory_analysis
+        assert ana is not None
+        xla = E.xla_peak_bytes(train_engine._compiled_step())
+        assert xla is not None and xla > 0
+        assert abs(ana.peak_bytes - xla) / xla <= 0.10, (ana.peak_bytes, xla)
+        budget = E.load_budgets(BUDGET_FILE)["train_step"]
+        assert ana.peak_bytes <= budget
+        # the ledger is not vacuous: params + temps both present at peak
+        assert ana.by_category["params"] > 0
+        assert ana.by_category["temp"] + ana.by_category["activations"] > 0
+
+    def test_serving_programs_within_10pct_and_in_budget(
+        self, serving_engine
+    ):
+        assert serving_engine.verify(SERVING_ACFG) == []
+        budgets = E.load_budgets(BUDGET_FILE)
+        for name, exe in (
+            ("serving_prefill", serving_engine._prefill_exec),
+            ("serving_decode", serving_engine._decode_exec),
+        ):
+            ana = serving_engine._memory_analyses[name]
+            xla = E.xla_peak_bytes(exe)
+            assert xla is not None and xla > 0
+            assert abs(ana.peak_bytes - xla) / xla <= 0.10, \
+                (name, ana.peak_bytes, xla)
+            assert ana.peak_bytes <= budgets[name], name
+            # the KV pool is visible as its own category
+            assert ana.by_category["kv-pool"] > 0, name
+
+    def test_injected_regression_doubled_kv_pool_fails_gate(
+        self, gpt2_tiny_cfg
+    ):
+        """THE gate pin: double the KV page pool, keep the committed
+        budgets — verification must exit nonzero (findings non-empty,
+        hbm-over-budget naming the kv-pool category)."""
+        big = _serving(gpt2_tiny_cfg, num_pages=128)
+        fs = big.verify(SERVING_ACFG)
+        assert "hbm-over-budget" in rules_of(fs)
+        over = [f for f in fs if f.rule == "hbm-over-budget"]
+        assert any("kv-pool" in f.message for f in over)
+
+    def test_memory_report_shape(self, train_engine, serving_engine):
+        rep = train_engine.memory_report()
+        assert rep["budget_bytes"] > 0
+        assert rep["headroom_pct"] is not None and rep["headroom_pct"] > 0
+        assert rep["peak_bytes"] == rep["args_bytes"] + \
+            rep["walk_peak_bytes"]
+        srep = serving_engine.memory_report()
+        assert set(srep) == {"serving_prefill", "serving_decode"}
+        for rec in srep.values():
+            assert rec["kv_pool_bytes"] > 0
+
+    def test_verify_program_shares_the_one_compile(self, train_engine):
+        c1 = train_engine._compiled_step()
+        train_engine.verify_program()
+        assert train_engine._compiled_step() is c1
