@@ -84,8 +84,16 @@ RULES = {
 
 DEFAULT_BUDGET_NAME = ".dsmem-budgets.json"
 
-# buffer categories in the live-at-peak ledger
-CATEGORIES = ("params", "kv-pool", "activations", "collective-scratch", "temp")
+# buffer categories in the live-at-peak ledger. "metadata" (ISSUE 10) is
+# the serving control plane: integer block tables, draft-token batches and
+# page maps — the device shadow of the scheduler's host-side
+# refcount/prefix-index state, labeled so the ledger separates them from
+# model temps.
+CATEGORIES = ("params", "kv-pool", "activations", "collective-scratch",
+              "temp", "metadata")
+
+_METADATA_DTYPES = frozenset(("s8", "s16", "s32", "s64", "u8", "u16", "u32",
+                              "u64", "pred"))
 
 _COLLECTIVE_BASES = (
     "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
@@ -118,6 +126,10 @@ class MemoryRuleContext:
     # -- categorization ------------------------------------------------
     # dim strings ("L,P,KV,page,D") whose buffers are the serving KV pool
     kv_pool_dims: Sequence[str] = ()
+    # dim strings of integer control-plane buffers (block tables, draft
+    # batches, page maps) labeled "metadata"; only integer/pred dtypes
+    # match, so a float activation sharing a dim string stays put
+    metadata_dims: Sequence[str] = ()
     # metadata source/op hint that marks a temp buffer as an activation
     activation_hint: str = r"models/|attention|attn|mlp|embed|transformer"
 
@@ -212,6 +224,12 @@ def _categorize(inst: NamedInstruction, ctx: MemoryRuleContext,
         return "collective-scratch"
     if pool_dims and any(dd in pool_dims for _, dd in inst.result_shapes):
         return "kv-pool"
+    meta_dims = frozenset(ctx.metadata_dims)
+    if meta_dims and any(
+        dd in meta_dims and dt in _METADATA_DTYPES
+        for dt, dd in inst.result_shapes
+    ):
+        return "metadata"
     if act_re is not None:
         op_m = _META_OP.search(inst.line)
         src_m = _META_SRC.search(inst.line)
@@ -425,11 +443,17 @@ def analyze_memory_text(
                 m.group("dtype"), m.group("dims"),
                 int(m.group("num")), lineno,
             )
-    args_by_cat = {"params": 0, "kv-pool": 0}
+    meta_dims = frozenset(ctx.metadata_dims)
+    args_by_cat = {"params": 0, "kv-pool": 0, "metadata": 0}
     param_buffers: List[LiveBuffer] = []
     for pname, (dt, dd, num, lineno) in params.items():
         b = shape_bytes(dt, dd) if dt in DTYPE_BYTES else 0
-        category = "kv-pool" if dd in pool_dims else "params"
+        if dd in pool_dims:
+            category = "kv-pool"
+        elif dd in meta_dims and dt in _METADATA_DTYPES:
+            category = "metadata"
+        else:
+            category = "params"
         args_by_cat[category] += b
         param_buffers.append(LiveBuffer(pname, b, category, lineno))
         ana.args_bytes += b
@@ -450,6 +474,7 @@ def analyze_memory_text(
     by_cat = {c: 0 for c in CATEGORIES}
     by_cat["params"] = args_by_cat["params"]
     by_cat["kv-pool"] = args_by_cat["kv-pool"]
+    by_cat["metadata"] = args_by_cat["metadata"]
     for buf in ledger:
         by_cat[buf.category] = by_cat.get(buf.category, 0) + buf.nbytes
     # while-body internal peaks are charged transiently at the while line

@@ -341,7 +341,10 @@ class InferenceEngine:
 
     def serve(self, serving_config=None, clock=None):
         """Continuous-batching server over this engine (serving/scheduler.py):
-        a paged KV pool + slot-based decode loop compiled exactly twice.
+        a paged KV pool + slot-based decode loop over a fixed set of AOT
+        executables (prefill + decode, plus speculative verify / chunked
+        prefill when the config enables them; prefix-cache KV reuse rides
+        the same programs).
         ``serving_config`` (dict or :class:`~deepspeed_tpu.runtime.config.ServingConfig`)
         overrides the ``serving`` section passed to ``init_inference``."""
         import time as _time

@@ -145,6 +145,69 @@ def paged_cached_attention(
     return o.reshape(B, H, D).astype(q.dtype)
 
 
+def paged_multitoken_cached_attention(
+    q, k_pool, v_pool, block_tables, base, impl: str = "auto",
+    sm_scale: Optional[float] = None,
+):
+    """T-token causal decode attention against a PAGED KV cache (ISSUE 10:
+    the speculative verify step and chunked prefill): q [B,T,H,D], pools
+    [P,KV,page,D], block_tables [B,n] i32, base [B] i32 — query t of slot b
+    sits at absolute position ``base[b] + t`` and attends keys ``<= base[b]
+    + t`` → [B,T,H,D]. The chunk's own K/V must already be scattered into
+    the pool (update-then-attend, exactly like the single-token step).
+
+    Dispatch mirrors :func:`paged_cached_attention`: the multitoken Pallas
+    kernel on TPU, and a pure-jnp fallback whose T == 1 slice is the exact
+    grouped einsum of the single-token fallback (same casts, same masked
+    softmax) so the verify step's first query agrees with the decode step
+    bit for bit."""
+    B, T, H, D = q.shape
+    P, KV, page, _ = k_pool.shape
+    if H % KV != 0:
+        raise ValueError(f"q heads {H} must divide by KV heads {KV}")
+    if impl in ("auto", "pallas"):
+        from .pallas.decode_attention import (
+            paged_multitoken_attention,
+            paged_multitoken_attention_ok,
+        )
+
+        if impl == "pallas" or paged_multitoken_attention_ok(
+            page, D, T, k_pool.dtype.itemsize
+        ):
+            try:
+                return paged_multitoken_attention(
+                    q, k_pool, v_pool, block_tables, base, sm_scale=sm_scale
+                )
+            except Exception as e:  # pragma: no cover
+                if impl == "pallas":
+                    raise
+                warning_once(
+                    f"pallas multitoken paged attention unavailable ({e}); "
+                    "using jnp path"
+                )
+    elif impl != "jnp":
+        raise ValueError(f"unknown attention impl {impl}")
+    kd = jnp.swapaxes(k_pool[block_tables], 2, 3).reshape(B, -1, KV, D)
+    vd = jnp.swapaxes(v_pool[block_tables], 2, 3).reshape(B, -1, KV, D)
+    scale = sm_scale if sm_scale is not None else 1.0 / (D**0.5)
+    S = kd.shape[1]
+    # [B, T, S]: key j visible to query t iff j <= base + t
+    mask = (
+        jnp.arange(S)[None, None, :]
+        <= base[:, None, None] + jnp.arange(T)[None, :, None]
+    )
+    rep = H // KV
+    qg = q.reshape(B, T, KV, rep, D)
+    scores = jnp.einsum(
+        "btgrd,bsgd->btgrs", qg.astype(jnp.float32), kd.astype(jnp.float32)
+    ) * scale
+    probs = jax.nn.softmax(
+        jnp.where(mask[:, :, None, None, :], scores, -1e30), axis=-1
+    )
+    o = jnp.einsum("btgrs,bsgd->btgrd", probs, vd.astype(jnp.float32))
+    return o.reshape(B, T, H, D).astype(q.dtype)
+
+
 def windowed_attention_ok(q) -> bool:
     """Whether sliding-window causal attention will ride the Pallas kernels
     for this shape: the ordinary dispatch gate plus the resident-kernel

@@ -229,6 +229,121 @@ def paged_decode_attention(
     return out.reshape(B, H, D)
 
 
+def _paged_multitoken_kernel(bt_ref, base_ref, q_ref, k_ref, v_ref, o_ref,
+                             m_ref, l_ref, acc_ref, *, sm_scale: float,
+                             page: int, T: int):
+    """Online-softmax over one slot's pages for T query tokens at once.
+
+    The verify-step / chunked-prefill analog of :func:`_paged_kernel`
+    (ISSUE 10): query t of slot b sits at absolute position
+    ``base[b] + t`` and may attend keys at positions ``<= base[b] + t`` —
+    the extra column dimension turns the scalar (m, l) softmax state into
+    [1, T] rows and the accumulator into [T, D], everything else is the
+    same sequential-grid accumulation. Pages wholly past ``base + T - 1``
+    skip their compute."""
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    D = q_ref.shape[-1]
+
+    @pl.when(j == 0)
+    def _reset():
+        m_ref[...] = jnp.full_like(m_ref, -1e30)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    base = base_ref[b]
+
+    @pl.when(j * page <= base + T - 1)
+    def _update():
+        q = q_ref[...].reshape(T, D)
+        k = k_ref[0, 0]  # [page, D]
+        v = v_ref[0, 0]
+        s = jnp.dot(k, q.T, preferred_element_type=jnp.float32) * sm_scale  # [page,T]
+        idx = jax.lax.broadcasted_iota(jnp.int32, (page, T), 0) + j * page
+        t_col = jax.lax.broadcasted_iota(jnp.int32, (page, T), 1)
+        s = jnp.where(idx <= base + t_col, s, -1e30)
+        m_prev, l_prev = m_ref[...], l_ref[...]           # [1, T]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=0, keepdims=True))
+        corr = jnp.exp(m_prev - m_cur)                    # [1, T]
+        p = jnp.exp(s - m_cur)                            # [page, T]
+        m_ref[...] = m_cur
+        l_ref[...] = l_prev * corr + jnp.sum(p, axis=0, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr.T + jnp.dot(
+            p.astype(v.dtype).T, v, preferred_element_type=jnp.float32
+        )
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _emit():
+        o_ref[...] = (
+            acc_ref[...] / jnp.maximum(l_ref[...].T, 1e-30)
+        ).reshape(o_ref.shape).astype(o_ref.dtype)
+
+
+def paged_multitoken_attention(
+    q: jnp.ndarray,  # [B, T, H, D] T query tokens per slot
+    k_pool: jnp.ndarray,  # [P, KV, page, D] shared page pool
+    v_pool: jnp.ndarray,  # [P, KV, page, D]
+    block_tables: jnp.ndarray,  # [B, n_pages] i32 pool-page ids per slot
+    base: jnp.ndarray,  # [B] i32: query t of slot b sits at position base[b]+t
+    sm_scale: Optional[float] = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """T-token causal attention against a PAGED cache → [B, T, H, D].
+
+    Serves the speculative verify step (T = k+1 drafted tokens, base =
+    per-slot cached length) and chunked prefill (T = chunk width, base =
+    chunk start) — the chunk's own K/V must already be scattered into the
+    pool (update-then-attend, as in the single-token decode step). GQA as
+    in :func:`paged_decode_attention`."""
+    B, T, H, D = q.shape
+    P, KV, page, _ = k_pool.shape
+    n_pages = block_tables.shape[1]
+    if H % KV != 0:
+        raise ValueError(f"q heads {H} must divide by KV heads {KV}")
+    rep = H // KV
+    scale = sm_scale if sm_scale is not None else 1.0 / (D**0.5)
+
+    kernel = functools.partial(
+        _paged_multitoken_kernel, sm_scale=float(scale), page=page, T=T
+    )
+    q4 = jnp.swapaxes(q, 1, 2)  # [B, H, T, D]: trailing block == array dims
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,  # block table + per-slot base positions
+            grid=(B, H, n_pages),
+            in_specs=[
+                pl.BlockSpec((1, 1, T, D), lambda b, h, j, bt, base: (b, h, 0, 0)),
+                pl.BlockSpec(
+                    (1, 1, page, D),
+                    lambda b, h, j, bt, base: (bt[b, j], h // rep, 0, 0),
+                ),
+                pl.BlockSpec(
+                    (1, 1, page, D),
+                    lambda b, h, j, bt, base: (bt[b, j], h // rep, 0, 0),
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, T, D), lambda b, h, j, bt, base: (b, h, 0, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((1, T), jnp.float32),  # running max per query
+                pltpu.VMEM((1, T), jnp.float32),  # running denominator
+                pltpu.VMEM((T, D), jnp.float32),  # output accumulator
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
+        interpret=interpret,
+    )(
+        jnp.asarray(block_tables, jnp.int32),
+        jnp.asarray(base, jnp.int32),
+        q4,
+        k_pool,
+        v_pool,
+    )
+    return jnp.swapaxes(out, 1, 2)  # [B, T, H, D]
+
+
 def paged_decode_attention_ok(page: int, D: int, itemsize: int = 2) -> bool:
     """Trace-time gate for the paged kernel: TPU backend, lane-friendly head
     dim, sublane-aligned page length, and one page's K+V fitting VMEM (per-
@@ -241,6 +356,20 @@ def paged_decode_attention_ok(page: int, D: int, itemsize: int = 2) -> bool:
         and D % 64 == 0
         and page % sublane == 0
         and 2 * page * D * itemsize <= VMEM_RESIDENT_BYTES
+    )
+
+
+def paged_multitoken_attention_ok(
+    page: int, D: int, T: int, itemsize: int = 2
+) -> bool:
+    """Gate for the multitoken paged kernel: the single-token gate plus the
+    [T, D] query/accumulator slabs staying VMEM-resident."""
+    from .flash_attention import VMEM_RESIDENT_BYTES
+
+    return (
+        paged_decode_attention_ok(page, D, itemsize)
+        and (2 * page * D * itemsize + T * D * (itemsize + 4)
+             <= VMEM_RESIDENT_BYTES)
     )
 
 

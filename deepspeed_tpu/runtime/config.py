@@ -593,7 +593,9 @@ class AnalysisConfig(DSConfigModel):
     ``min_alias_fraction`` is the byte-fraction of large donated inputs
     that must actually alias an output before ``donation-honored`` trips.
     ``max_train_programs`` bounds the jit cache (``static-shapes``);
-    serving is always budgeted at exactly 2 executables."""
+    ``max_serving_programs`` is the serving executable budget, checked
+    EXACTLY (0 = auto: track the engine's enabled feature set — 2 base
+    programs + speculative verify + chunked prefill; ISSUE 10)."""
 
     enabled: bool = True
     baseline: str = ".dslint-baseline.json"
@@ -602,6 +604,9 @@ class AnalysisConfig(DSConfigModel):
     min_alias_fraction: float = 0.5
     min_donatable_param_bytes: int = 1 << 14
     max_train_programs: int = 4
+    # serving executable-count budget, exact-checked by ServingEngine.verify()
+    # (0 = auto: the engine's expected count for its enabled features)
+    max_serving_programs: int = 0
     upcast_allow: str = "softmax|loss|norm|logit|cumsum"
     hot_function_patterns: List[str] = field(default_factory=list)  # [] = built-in defaults
     donate_name_patterns: List[str] = field(default_factory=list)   # [] = built-in defaults
@@ -625,6 +630,11 @@ class AnalysisConfig(DSConfigModel):
             raise DeepSpeedConfigError(
                 "analysis.max_train_programs must be >= 1, got "
                 f"{self.max_train_programs}"
+            )
+        if self.max_serving_programs < 0:
+            raise DeepSpeedConfigError(
+                "analysis.max_serving_programs must be >= 0 (0 = auto), got "
+                f"{self.max_serving_programs}"
             )
 
 
@@ -700,12 +710,66 @@ class ResilienceConfig(DSConfigModel):
 
 
 @dataclass
+class SpeculativeConfig(DSConfigModel):
+    """serving.speculative section (ISSUE 10): self-speculative multi-token
+    decode. The scheduler proposes ``k`` draft tokens per slot host-side
+    (prompt-lookup: the continuation of the last ``ngram``-gram's previous
+    occurrence in prompt+output) and ONE compiled ``paged_verify_step``
+    scores all k+1 positions in a single forward pass, accepting the longest
+    matching prefix — decode is memory-bound (PR-5 roofline), so verifying k
+    extra tokens is nearly free and an accepted draft advances a slot
+    several tokens per step. Greedy-only: requires ``temperature == 0`` (the
+    accept rule compares argmax streams; the output is bit-identical to the
+    sequential decode path, which sampling would break)."""
+
+    enabled: bool = False
+    k: int = 4        # drafted tokens verified per step (queries = k+1)
+    ngram: int = 2    # host-side prompt-lookup match length
+
+    def __post_init__(self):
+        if not 1 <= int(self.k) <= 16:
+            raise DeepSpeedConfigError(
+                f"serving.speculative.k must be in [1, 16], got {self.k}"
+            )
+        if int(self.ngram) < 1:
+            raise DeepSpeedConfigError(
+                f"serving.speculative.ngram must be >= 1, got {self.ngram}"
+            )
+
+
+@dataclass
+class PrefixCacheConfig(DSConfigModel):
+    """serving.prefix_cache section (ISSUE 10): shared-prefix KV reuse.
+    Full pages of a prompt's K/V are registered in a chained-hash index
+    after prefill; a later prompt sharing that page-aligned prefix maps the
+    pages into its own block table (refcounted — the allocator returns a
+    page to the free list only when every slot AND the index released it)
+    and prefills only the tail. A full-prefix hit copy-on-write-forks the
+    last shared page (the slot's own writes land in the fork; the shared
+    original stays immutable) and costs one decode step instead of a
+    prefill — the TTFT collapse. ``max_pages`` bounds the index's held
+    pages (0 = no explicit cap; under pool pressure cold entries are
+    evicted LRU-leaf-first regardless)."""
+
+    enabled: bool = False
+    max_pages: int = 0
+
+    def __post_init__(self):
+        if int(self.max_pages) < 0:
+            raise DeepSpeedConfigError(
+                "serving.prefix_cache.max_pages must be >= 0, got "
+                f"{self.max_pages}"
+            )
+
+
+@dataclass
 class ServingConfig(DSConfigModel):
     """serving section (TPU-native; no reference analog — the reference serves
     one static batch per ``InferenceEngine.forward`` call). Drives the
     continuous-batching scheduler + paged KV cache (``serving/``): a slot-based
-    decode loop compiled EXACTLY TWICE (one prefill program, one decode-step
-    program, both shaped by this section alone), a shared KV page pool with a
+    decode loop over a fixed set of AOT-compiled programs (prefill, decode
+    step, and — when enabled — speculative verify and chunked prefill, all
+    shaped by this section alone), a shared KV page pool with a
     free-list allocator, and admission control.
 
     Sizing: the pool holds ``num_pages`` pages of ``page_size`` tokens (page 0
@@ -740,6 +804,17 @@ class ServingConfig(DSConfigModel):
     # (retry_backoff_s * 2^(retries-1)); 0 = transient failures are terminal
     retry_max: int = 0
     retry_backoff_s: float = 0.05
+    # --- ISSUE 10: serving hot-path shape changes --------------------------
+    # self-speculative multi-token decode (greedy-only; +1 verify executable)
+    speculative: SpeculativeConfig = field(default_factory=SpeculativeConfig)
+    # shared-prefix KV reuse over the page pool (+1 chunk-prefill executable)
+    prefix_cache: PrefixCacheConfig = field(default_factory=PrefixCacheConfig)
+    # > 0: long prompts prefill in page-rounded chunks of this many tokens,
+    # one chunk per scheduler step, interleaved with decode — a long prompt
+    # stops stalling co-resident decode slots (TPOT invariance). 0 keeps the
+    # whole-prompt prefill; prefix-cache tails always use the chunk program
+    # (width = this value when set, else one page).
+    prefill_chunk_tokens: int = 0
 
     def __post_init__(self):
         for key in ("max_slots", "page_size", "num_pages", "max_prompt_len",
@@ -749,6 +824,22 @@ class ServingConfig(DSConfigModel):
         if self.num_pages < 2:
             raise DeepSpeedConfigError(
                 "serving.num_pages must be >= 2 (page 0 is reserved scratch)"
+            )
+        if isinstance(self.speculative, dict):
+            self.speculative = SpeculativeConfig.from_dict(self.speculative)
+        if isinstance(self.prefix_cache, dict):
+            self.prefix_cache = PrefixCacheConfig.from_dict(self.prefix_cache)
+        if int(self.prefill_chunk_tokens) < 0:
+            raise DeepSpeedConfigError(
+                "serving.prefill_chunk_tokens must be >= 0, got "
+                f"{self.prefill_chunk_tokens}"
+            )
+        if self.speculative.enabled and float(self.temperature) > 0.0:
+            raise DeepSpeedConfigError(
+                "serving.speculative requires temperature == 0 (greedy): the "
+                "verify step accepts drafts by argmax comparison, which is "
+                "only bit-identical to sequential decode under greedy "
+                "sampling"
             )
 
 

@@ -3,25 +3,33 @@
 The layer above the kernels that wins serving throughput at scale (PAPERS.md
 2207.00032), designed TPU-natively around XLA's static shapes (2605.25645):
 
-- :mod:`~deepspeed_tpu.serving.kv_cache` — page-pool allocator + block tables
-- :mod:`~deepspeed_tpu.serving.model` — the two compiled-once model programs
-  (paged prefill, batched paged decode step) + the bucket-padded offline
-  ``generate``
+- :mod:`~deepspeed_tpu.serving.kv_cache` — refcounted page-pool allocator,
+  block tables, and the shared-prefix index (:class:`PrefixCache`)
+- :mod:`~deepspeed_tpu.serving.model` — the compiled-once model programs
+  (paged prefill, batched paged decode step, speculative multi-token verify,
+  chunked prefill) + the bucket-padded offline ``generate``
 - :mod:`~deepspeed_tpu.serving.scheduler` — :class:`ServingEngine`: slots,
-  admission control, deadlines, telemetry
+  admission control, deadlines, speculation drafts, telemetry
 - :mod:`~deepspeed_tpu.serving.request` — request lifecycle
 
 Entry point: ``deepspeed_tpu.init_inference(...).serve(serving_config)``, or
 the ``serving`` section of the engine config. See docs/SERVING.md.
 """
 
-from .kv_cache import PageAllocator, PageAllocatorError, SlotTable, pages_for
+from .kv_cache import (
+    PageAllocator,
+    PageAllocatorError,
+    PrefixCache,
+    SlotTable,
+    pages_for,
+)
 from .request import Request, RequestStatus
 from .scheduler import ServingEngine
 
 __all__ = [
     "PageAllocator",
     "PageAllocatorError",
+    "PrefixCache",
     "Request",
     "RequestStatus",
     "ServingEngine",

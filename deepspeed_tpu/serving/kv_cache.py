@@ -18,7 +18,8 @@ ever reads.
 
 from __future__ import annotations
 
-from typing import Any, List
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -31,11 +32,16 @@ class PageAllocatorError(RuntimeError):
 
 
 class PageAllocator:
-    """Free-list allocator over pages ``1..num_pages-1`` (0 = scratch).
+    """Refcounted free-list allocator over pages ``1..num_pages-1`` (0 =
+    scratch).
 
     LIFO reuse (a freshly-freed page is the next handed out) keeps the hot
-    working set small. ``alloc`` is all-or-nothing; ``free`` rejects
-    double-frees and foreign ids — the invariants the drain test asserts.
+    working set small. ``alloc`` is all-or-nothing and hands out pages at
+    refcount 1; ``retain`` adds a reference (a second slot, or the prefix
+    index, mapping an existing page — ISSUE 10 shared-prefix reuse);
+    ``free`` drops one reference and returns the page to the free list only
+    at refcount 0. Double-frees, foreign ids, and retaining a free page all
+    raise — the invariants the drain/sharing tests assert.
     """
 
     def __init__(self, num_pages: int):
@@ -43,7 +49,8 @@ class PageAllocator:
             raise ValueError(f"num_pages must be >= 2 (page 0 is scratch), got {num_pages}")
         self.num_pages = int(num_pages)
         self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
-        self._in_use: set = set()
+        self._refs: Dict[int, int] = {}  # page -> refcount (in-use pages only)
+        self.cow_forks_total = 0  # bumped by the scheduler's COW path
 
     @property
     def capacity(self) -> int:
@@ -56,7 +63,15 @@ class PageAllocator:
 
     @property
     def pages_in_use(self) -> int:
-        return len(self._in_use)
+        return len(self._refs)
+
+    @property
+    def pages_shared(self) -> int:
+        """In-use pages referenced by more than one holder."""
+        return sum(1 for c in self._refs.values() if c > 1)
+
+    def refcount(self, page: int) -> int:
+        return self._refs.get(int(page), 0)
 
     def alloc(self, n: int) -> List[int]:
         if n < 0:
@@ -67,21 +82,52 @@ class PageAllocator:
                 f"of {self.capacity}"
             )
         pages = [self._free.pop() for _ in range(n)]
-        self._in_use.update(pages)
+        for p in pages:
+            self._refs[p] = 1
         return pages
 
-    def free(self, pages: List[int]) -> None:
+    def retain(self, pages: Sequence[int]) -> None:
+        """Add one reference per page (sharing an already-allocated page)."""
         for p in pages:
+            p = int(p)
+            if p == SCRATCH_PAGE:
+                raise PageAllocatorError("cannot retain the scratch page")
+            if p not in self._refs:
+                raise PageAllocatorError(f"retain of free/foreign page {p}")
+        for p in pages:
+            self._refs[int(p)] += 1
+
+    def free(self, pages: Sequence[int]) -> None:
+        """Drop one reference per page; a page returns to the free list only
+        when its LAST holder frees it."""
+        for p in pages:
+            p = int(p)
             if p == SCRATCH_PAGE:
                 raise PageAllocatorError("cannot free the scratch page")
-            if p not in self._in_use:
+            if p not in self._refs:
                 raise PageAllocatorError(f"double free / foreign page {p}")
-            self._in_use.remove(p)
-            self._free.append(p)
+        for p in pages:
+            p = int(p)
+            self._refs[p] -= 1
+            if self._refs[p] == 0:
+                del self._refs[p]
+                self._free.append(p)
 
-    def check_no_leaks(self) -> None:
-        if self._in_use:
-            raise PageAllocatorError(f"leaked pages: {sorted(self._in_use)}")
+    def check_no_leaks(self, allowed: Optional[Sequence[int]] = None) -> None:
+        """Raise unless every in-use page is in ``allowed`` (default: none) —
+        and every allowed page holds EXACTLY one reference (the holder that
+        declared it, e.g. the prefix index after all slots drained)."""
+        allowed_set = {int(p) for p in (allowed or ())}
+        leaked = sorted(p for p in self._refs if p not in allowed_set)
+        if leaked:
+            raise PageAllocatorError(f"leaked pages: {leaked}")
+        over = sorted(
+            (p, c) for p, c in self._refs.items() if c != 1
+        )
+        if over:
+            raise PageAllocatorError(
+                f"pages with nonzero extra refcounts at drain: {over}"
+            )
 
 
 class SlotTable:
@@ -145,3 +191,192 @@ def pool_bytes(
 ) -> int:
     """HBM footprint of K+V pools (sizing aid for the ``serving`` config)."""
     return 2 * n_layer * num_pages * n_kv_head * page_size * head_dim * itemsize
+
+
+# ---------------------------------------------------------------------------
+# shared-prefix index (ISSUE 10)
+# ---------------------------------------------------------------------------
+
+
+class PrefixCache:
+    """Chained-hash index over FULL prompt pages: hash(parent, page tokens)
+    → pool page holding that page's K/V.
+
+    The production shape this serves: millions of users sharing system
+    prompts. After a prompt prefills, each full page of it is registered
+    here (the index ``retain``s the page, so it outlives the request); a
+    later prompt walks its own pages through the chain and maps every
+    matching page into its block table instead of recomputing it. Sharing
+    is deterministic-by-construction — the same tokens at the same
+    positions produce bit-identical K/V, so a mapped page IS the page
+    prefill would have written.
+
+    Only pages strictly before the prompt's last token are ever returned by
+    :meth:`lookup` (``(plen-1)//page`` cap): the tail always re-runs through
+    the model so the first sampled token has logits, and a full-prefix hit
+    (prompt == an indexed chain, page-aligned) is handled by the scheduler's
+    copy-on-write path instead.
+
+    Eviction: LRU among LEAF entries only (an interior page stays as long
+    as any longer chain extends it — evicting a parent would orphan its
+    descendants). ``max_pages`` bounds the held set; the scheduler also
+    evicts on pool pressure.
+    """
+
+    def __init__(self, allocator: PageAllocator, page_size: int,
+                 max_pages: int = 0):
+        self.allocator = allocator
+        self.page_size = int(page_size)
+        self.max_pages = int(max_pages)
+        # key -> page id; OrderedDict gives LRU order (move_to_end on hit)
+        self._entries: "OrderedDict[Tuple, int]" = OrderedDict()
+        self._children: Dict[Tuple, int] = {}  # key -> # direct extensions
+        self._parent: Dict[Tuple, Optional[Tuple]] = {}
+        self.hits_full = 0
+        self.hits_partial = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def held_pages(self) -> List[int]:
+        return list(self._entries.values())
+
+    @staticmethod
+    def _key(parent: Optional[Tuple], tokens: np.ndarray) -> Tuple:
+        return (parent, tuple(int(t) for t in tokens))
+
+    def lookup(self, prompt: np.ndarray) -> Tuple[List[int], int, Optional[int]]:
+        """→ (shared page ids, shared token count, cow_page).
+
+        The shared pages are the longest indexed page-aligned prefix of
+        ``prompt``, capped so the last prompt token always stays in the tail
+        (its logits must be recomputed). ``cow_page``: when the prompt is
+        exactly page-aligned and the index also holds its LAST page (a
+        full-prefix hit), that page's id — the scheduler copy-on-write-forks
+        it instead of re-prefilling the tail, collapsing TTFT to one decode
+        step."""
+        plen = int(np.asarray(prompt).shape[-1])
+        page = self.page_size
+        limit = max(0, (plen - 1) // page)  # last token never shared
+        pages: List[int] = []
+        parent: Optional[Tuple] = None
+        for j in range(limit):
+            key = self._key(parent, prompt[j * page:(j + 1) * page])
+            pid = self._entries.get(key)
+            if pid is None:
+                break
+            self._entries.move_to_end(key)
+            pages.append(pid)
+            parent = key
+        cow_page: Optional[int] = None
+        # a full hit needs mappable pages to be worth anything: a one-page
+        # prompt (limit == 0) has nothing to reuse — the tail IS the prompt —
+        # so it reports a plain miss rather than a phantom COW fork
+        if pages and len(pages) == limit and plen % page == 0:
+            key = self._key(parent, prompt[limit * page: plen])
+            pid = self._entries.get(key)
+            if pid is not None:
+                self._entries.move_to_end(key)
+                cow_page = pid
+        if cow_page is not None:
+            self.hits_full += 1
+        elif pages:
+            self.hits_partial += 1
+        else:
+            self.misses += 1
+        return pages, len(pages) * page, cow_page
+
+    def probe(self, prompt: np.ndarray) -> int:
+        """Non-mutating :meth:`lookup`: how many pages a lookup would map
+        right now (no hit/miss counters, no LRU refresh) — the admission
+        gate calls this every step while a request heads the queue."""
+        plen = int(np.asarray(prompt).shape[-1])
+        page = self.page_size
+        limit = max(0, (plen - 1) // page)
+        parent: Optional[Tuple] = None
+        n = 0
+        for j in range(limit):
+            key = self._key(parent, prompt[j * page:(j + 1) * page])
+            if key not in self._entries:
+                break
+            n += 1
+            parent = key
+        return n
+
+    def insert(self, prompt: np.ndarray, pages: Sequence[int],
+               n_tokens: Optional[int] = None) -> int:
+        """Register the full pages of ``prompt`` (whose K/V lives in
+        ``pages``, the slot's block-table prefix). Pages already indexed are
+        refreshed; new ones are ``retain``ed by the index. Returns the
+        number of newly indexed pages."""
+        page = self.page_size
+        plen = int(np.asarray(prompt).shape[-1]) if n_tokens is None else int(n_tokens)
+        n_full = min(plen // page, len(pages))
+        parent: Optional[Tuple] = None
+        added = 0
+        for j in range(n_full):
+            key = self._key(parent, prompt[j * page:(j + 1) * page])
+            if key in self._entries:
+                self._entries.move_to_end(key)
+            else:
+                pid = int(pages[j])
+                self.allocator.retain([pid])
+                self._entries[key] = pid
+                self._parent[key] = parent
+                self._children[key] = 0
+                if parent is not None:
+                    self._children[parent] += 1
+                added += 1
+            parent = key
+        if self.max_pages > 0:
+            self.evict(keep=self.max_pages)
+        return added
+
+    def _evict_one(self) -> bool:
+        """Release the least-recently-used LEAF entry. → False if none."""
+        for key in self._entries:  # insertion(/recency) order
+            if self._children.get(key, 0) == 0:
+                pid = self._entries.pop(key)
+                parent = self._parent.pop(key)
+                self._children.pop(key, None)
+                if parent is not None and parent in self._children:
+                    self._children[parent] -= 1
+                self.allocator.free([pid])
+                self.evictions += 1
+                return True
+        return False
+
+    def evict(self, keep: Optional[int] = None, need_free: int = 0) -> int:
+        """Evict LRU leaves until the index holds ≤ ``keep`` entries (when
+        given) and the allocator has ≥ ``need_free`` free pages (when
+        given) — each independent goal stops mattering once met, so a
+        pure ``need_free`` call frees only as much as pool pressure
+        demands instead of dumping the cache. An evicted page only frees
+        if the index held its last reference. → entries evicted."""
+        n = 0
+        while self._entries:
+            over_cap = keep is not None and len(self._entries) > keep
+            starved = need_free > 0 and self.allocator.free_pages < need_free
+            if not (over_cap or starved):
+                break
+            if not self._evict_one():
+                break
+            n += 1
+        return n
+
+    def clear(self) -> int:
+        """Release every index reference (teardown / leak accounting)."""
+        return self.evict(keep=0)
+
+    def host_metadata_bytes(self) -> int:
+        """Rough host-side footprint of the index structures (Engine E's
+        ledger reports it alongside the HLO-derived device categories)."""
+        import sys
+
+        total = sys.getsizeof(self._entries)
+        for key in self._entries:
+            total += sys.getsizeof(key) + 2 * len(key[1] or ()) * 28
+        return total

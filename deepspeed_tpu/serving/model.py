@@ -23,6 +23,17 @@ a slot's length is either masked or overwritten by the decode write before
 that position is ever attended. The attention lines below deliberately
 mirror ``gpt2._attention_cached`` (same einsums, same casts, same mask
 compare) so the two paths cannot drift.
+
+Why the layer loop is UNROLLED (ISSUE 10 perf fix): scanning the pools as
+``lax.scan`` xs/ys stacks a freshly-written FULL pool as the scan output —
+every program call paid O(pool bytes) of copy traffic even with donation
+(~170 ms/step at a 151 MB pool, linear in ``num_pages``). With a static
+python loop the pools are plain dataflow values updated by per-layer
+scatters into donated buffers: per-call cost scales with the pages
+actually touched, not the pool (38x on the bench config), which is the
+whole point of paging. n_layer is static and small, so the unroll's
+compile-time cost is bounded; the arithmetic per layer is unchanged, so
+token streams are unaffected (the equivalence tests pin this).
 """
 
 from __future__ import annotations
@@ -46,20 +57,27 @@ PyTree = Any
 # paged prefill (one request into one slot's pages)
 # ---------------------------------------------------------------------------
 
-def _attention_prefill_paged(cfg, lp, h, k_pool_l, v_pool_l, page_ids):
-    """Causal self-attention over the prompt chunk; K/V written to pages.
+def _layer_params(params: PyTree, l: int) -> PyTree:
+    """Layer ``l``'s slice of the stacked block params (static index — XLA
+    folds the slices into their consumers)."""
+    return jax.tree_util.tree_map(lambda x: x[l], params["blocks"])
+
+
+def _attention_prefill_paged(cfg, lp, h, k_pool, v_pool, page_ids, l):
+    """Causal self-attention over the prompt chunk; K/V written to layer
+    ``l``'s pages of the FULL pool.
 
     The chunk starts at position 0 of a fresh slot, so "the cache" IS the
     chunk — the dense causal einsum here is exactly ``_attention_cached``'s
     prefill path with ``pos = 0`` and ``Smax = Sp``."""
     B, Sp, E = h.shape
     H, D = cfg.n_head, cfg.head_dim
-    page = k_pool_l.shape[2]
+    page = k_pool.shape[3]
     qkv = h @ _deq(lp["c_attn_w"], h.dtype) + lp["c_attn_b"]
     q, k_, v = jnp.split(qkv, 3, axis=-1)
     q = q.reshape(B, Sp, H, D)
-    k_c = k_.reshape(B, Sp, H, D).astype(k_pool_l.dtype)
-    v_c = v.reshape(B, Sp, H, D).astype(v_pool_l.dtype)
+    k_c = k_.reshape(B, Sp, H, D).astype(k_pool.dtype)
+    v_c = v.reshape(B, Sp, H, D).astype(v_pool.dtype)
 
     # page-granular scatter: [Sp,H,D] → [n_pp, H, page, D] rows of the pool.
     # Whole pages are overwritten — a slot's pages are fresh at admission and
@@ -67,9 +85,9 @@ def _attention_prefill_paged(cfg, lp, h, k_pool_l, v_pool_l, page_ids):
     # padded page_ids point at the scratch page.
     n_pp = Sp // page
     chunks = jnp.swapaxes(k_c[0].reshape(n_pp, page, H, D), 1, 2)
-    k_pool_l = k_pool_l.at[page_ids].set(chunks)
+    k_pool = k_pool.at[l, page_ids].set(chunks)
     chunks_v = jnp.swapaxes(v_c[0].reshape(n_pp, page, H, D), 1, 2)
-    v_pool_l = v_pool_l.at[page_ids].set(chunks_v)
+    v_pool = v_pool.at[l, page_ids].set(chunks_v)
 
     scale = 1.0 / np.sqrt(D)
     scores = jnp.einsum(
@@ -82,7 +100,7 @@ def _attention_prefill_paged(cfg, lp, h, k_pool_l, v_pool_l, page_ids):
     probs = jax.nn.softmax(scores, axis=-1).astype(v_c.dtype)
     o = jnp.einsum("bhst,bthd->bshd", probs, v_c)
     o = o.reshape(B, Sp, E).astype(h.dtype)
-    return o @ _deq(lp["c_proj_w"], h.dtype) + lp["c_proj_b"], k_pool_l, v_pool_l
+    return o @ _deq(lp["c_proj_w"], h.dtype) + lp["c_proj_b"], k_pool, v_pool
 
 
 def paged_prefill(
@@ -104,12 +122,12 @@ def paged_prefill(
     positions = jnp.arange(Sp)
     h = params["wte"][input_ids] + params["wpe"][positions][None, :, :]
 
-    def body(h, xs):
-        lp, kp, vp = xs
-        a, kp, vp = _attention_prefill_paged(
+    for l in range(cfg.n_layer):
+        lp = _layer_params(params, l)
+        a, k_pool, v_pool = _attention_prefill_paged(
             cfg, lp["attn"],
             _layer_norm(h, lp["ln_1"]["scale"], lp["ln_1"]["bias"], eps),
-            kp, vp, page_ids,
+            k_pool, v_pool, page_ids, l,
         )
         h = h + a
         m, _aux = _mlp(
@@ -117,41 +135,28 @@ def paged_prefill(
             _layer_norm(h, lp["ln_2"]["scale"], lp["ln_2"]["bias"], eps),
             False, None,
         )
-        return h + m, (kp, vp)
+        h = h + m
 
-    h, (new_k, new_v) = lax.scan(body, h, (params["blocks"], k_pool, v_pool))
     h_last = jnp.take(h, prompt_len - 1, axis=1)  # [B, E] true last prompt pos
     h_last = _layer_norm(h_last, params["ln_f"]["scale"], params["ln_f"]["bias"], eps)
     logits = (h_last @ params["wte"].T)[..., : cfg.vocab_size]
     first = sample_logits(logits, rng, temperature, top_k, top_p)
-    return new_k, new_v, first
+    return k_pool, v_pool, first
 
 
 # ---------------------------------------------------------------------------
 # paged decode step (one token for every slot)
 # ---------------------------------------------------------------------------
 
-def _attention_decode_paged(cfg, lp, h, k_pool_l, v_pool_l, block_tables,
-                            pos, pidx, poff):
-    """One-token attention per slot against its paged cache.
+def _attend_decode_shaped(cfg, q, k_pool_l, v_pool_l, block_tables, pos,
+                          out_dtype):
+    """ONE query token per slot against the paged cache → [B, 1, E].
 
-    ``pos[b]`` = tokens already cached for slot b (the new token's position);
-    new K/V scatters to (page ``pidx[b]``, offset ``poff[b]``) before the
-    gather, mirroring ``_attention_cached``'s update-then-attend order."""
-    B, S, E = h.shape  # S == 1
-    H, D = cfg.n_head, cfg.head_dim
-    qkv = h @ _deq(lp["c_attn_w"], h.dtype) + lp["c_attn_b"]
-    q, k_, v = jnp.split(qkv, 3, axis=-1)
-    q = q.reshape(B, S, H, D)
-    k_c = k_.reshape(B, S, H, D).astype(k_pool_l.dtype)
-    v_c = v.reshape(B, S, H, D).astype(v_pool_l.dtype)
-
-    # [B,H,D] values to (pidx[b], :, poff[b], :) — advanced indices around the
-    # head slice put the batch dim first, matching the value layout. Inactive
-    # slots target the scratch page.
-    k_pool_l = k_pool_l.at[pidx, :, poff].set(k_c[:, 0])
-    v_pool_l = v_pool_l.at[pidx, :, poff].set(v_c[:, 0])
-
+    The decode step's attention, factored so the speculative verify step
+    can attend each of its T queries through EXACTLY this code — same
+    shapes, same XLA reduction trees, same bits (ISSUE 10)."""
+    B, S, H, D = q.shape  # S == 1
+    E = H * D
     scale = 1.0 / np.sqrt(D)
     if cfg.attn_impl in ("auto", "pallas"):
         from ..ops.attention import paged_cached_attention
@@ -160,8 +165,7 @@ def _attention_decode_paged(cfg, lp, h, k_pool_l, v_pool_l, block_tables,
             q[:, 0], k_pool_l, v_pool_l, block_tables, pos,
             impl=cfg.attn_impl, sm_scale=scale,
         )
-        o = o1.reshape(B, 1, E).astype(h.dtype)
-        return o @ _deq(lp["c_proj_w"], h.dtype) + lp["c_proj_b"], k_pool_l, v_pool_l
+        return o1.reshape(B, 1, E).astype(out_dtype)
 
     # jnp impl: gather the slot's pages into the dense view and run the exact
     # dense einsum of _attention_cached's decode path, with a per-slot mask.
@@ -181,8 +185,35 @@ def _attention_decode_paged(cfg, lp, h, k_pool_l, v_pool_l, block_tables,
     scores = jnp.where(mask[:, None, None, :], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(vd.dtype)
     o = jnp.einsum("bhst,bthd->bshd", probs, vd)
-    o = o.reshape(B, S, E).astype(h.dtype)
-    return o @ _deq(lp["c_proj_w"], h.dtype) + lp["c_proj_b"], k_pool_l, v_pool_l
+    return o.reshape(B, S, E).astype(out_dtype)
+
+
+def _attention_decode_paged(cfg, lp, h, k_pool, v_pool, block_tables,
+                            pos, pidx, poff, l):
+    """One-token attention per slot against its paged cache (layer ``l`` of
+    the FULL pool).
+
+    ``pos[b]`` = tokens already cached for slot b (the new token's position);
+    new K/V scatters to (page ``pidx[b]``, offset ``poff[b]``) before the
+    gather, mirroring ``_attention_cached``'s update-then-attend order."""
+    B, S, E = h.shape  # S == 1
+    H, D = cfg.n_head, cfg.head_dim
+    qkv = h @ _deq(lp["c_attn_w"], h.dtype) + lp["c_attn_b"]
+    q, k_, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, S, H, D)
+    k_c = k_.reshape(B, S, H, D).astype(k_pool.dtype)
+    v_c = v.reshape(B, S, H, D).astype(v_pool.dtype)
+
+    # [B,H,D] values to (l, pidx[b], :, poff[b], :) — advanced indices around
+    # the head slice put the batch dim first, matching the value layout.
+    # Inactive slots target the scratch page.
+    k_pool = k_pool.at[l, pidx, :, poff].set(k_c[:, 0])
+    v_pool = v_pool.at[l, pidx, :, poff].set(v_c[:, 0])
+
+    o = _attend_decode_shaped(
+        cfg, q, k_pool[l], v_pool[l], block_tables, pos, h.dtype
+    )
+    return o @ _deq(lp["c_proj_w"], h.dtype) + lp["c_proj_b"], k_pool, v_pool
 
 
 def paged_decode_step(
@@ -208,12 +239,12 @@ def paged_decode_step(
     )[:, 0]
     poff = seq_lens % page
 
-    def body(h, xs):
-        lp, kp, vp = xs
-        a, kp, vp = _attention_decode_paged(
+    for l in range(cfg.n_layer):
+        lp = _layer_params(params, l)
+        a, k_pool, v_pool = _attention_decode_paged(
             cfg, lp["attn"],
             _layer_norm(h, lp["ln_1"]["scale"], lp["ln_1"]["bias"], eps),
-            kp, vp, block_tables, seq_lens, pidx, poff,
+            k_pool, v_pool, block_tables, seq_lens, pidx, poff, l,
         )
         h = h + a
         m, _aux = _mlp(
@@ -221,9 +252,8 @@ def paged_decode_step(
             _layer_norm(h, lp["ln_2"]["scale"], lp["ln_2"]["bias"], eps),
             False, None,
         )
-        return h + m, (kp, vp)
+        h = h + m
 
-    h, (new_k, new_v) = lax.scan(body, h, (params["blocks"], k_pool, v_pool))
     h_last = _layer_norm(
         h[:, -1], params["ln_f"]["scale"], params["ln_f"]["bias"], eps
     )
@@ -239,7 +269,250 @@ def paged_decode_step(
                 lg[None, :], kk, temperature, top_k, top_p
             )[0]
         )(logits, keys)
-    return new_k, new_v, nxt
+    return k_pool, v_pool, nxt
+
+
+# ---------------------------------------------------------------------------
+# multi-token programs (ISSUE 10): speculative verify + chunked prefill.
+#
+# Both process T tokens per slot in ONE pass with the update-then-attend
+# order of the decode step: scatter the T tokens' K/V into the pool, then
+# attend with the causal per-query mask idx <= base + t. The batched
+# matmuls (QKV, MLP, logits — where the decode step's memory-boundness
+# leaves the MXU idle) are row-independent across the query dim, so each
+# row's bits equal the single-token step's. Attention is the one op where
+# the query count changes a REDUCTION shape (the softmax normalizer), and
+# XLA's reduction tree — hence the low-order bits — depends on that shape;
+# the verify step therefore attends its T queries as T unrolled
+# single-token calls (exact decode-step shapes → exact decode-step bits,
+# the property the greedy-equivalence contract rests on), while chunked
+# prefill keeps the batched form and pins token-level identity in tests
+# (chunking reorders prefill arithmetic at the ulp level by nature —
+# trading bit-exact hidden states for not stalling the decode batch).
+# ---------------------------------------------------------------------------
+
+
+def _attend_multitoken_paged(cfg, h, q, k_pool_l, v_pool_l,
+                             block_tables, base):
+    """Batched attention tail of the chunk-prefill program: q [B,T,H,D]
+    against the (already updated) paged cache, masked per query. The
+    caller applies the output projection.
+
+    Dispatch mirrors ``_attention_decode_paged`` branch for branch; see the
+    block comment above for why this form is token-identical but not
+    bit-identical across chunking boundaries."""
+    B, T, E = h.shape
+    H, D = cfg.n_head, cfg.head_dim
+    scale = 1.0 / np.sqrt(D)
+    if cfg.attn_impl in ("auto", "pallas"):
+        from ..ops.attention import paged_multitoken_cached_attention
+
+        o = paged_multitoken_cached_attention(
+            q, k_pool_l, v_pool_l, block_tables, base,
+            impl=cfg.attn_impl, sm_scale=scale,
+        )
+        return o.reshape(B, T, E).astype(h.dtype)
+
+    # jnp impl: dense gather + the exact einsum/cast structure of
+    # _attention_decode_paged's jnp branch, extended to T query rows (see
+    # that branch for why this is NOT deduplicated into the dispatcher)
+    kd = jnp.swapaxes(k_pool_l[block_tables], 2, 3).reshape(B, -1, H, D)
+    vd = jnp.swapaxes(v_pool_l[block_tables], 2, 3).reshape(B, -1, H, D)
+    Smax = kd.shape[1]
+    scores = jnp.einsum(
+        "bshd,bthd->bhst", q.astype(jnp.float32), kd.astype(jnp.float32)
+    ) * scale
+    mask = (
+        jnp.arange(Smax)[None, None, :]
+        <= base[:, None, None] + jnp.arange(T)[None, :, None]
+    )  # [B, T, Smax]
+    scores = jnp.where(mask[:, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(vd.dtype)
+    o = jnp.einsum("bhst,bthd->bshd", probs, vd)
+    return o.reshape(B, T, E).astype(h.dtype)
+
+
+def _attention_verify_paged(cfg, lp, h, k_pool, v_pool, block_tables,
+                            base, pidx, poff, l):
+    """T-token attention per slot: scatter every token's K/V to layer ``l``
+    at (``pidx[b,t]``, ``poff[b,t]``), then attend query t at position
+    ``base + t`` through the block table. Out-of-budget positions arrive
+    with ``pidx`` already routed to the scratch page (see
+    :func:`_verify_write_targets`).
+
+    The T attention calls are UNROLLED single-token ``_attend_decode_shaped``
+    invocations — identical shapes to the decode step, hence identical bits;
+    query t's mask (``idx <= base + t``) hides the already-scattered K/V of
+    queries > t exactly as it hides any other stale cache content, so
+    scatter-all-then-attend equals the sequential write-attend interleaving
+    bit for bit. The QKV matmul above and projection below stay batched over
+    T — the arithmetic-intensity win speculation exists for."""
+    B, T, E = h.shape
+    H, D = cfg.n_head, cfg.head_dim
+    qkv = h @ _deq(lp["c_attn_w"], h.dtype) + lp["c_attn_b"]
+    q, k_, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, T, H, D)
+    k_c = k_.reshape(B, T, H, D).astype(k_pool.dtype)
+    v_c = v.reshape(B, T, H, D).astype(v_pool.dtype)
+    # [B,T,H,D] values to (l, pidx[b,t], :, poff[b,t], :): the advanced
+    # index pair around the head slice puts (B,T) first, matching the value
+    # layout
+    k_pool = k_pool.at[l, pidx, :, poff].set(k_c)
+    v_pool = v_pool.at[l, pidx, :, poff].set(v_c)
+    k_l, v_l = k_pool[l], v_pool[l]
+    o = jnp.concatenate(
+        [
+            _attend_decode_shaped(
+                cfg, q[:, t:t + 1], k_l, v_l, block_tables,
+                base + t, h.dtype,
+            )
+            for t in range(T)
+        ],
+        axis=1,
+    )
+    return o @ _deq(lp["c_proj_w"], h.dtype) + lp["c_proj_b"], k_pool, v_pool
+
+
+def _verify_write_targets(seq_lens, block_tables, page: int, T: int):
+    """→ (pidx [B,T], poff [B,T]) write targets for tokens at positions
+    ``seq_lens + t``. Positions past the block-table row (a draft running
+    past the slot's reservation — the scheduler never emits those tokens)
+    route to the scratch page instead of clamping into a REAL page, which
+    would corrupt live cache entries."""
+    B, W = block_tables.shape
+    pos = seq_lens[:, None] + jnp.arange(T)[None, :]  # [B, T]
+    page_i = pos // page
+    safe = page_i < W
+    gathered = jnp.take_along_axis(
+        block_tables, jnp.minimum(page_i, W - 1), axis=1
+    )
+    pidx = jnp.where(safe, gathered, 0)  # 0 = scratch page
+    return pidx, pos % page
+
+
+def paged_verify_step(
+    cfg: GPT2Config,
+    params: PyTree,
+    tokens: jnp.ndarray,        # [B, T] col 0 = last emitted, cols 1.. = drafts
+    seq_lens: jnp.ndarray,      # [B] i32 tokens already cached per slot
+    k_pool: jnp.ndarray,        # [L, P, KV, page, D]
+    v_pool: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, W] i32
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Self-speculative verify (ISSUE 10): score T = k+1 tokens per slot in
+    one forward pass → (k_pool, v_pool, greedy [B, T]).
+
+    ``greedy[b, t]`` is the argmax next token after prefix ⊕ tokens[b, :t+1]
+    — i.e. exactly what ``paged_decode_step`` would emit at that point. The
+    host accepts the longest prefix where ``tokens[b, t+1] == greedy[b, t]``
+    and emits ``greedy[b, :accepted+1]``: the output stream is bit-identical
+    to sequential decode, drafts only change how many tokens one step
+    yields. Rejected drafts leave K/V at positions past the accepted length;
+    the next step's T-token scatter overwrites every such position before
+    anything attends it (``new_base = base + accepted + 1 <= base + T``), so
+    rollback is by construction, not by copy."""
+    B, T = tokens.shape
+    page = k_pool.shape[3]
+    eps = cfg.layer_norm_epsilon
+    # clamp garbage positions (past the decode budget) into the embedding
+    # table; their queries are never emitted and their writes go to scratch
+    positions = jnp.minimum(
+        seq_lens[:, None] + jnp.arange(T)[None, :], cfg.n_positions - 1
+    )
+    h = params["wte"][tokens] + params["wpe"][positions]
+    pidx, poff = _verify_write_targets(seq_lens, block_tables, page, T)
+
+    for l in range(cfg.n_layer):
+        lp = _layer_params(params, l)
+        a, k_pool, v_pool = _attention_verify_paged(
+            cfg, lp["attn"],
+            _layer_norm(h, lp["ln_1"]["scale"], lp["ln_1"]["bias"], eps),
+            k_pool, v_pool, block_tables, seq_lens, pidx, poff, l,
+        )
+        h = h + a
+        m, _aux = _mlp(
+            cfg, lp["mlp"],
+            _layer_norm(h, lp["ln_2"]["scale"], lp["ln_2"]["bias"], eps),
+            False, None,
+        )
+        h = h + m
+
+    h = _layer_norm(h, params["ln_f"]["scale"], params["ln_f"]["bias"], eps)
+    logits = (h @ params["wte"].T)[..., : cfg.vocab_size]
+    greedy = jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32)
+    return k_pool, v_pool, greedy
+
+
+def paged_chunk_prefill(
+    cfg: GPT2Config,
+    params: PyTree,
+    input_ids: jnp.ndarray,   # [1, C] one chunk, right-padded past the prompt
+    start: jnp.ndarray,       # traced i32: absolute position of input_ids[0, 0]
+    prompt_len: jnp.ndarray,  # traced i32: the request's true prompt length
+    k_pool: jnp.ndarray,      # [L, P, KV, page, D]
+    v_pool: jnp.ndarray,
+    page_ids: jnp.ndarray,    # [C // page] i32: THIS chunk's slot pages
+    block_tables: jnp.ndarray,  # [1, W] i32: the slot's full table row
+    rng: jnp.ndarray,         # PRNGKey for the first sampled token
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One chunk of an incremental prefill (ISSUE 10) → (k_pool, v_pool,
+    token [1]).
+
+    Positions ``start .. start+C-1`` run through the model attending the
+    slot's cached prefix (``< start`` — earlier chunks or shared prefix
+    pages) plus causal intra-chunk, K/V written page-granularly to
+    ``page_ids`` (page-aligned because C is a page multiple; pages the
+    chunk overruns are scratch-padded by the scheduler). The returned token
+    is sampled at the true last prompt position and is only meaningful on
+    the final chunk — earlier chunks' samples are discarded host-side.
+    Long prompts stop stalling decode: the scheduler interleaves one chunk
+    per step with the batched decode of other slots."""
+    B, C = input_ids.shape
+    page = k_pool.shape[3]
+    n_cp = C // page
+    eps = cfg.layer_norm_epsilon
+    positions = jnp.minimum(start + jnp.arange(C), cfg.n_positions - 1)
+    h = params["wte"][input_ids] + params["wpe"][positions][None, :, :]
+    base = jnp.reshape(start, (1,))
+
+    for l in range(cfg.n_layer):
+        lp = _layer_params(params, l)
+        hn = _layer_norm(h, lp["ln_1"]["scale"], lp["ln_1"]["bias"], eps)
+        qkv = hn @ _deq(lp["attn"]["c_attn_w"], hn.dtype) + lp["attn"]["c_attn_b"]
+        q, k_, v = jnp.split(qkv, 3, axis=-1)
+        H, D = cfg.n_head, cfg.head_dim
+        q = q.reshape(B, C, H, D)
+        k_c = k_.reshape(B, C, H, D).astype(k_pool.dtype)
+        v_c = v.reshape(B, C, H, D).astype(v_pool.dtype)
+        # page-granular scatter, exactly paged_prefill's write
+        k_pool = k_pool.at[l, page_ids].set(
+            jnp.swapaxes(k_c[0].reshape(n_cp, page, H, D), 1, 2)
+        )
+        v_pool = v_pool.at[l, page_ids].set(
+            jnp.swapaxes(v_c[0].reshape(n_cp, page, H, D), 1, 2)
+        )
+        o = _attend_multitoken_paged(
+            cfg, hn, q, k_pool[l], v_pool[l], block_tables, base
+        )
+        a = o @ _deq(lp["attn"]["c_proj_w"], hn.dtype) + lp["attn"]["c_proj_b"]
+        h = h + a
+        m, _aux = _mlp(
+            cfg, lp["mlp"],
+            _layer_norm(h, lp["ln_2"]["scale"], lp["ln_2"]["bias"], eps),
+            False, None,
+        )
+        h = h + m
+
+    # the true last prompt position, when it falls inside this chunk
+    idx = jnp.clip(prompt_len - 1 - start, 0, C - 1)
+    h_last = jnp.take(h, idx, axis=1)  # [B, E]
+    h_last = _layer_norm(h_last, params["ln_f"]["scale"], params["ln_f"]["bias"], eps)
+    logits = (h_last @ params["wte"].T)[..., : cfg.vocab_size]
+    first = sample_logits(logits, rng, temperature, top_k, top_p)
+    return k_pool, v_pool, first
 
 
 # ---------------------------------------------------------------------------

@@ -55,6 +55,13 @@ class Request:
     # many tokens (None = healthy); set by the scheduler at admission
     stall_after: Optional[int] = None
 
+    # -- prefix cache (ISSUE 10) ---------------------------------------
+    # prompt tokens served from shared prefix-index pages at admission
+    # (0 = cold); the tail past this point was prefilled normally
+    prefix_shared_tokens: int = 0
+    # a full-prefix hit forked the last prompt page copy-on-write
+    cow_forked: bool = False
+
     # -- filled by the scheduler ---------------------------------------
     id: int = field(default_factory=lambda: next(_ids))
     status: str = RequestStatus.QUEUED
@@ -67,6 +74,17 @@ class Request:
     @property
     def done(self) -> bool:
         return self.status in RequestStatus.TERMINAL
+
+    @property
+    def prompt_list(self) -> List[int]:
+        """The prompt as a plain list, converted ONCE — the speculative
+        drafter reads prompt ⊕ tokens every step, and re-running
+        ``ndarray.tolist()`` per slot per step is avoidable hot-path work."""
+        cached = getattr(self, "_prompt_list", None)
+        if cached is None:
+            cached = np.asarray(self.prompt, np.int64).tolist()
+            object.__setattr__(self, "_prompt_list", cached)
+        return cached
 
     @property
     def prompt_len(self) -> int:

@@ -3,12 +3,13 @@
 The serving answer to DeepSpeed-Inference's throughput story (PAPERS.md
 2207.00032) under XLA's static-shape constraint (2605.25645): instead of one
 static batch per ``generate`` call, a fixed array of ``max_slots`` decode
-slots advances one token per step through ONE compiled decode program, while
+slots advances through ONE compiled decode-shaped program per step, while
 finished sequences vacate their slot mid-flight and queued requests are
 admitted into free slots via prefill-insertions (ONE compiled prefill
-program). Exactly two executables exist for the lifetime of the engine —
-``ServingEngine.executables`` — because every input shape is a function of
-the ``serving`` config alone:
+program). A fixed, config-derived set of executables exists for the
+lifetime of the engine — ``ServingEngine.executables``, exact-checked by
+``verify()`` — because every input shape is a function of the ``serving``
+config alone:
 
 - tokens/seq_lens/keys: ``[max_slots]`` — inactive slots ride along pointed
   at the scratch page (their compute is garbage nobody reads; all ops are
@@ -16,6 +17,29 @@ the ``serving`` config alone:
 - prompts: right-padded to the static prefill width, true length traced.
 - the KV cache: a paged pool + per-slot block tables (serving/kv_cache.py),
   so sequence length never appears in any array shape.
+
+Serving hot-path shapes (ISSUE 10), all off by default and all preserving
+the token streams:
+
+- **Self-speculative decode** (``serving.speculative``): the scheduler
+  proposes ``k`` draft tokens per slot host-side (prompt-lookup n-grams over
+  prompt+output) and ONE ``paged_verify_step`` program replaces the decode
+  step, scoring all k+1 positions per slot per step and accepting the
+  longest matching prefix — decode is memory-bound (PR-5 roofline), so the
+  extra verified tokens are nearly free and an accepted draft advances a
+  slot several tokens per step. Greedy-only; the emitted stream is
+  BIT-identical to sequential decode (tested), rejected-draft K/V rolls
+  back by being overwritten before anything attends it.
+- **Shared-prefix KV reuse** (``serving.prefix_cache``): full prompt pages
+  register in a chained-hash index after prefill; later prompts map the
+  matching page-aligned prefix into their block table (refcounted pages)
+  and prefill only the tail through the chunk program. A full-prefix hit
+  copy-on-write-forks the last page (recomputed privately — the shared
+  original is never written) and collapses TTFT to roughly one chunk step.
+- **Chunked prefill** (``serving.prefill_chunk_tokens``): long prompts
+  prefill in fixed-width page-aligned chunks, ONE chunk per scheduler step,
+  so a long prompt no longer stalls co-resident decode slots for its whole
+  width (TPOT invariance, tested).
 
 Robustness: admission control (queue-depth + KV-page budget) rejects at the
 door; per-request deadlines evict mid-flight to a TRUNCATED response; an
@@ -49,7 +73,14 @@ from ..models.gpt2 import GPT2Config
 from ..telemetry.registry import MetricsRegistry
 from ..utils.logging import log_dist
 from . import model as smodel
-from .kv_cache import PageAllocator, SlotTable, init_pools, pages_for, pool_bytes
+from .kv_cache import (
+    PageAllocator,
+    PrefixCache,
+    SlotTable,
+    init_pools,
+    pages_for,
+    pool_bytes,
+)
 from .request import Request, RequestStatus
 
 # TTFT/TPOT histogram buckets (seconds): sub-ms CPU-sim steps through
@@ -84,10 +115,18 @@ def _host_prng_key(seed: int) -> np.ndarray:
 @dataclass
 class _Slot:
     request: Optional[Request] = None
-    pages: List[int] = field(default_factory=list)
+    pages: List[int] = field(default_factory=list)  # full row: shared + private
     pos: int = 0    # tokens currently in this slot's cache
     step: int = 0   # decode steps completed
     keys: Optional[np.ndarray] = None  # [max_new-1, 2] u32 decode sampling keys
+    # -- ISSUE 10: chunked prefill + prefix sharing --------------------
+    # True while the prompt is still prefilling chunk-by-chunk; the main
+    # slot-table row stays scratch (the batched decode must not touch this
+    # slot's real pages) and ``row`` below carries the real block table
+    prefilling: bool = False
+    prefill_pos: int = 0               # prompt tokens prefilled so far
+    row: Optional[np.ndarray] = None   # [1, pages_per_slot] real block table
+    shared_pages: int = 0              # leading row entries mapped from the index
 
 
 class ServingEngine:
@@ -172,6 +211,34 @@ class ServingEngine:
         self.completed: List[Request] = []
         self._sampling = float(config.temperature) > 0.0
 
+        # -- ISSUE 10: speculative decode / prefix cache / chunked prefill --
+        self.spec = getattr(config, "speculative", None)
+        self.spec_enabled = bool(self.spec and self.spec.enabled)
+        self.spec_k = int(self.spec.k) if self.spec_enabled else 0
+        self.spec_ngram = int(self.spec.ngram) if self.spec_enabled else 2
+        if self.spec_enabled and self._sampling:
+            raise ValueError(
+                "serving.speculative requires temperature == 0 (greedy)"
+            )
+        pcfg = getattr(config, "prefix_cache", None)
+        self.prefix_enabled = bool(pcfg and pcfg.enabled)
+        self.prefix_cache: Optional[PrefixCache] = (
+            PrefixCache(self.allocator, page,
+                        max_pages=int(pcfg.max_pages) if pcfg else 0)
+            if self.prefix_enabled else None
+        )
+        cw = int(getattr(config, "prefill_chunk_tokens", 0) or 0)
+        self._chunk_cold = cw > 0  # chunk long COLD prompts too
+        if cw > 0:
+            self.chunk_width = pages_for(cw, page) * page
+        elif self.prefix_enabled:
+            # prefix-hit tails always run through the chunk program
+            self.chunk_width = page
+        else:
+            self.chunk_width = 0
+        if self.chunk_width > self.prefill_width:
+            self.chunk_width = self.prefill_width
+
         # -- telemetry (PR-1 registry when the engine carries one) ---------
         self.metrics: MetricsRegistry = (
             engine.telemetry.registry if getattr(engine, "telemetry", None)
@@ -225,6 +292,47 @@ class ServingEngine:
             "serving_retried_requests_total",
             "transient slot failures re-enqueued with backoff",
         )
+        # -- ISSUE 10 instruments ------------------------------------------
+        self._h_accept = m.histogram(
+            "serving_spec_accept_length",
+            "tokens emitted per slot per speculative verify step "
+            "(1 = no draft accepted, k+1 = full accept)",
+            buckets=tuple(float(i) for i in range(1, max(2, self.spec_k) + 2)),
+        )
+        self._c_spec_steps = m.counter(
+            "serving_spec_steps_total", "batched speculative verify steps"
+        )
+        self._c_spec_drafted = m.counter(
+            "serving_spec_drafted_total", "draft tokens proposed (host-side)"
+        )
+        self._c_spec_accepted = m.counter(
+            "serving_spec_accepted_total", "draft tokens accepted by verify"
+        )
+        self._c_prefix_hits = m.counter(
+            "serving_prefix_hits_total",
+            "prefix-cache admission lookups by outcome",
+            labelnames=("kind",),  # full | partial | miss
+        )
+        self._g_prefix_rate = m.gauge(
+            "serving_prefix_hit_rate", "lookups that mapped >= 1 shared page"
+        )
+        self._c_pages_reused = m.counter(
+            "serving_prefix_pages_reused_total",
+            "KV pages mapped from the prefix index instead of prefilled",
+        )
+        self._g_pages_shared = m.gauge(
+            "serving_kv_pages_shared", "in-use pages with refcount > 1"
+        )
+        self._c_cow = m.counter(
+            "serving_kv_cow_forks_total",
+            "shared pages forked copy-on-write at a full-prefix hit",
+        )
+        self._c_chunks = m.counter(
+            "serving_chunk_prefills_total", "chunk-prefill program invocations"
+        )
+        self._g_index_pages = m.gauge(
+            "serving_prefix_index_pages", "pages held live by the prefix index"
+        )
         # anomaly watchdog (ISSUE 5): shared with the owning engine's
         # telemetry when present — straggler trips land in the same trace
         self.watchdog = (
@@ -236,16 +344,29 @@ class ServingEngine:
 
         self._prefill_exec = None
         self._decode_exec = None
+        self._verify_exec = None
+        self._chunk_exec = None
         self.executables: List[Any] = []
         log_dist(
             f"ServingEngine: slots={self.max_slots} page={page} "
             f"pages={config.num_pages} (pool "
             f"{pool_bytes(mcfg.n_layer, int(config.num_pages), mcfg.n_head, page, mcfg.head_dim, np.dtype(self.cache_dtype).itemsize) / 1e6:.1f} MB) "
-            f"prefill_width={self.prefill_width} dtype={np.dtype(self.cache_dtype).name}"
+            f"prefill_width={self.prefill_width} dtype={np.dtype(self.cache_dtype).name} "
+            f"spec_k={self.spec_k if self.spec_enabled else 0} "
+            f"prefix_cache={self.prefix_enabled} chunk={self.chunk_width}"
         )
 
+    @property
+    def expected_executables(self) -> int:
+        """The static-shapes contract (Engine A ``exact`` budget): one
+        prefill program, ONE decode-shaped program (the speculative verify
+        step REPLACES the plain decode step when enabled — never both), and
+        the chunk-prefill program when chunking or the prefix cache needs
+        it."""
+        return 2 + (1 if self.chunk_width > 0 else 0)
+
     # ------------------------------------------------------------------
-    # compilation: exactly two executables, ahead-of-time
+    # compilation: a fixed feature-derived program set, ahead-of-time
     # ------------------------------------------------------------------
     def _ensure_compiled(self) -> None:
         if self._prefill_exec is not None:
@@ -266,24 +387,56 @@ class ServingEngine:
                 temperature=temp, top_k=tk, top_p=tp,
             )
 
+        def verify_fn(params, k_pool, v_pool, tokens, seq_lens, bt):
+            return smodel.paged_verify_step(
+                cfg, params, tokens, seq_lens, k_pool, v_pool, bt
+            )
+
+        def chunk_fn(params, k_pool, v_pool, ids, start, plen, page_ids,
+                     bt_row, key):
+            return smodel.paged_chunk_prefill(
+                cfg, params, ids, start, plen, k_pool, v_pool, page_ids,
+                bt_row, key, temperature=temp, top_k=tk, top_p=tp,
+            )
+
         S = jax.ShapeDtypeStruct
         i32, u32 = jnp.int32, jnp.uint32
         # AOT: lower + compile ONCE with the config-derived static shapes;
         # the compiled objects reject any other shape, enforcing the
-        # two-executables contract structurally (pools are donated — the
-        # cache never exists twice)
+        # executable-count contract structurally (pools are donated — the
+        # cache never exists twice). The verify step REPLACES the decode
+        # step when speculation is on: exactly one decode-shaped program
+        # ever advances the batch.
         self._prefill_exec = jax.jit(prefill_fn, donate_argnums=(1, 2)).lower(
             self.engine.params, self.k_pool, self.v_pool,
             S((1, self.prefill_width), i32), S((), i32),
             S((self.prefill_pages,), i32), S((2,), u32),
         ).compile()
-        self._decode_exec = jax.jit(decode_fn, donate_argnums=(1, 2)).lower(
-            self.engine.params, self.k_pool, self.v_pool,
-            S((self.max_slots,), i32), S((self.max_slots,), i32),
-            S((self.max_slots, self.pages_per_slot), i32),
-            S((self.max_slots, 2), u32),
-        ).compile()
-        self.executables = [self._prefill_exec, self._decode_exec]
+        self.executables = [self._prefill_exec]
+        if self.spec_enabled:
+            self._verify_exec = jax.jit(verify_fn, donate_argnums=(1, 2)).lower(
+                self.engine.params, self.k_pool, self.v_pool,
+                S((self.max_slots, self.spec_k + 1), i32),
+                S((self.max_slots,), i32),
+                S((self.max_slots, self.pages_per_slot), i32),
+            ).compile()
+            self.executables.append(self._verify_exec)
+        else:
+            self._decode_exec = jax.jit(decode_fn, donate_argnums=(1, 2)).lower(
+                self.engine.params, self.k_pool, self.v_pool,
+                S((self.max_slots,), i32), S((self.max_slots,), i32),
+                S((self.max_slots, self.pages_per_slot), i32),
+                S((self.max_slots, 2), u32),
+            ).compile()
+            self.executables.append(self._decode_exec)
+        if self.chunk_width > 0:
+            self._chunk_exec = jax.jit(chunk_fn, donate_argnums=(1, 2)).lower(
+                self.engine.params, self.k_pool, self.v_pool,
+                S((1, self.chunk_width), i32), S((), i32), S((), i32),
+                S((self.chunk_width // self.page_size,), i32),
+                S((1, self.pages_per_slot), i32), S((2,), u32),
+            ).compile()
+            self.executables.append(self._chunk_exec)
 
     # ------------------------------------------------------------------
     # admission control
@@ -378,9 +531,12 @@ class ServingEngine:
 
         # 2. prefill insertions: FIFO admission into free slots, gated by the
         # KV-page budget (head-of-line blocks until draining slots free
-        # pages). A drain stops admission entirely; a retried request still
-        # inside its backoff window (not_before) is passed over, not a
-        # head-of-line blocker.
+        # pages). The page need is net of prefix-index pages the prompt can
+        # map (ISSUE 10 — shared pages cost nothing), and under pool
+        # pressure the index yields cold entries to live traffic before the
+        # head of line blocks. A drain stops admission entirely; a retried
+        # request still inside its backoff window (not_before) is passed
+        # over, not a head-of-line blocker.
         while self.queue and not self._draining:
             free = next(
                 (i for i, s in enumerate(self.slots) if s.request is None), None
@@ -394,28 +550,64 @@ class ServingEngine:
             if idx is None:
                 break
             req = self.queue[idx]
-            need = pages_for(req.prompt_len + req.max_new_tokens, self.page_size)
+            need = self._pages_needed(req)
             if need > self.allocator.free_pages:
-                break
+                if self.prefix_cache is not None and len(self.prefix_cache):
+                    self.prefix_cache.evict(need_free=need)
+                    self._g_index_pages.set(len(self.prefix_cache))
+                    # eviction may have dropped the very pages the probe
+                    # counted as mappable — recompute, or _admit could
+                    # allocate past the pool
+                    need = self._pages_needed(req)
+                if need > self.allocator.free_pages:
+                    break
             del self.queue[idx]
             self._admit(free, req)
 
-        # 3. one batched decode step for every active slot
-        active = [i for i, s in enumerate(self.slots) if s.request is not None]
+        # 2b. chunked prefill (ISSUE 10): every PREFILLING slot advances ONE
+        # chunk, then the decode batch below still runs — a long prompt pays
+        # out its prefill across steps instead of stalling co-resident
+        # decodes for its whole width
+        for i, slot in enumerate(self.slots):
+            if slot.request is not None and slot.prefilling:
+                self._advance_chunk(i)
+
+        # 3. one batched decode (or speculative verify) step for every slot
+        # that is past prefill
+        active = [
+            i for i, s in enumerate(self.slots)
+            if s.request is not None and not s.prefilling
+        ]
         if active:
             t0 = self.clock()
+            drafts: dict = {}
             # the AOT executable takes the numpy slot tables directly — a
             # jnp.asarray wrapper here would dispatch four extra device ops
             # per decode step (dslint jnp-in-hot-loop)
-            kp, vp, nxt = self._decode_exec(
-                self.engine.params, self.k_pool, self.v_pool,
-                self.table.tokens, self.table.seq_lens,
-                self.table.block_tables, self.table.keys,
-            )
+            if self.spec_enabled:
+                T = self.spec_k + 1
+                vt = np.zeros((self.max_slots, T), np.int32)
+                vt[:, 0] = self.table.tokens
+                for i in active:
+                    d = self._draft(self.slots[i].request)
+                    drafts[i] = d
+                    vt[i, 1:] = d
+                kp, vp, out = self._verify_exec(
+                    self.engine.params, self.k_pool, self.v_pool,
+                    vt, self.table.seq_lens, self.table.block_tables,
+                )
+                self._c_spec_steps.inc()
+                self._c_spec_drafted.inc(self.spec_k * len(active))
+            else:
+                kp, vp, out = self._decode_exec(
+                    self.engine.params, self.k_pool, self.v_pool,
+                    self.table.tokens, self.table.seq_lens,
+                    self.table.block_tables, self.table.keys,
+                )
             self.k_pool, self.v_pool = kp, vp
             # the ONE deliberate sync of the slot loop: the scheduler must
             # read the sampled tokens to retire/advance slots
-            nxt_np = jax.device_get(nxt)  # dslint: disable=host-sync-in-step
+            out_np = jax.device_get(out)  # dslint: disable=host-sync-in-step
             now = self.clock()
             self._h_step.observe(now - t0)
             self._c_steps.inc()
@@ -428,14 +620,18 @@ class ServingEngine:
             for i in active:
                 slot = self.slots[i]
                 req = slot.request
-                tok = int(nxt_np[i])
-                req.tokens.append(tok)
-                slot.pos += 1
+                if self.spec_enabled:
+                    toks = self._accept_tokens(req, drafts[i], out_np[i])
+                else:
+                    toks = [int(out_np[i])]
+                req.tokens.extend(toks)
+                slot.pos += len(toks)
                 slot.step += 1
                 self.table.seq_lens[i] = slot.pos
-                self.table.tokens[i] = tok
+                self.table.tokens[i] = toks[-1]
                 if len(req.tokens) >= req.max_new_tokens or (
-                    req.eos_token_id is not None and tok == req.eos_token_id
+                    req.eos_token_id is not None
+                    and toks[-1] == req.eos_token_id
                 ):
                     self._finish_slot(i, RequestStatus.FINISHED, "", now)
                 elif req.stall_after is not None and len(req.tokens) >= req.stall_after:
@@ -472,9 +668,76 @@ class ServingEngine:
         self._g_util.set(n_active / self.max_slots)
         self._g_pages.set(self.allocator.pages_in_use)
         self._g_occ.set(self.allocator.pages_in_use / self.allocator.capacity)
+        self._g_pages_shared.set(self.allocator.pages_shared)
+        if self.prefix_cache is not None:
+            self._g_index_pages.set(len(self.prefix_cache))
         if self._step_count and self._step_count % 32 == 0:
             self.stats()  # refresh the quantile gauges for textfile scrapes
         return n_active
+
+    def _pages_needed(self, req: Request) -> int:
+        """Net new pages an admission must allocate: the request's full
+        reservation minus pages the prefix index can map (non-counting
+        probe — the admission gate runs this every step while a request
+        heads the queue)."""
+        total = pages_for(req.prompt_len + req.max_new_tokens, self.page_size)
+        if self.prefix_cache is None:
+            return total
+        return total - self.prefix_cache.probe(req.prompt)
+
+    def _draft(self, req: Request) -> np.ndarray:
+        """Host-side prompt-lookup draft (ISSUE 10): the continuation of the
+        most recent PRIOR occurrence of the context's last ``ngram`` tokens,
+        padded with the last token. The ngram→position map is maintained
+        incrementally on the request (only positions that appeared since the
+        previous step get indexed), so drafting costs O(tokens appended) per
+        step instead of rescanning the whole context; a retry rewind
+        (``req.tokens`` reset) shrinks the context and rebuilds it. A bad
+        draft costs nothing extra — the verify step's shape is fixed — so
+        the fallback is deliberately dumb."""
+        k, n = self.spec_k, self.spec_ngram
+        prompt = req.prompt_list
+        L = len(prompt) + len(req.tokens)
+        st = getattr(req, "_draft_state", None)
+        if st is None or len(st[0]) > L:
+            st = ([], {}, [0])  # (ctx copy, ngram→most-recent start, watermark)
+            object.__setattr__(req, "_draft_state", st)
+        ctx, index, cur = st
+        if len(ctx) < L:
+            grown = len(ctx)
+            ctx.extend(prompt[grown:] if grown < len(prompt) else [])
+            ctx.extend(req.tokens[len(ctx) - len(prompt):])
+        # index every ngram start strictly before the target position L-n —
+        # latest write wins, so a lookup is exactly the backward scan's
+        # "most recent prior occurrence"
+        for s in range(cur[0], L - n):
+            index[tuple(ctx[s:s + n])] = s
+        cur[0] = max(cur[0], L - n)
+        last = ctx[-1]
+        if L >= n + 1:
+            s = index.get(tuple(ctx[L - n:]))
+            if s is not None:
+                cont = ctx[s + n:s + n + k]
+                return np.asarray((cont + [last] * k)[:k], np.int32)
+        return np.full((k,), last, np.int32)
+
+    def _accept_tokens(self, req: Request, draft: np.ndarray,
+                       greedy: np.ndarray) -> List[int]:
+        """The speculative accept rule: ``greedy[t]`` is the argmax token
+        after the prefix ⊕ draft[:t], so drafts are accepted while
+        ``draft[t] == greedy[t]`` and the step emits the accepted drafts
+        plus one bonus token — exactly the sequential greedy stream,
+        truncated to the remaining budget and at EOS."""
+        n_acc = 0
+        while n_acc < self.spec_k and int(draft[n_acc]) == int(greedy[n_acc]):
+            n_acc += 1
+        emit = min(n_acc + 1, req.max_new_tokens - len(req.tokens))
+        toks = [int(t) for t in greedy[:emit]]
+        if req.eos_token_id is not None and req.eos_token_id in toks:
+            toks = toks[: toks.index(req.eos_token_id) + 1]
+        self._c_spec_accepted.inc(len(toks) - 1)
+        self._h_accept.observe(len(toks))
+        return toks
 
     def _admit(self, slot_i: int, req: Request) -> None:
         self._admissions += 1
@@ -486,17 +749,70 @@ class ServingEngine:
             # fail once the request is mid-decode — the interesting point:
             # pages held, tokens emitted, retry must rewind all of it
             req.stall_after = max(1, req.max_new_tokens // 2)
-        pages = self.allocator.alloc(
-            pages_for(req.prompt_len + req.max_new_tokens, self.page_size)
-        )
+        page = self.page_size
+        total = pages_for(req.prompt_len + req.max_new_tokens, page)
+
+        # prefix-cache lookup (ISSUE 10): map every indexed full page of the
+        # prompt instead of recomputing it. A full-prefix hit additionally
+        # finds the LAST prompt page indexed — that page is copy-on-write
+        # forked (a fresh private page, filled by recomputing its tokens
+        # through the chunk program) because the slot's own decode writes
+        # continue into its page-aligned neighborhood; the shared original
+        # stays immutable for every other holder.
+        shared: List[int] = []
+        shared_tokens = 0
+        cow_page = None
+        if self.prefix_cache is not None:
+            shared, shared_tokens, cow_page = self.prefix_cache.lookup(req.prompt)
+            kind = (
+                "full" if cow_page is not None
+                else ("partial" if shared else "miss")
+            )
+            self._c_prefix_hits.inc(kind=kind)
+            pc = self.prefix_cache
+            lookups = pc.hits_full + pc.hits_partial + pc.misses
+            if lookups:
+                self._g_prefix_rate.set(
+                    (pc.hits_full + pc.hits_partial) / lookups
+                )
+            if shared:
+                self.allocator.retain(shared)
+                self._c_pages_reused.inc(len(shared))
+            if cow_page is not None:
+                self.allocator.cow_forks_total += 1
+                self._c_cow.inc()
+        priv = self.allocator.alloc(total - len(shared))
+        pages = shared + priv
         slot = self.slots[slot_i]
         slot.request = req
         slot.pages = pages
         slot.pos = 0
         slot.step = 0
         slot.keys = None
-        self.table.assign(slot_i, pages)
+        slot.shared_pages = len(shared)
+        slot.row = None
+        slot.prefilling = False
+        req.prefix_shared_tokens = shared_tokens
+        req.cow_forked = cow_page is not None
 
+        use_chunks = self.chunk_width > 0 and (
+            shared_tokens > 0
+            or (self._chunk_cold and req.prompt_len > self.chunk_width)
+        )
+        if use_chunks:
+            # chunked tail prefill: the real block table lives on the slot;
+            # the main table row stays scratch so the batched decode's
+            # rides-along write for this slot cannot touch real (possibly
+            # shared) pages mid-prefill
+            row = np.full((1, self.pages_per_slot), 0, np.int32)
+            row[0, : len(pages)] = pages
+            slot.row = row
+            slot.prefilling = True
+            slot.prefill_pos = shared_tokens
+            req.status = RequestStatus.RUNNING
+            return
+
+        self.table.assign(slot_i, pages)
         ids = np.zeros((1, self.prefill_width), np.int32)
         ids[0, : req.prompt_len] = req.prompt
         page_ids = self.table.block_tables[slot_i, : self.prefill_pages]
@@ -512,7 +828,54 @@ class ServingEngine:
         # deliberate sync: TTFT is defined by the first token reaching the
         # host, and an at-admission EOS must retire the slot before decode
         tok0 = int(jax.device_get(first)[0])  # dslint: disable=host-sync-in-step
+        self._start_decoding(slot_i, tok0)
+
+    def _advance_chunk(self, slot_i: int) -> None:
+        """One chunk of a PREFILLING slot's prompt through the chunk
+        program; on the final chunk the sampled token becomes the request's
+        first token and the slot joins the decode batch."""
+        slot = self.slots[slot_i]
+        req = slot.request
+        C = self.chunk_width
+        page = self.page_size
+        start = slot.prefill_pos
+        ids = np.zeros((1, C), np.int32)
+        seg = req.prompt[start: start + C]
+        ids[0, : len(seg)] = seg
+        p0 = start // page
+        n_cp = C // page
+        page_ids = np.zeros((n_cp,), np.int32)  # scratch-padded
+        avail = slot.row[0, p0: p0 + n_cp]
+        page_ids[: len(avail)] = avail
+        key0 = _host_prng_key(req.seed)
+        kp, vp, tok = self._chunk_exec(
+            self.engine.params, self.k_pool, self.v_pool,
+            ids, np.asarray(start, np.int32),
+            np.asarray(req.prompt_len, np.int32), page_ids, slot.row, key0,
+        )
+        self.k_pool, self.v_pool = kp, vp
+        self._c_chunks.inc()
+        slot.prefill_pos = start + C
+        if slot.prefill_pos < req.prompt_len:
+            return  # more chunks; the decode batch advances meanwhile
+        self._c_prefills.inc()
+        # deliberate sync, as in _admit: the final chunk's sample is the
+        # request's first token
+        tok0 = int(jax.device_get(tok)[0])  # dslint: disable=host-sync-in-step
+        self._start_decoding(slot_i, tok0)
+
+    def _start_decoding(self, slot_i: int, tok0: int) -> None:
+        """Shared post-prefill transition: install the real block table (if
+        the prefill ran chunked), record TTFT, register the prompt's full
+        pages in the prefix index, arm sampling keys, and handle an
+        immediate EOS / single-token ask."""
+        slot = self.slots[slot_i]
+        req = slot.request
         now = self.clock()
+        if slot.row is not None:
+            self.table.block_tables[slot_i, :] = slot.row[0]
+            slot.prefilling = False
+            slot.row = None
         req.status = RequestStatus.RUNNING
         req.t_first_token = now
         self._h_ttft.observe(now - req.t_submit)
@@ -520,6 +883,9 @@ class ServingEngine:
         slot.pos = req.prompt_len
         self.table.seq_lens[slot_i] = slot.pos
         self.table.tokens[slot_i] = tok0
+        if self.prefix_cache is not None:
+            self.prefix_cache.insert(req.prompt, slot.pages)
+            self._g_index_pages.set(len(self.prefix_cache))
         if self._sampling and req.max_new_tokens > 1:
             # the EXACT key sequence of gpt2.generate for this request:
             # step t consumes split(fold_in(PRNGKey(seed), 1), N-1)[t-1].
@@ -584,6 +950,9 @@ class ServingEngine:
             req.retries += 1
             req.stall_after = None  # the injected fault is one-shot
             req.tokens = []
+            # the retry regenerates from scratch — drop the incremental
+            # drafter index built over the discarded output
+            object.__setattr__(req, "_draft_state", None)
             req.status = RequestStatus.QUEUED
             req.t_first_token = None
             req.not_before = now + float(
@@ -669,7 +1038,15 @@ class ServingEngine:
             ) + sum(
                 s.request.max_new_tokens for s in self.slots if s.request is not None
             )
-            max_steps = 2 * budget + len(self.queue) + 16
+            n_reqs = len(self.queue) + sum(
+                1 for s in self.slots if s.request is not None
+            )
+            # chunked prefill consumes steps without emitting tokens
+            chunks_per_req = (
+                -(-self.prefill_width // self.chunk_width)
+                if self.chunk_width else 0
+            )
+            max_steps = 2 * budget + n_reqs * chunks_per_req + len(self.queue) + 16
         start = len(self.completed)
         for _ in range(max_steps):
             if not self.queue and all(s.request is None for s in self.slots):
@@ -684,16 +1061,32 @@ class ServingEngine:
         return self.completed[start:]
 
     # ------------------------------------------------------------------
+    def executable_names(self) -> List[tuple]:
+        """→ [(name, compiled)] for the engine's program set (compiling on
+        first use). The names key the dsmem budget ledger and the analysis
+        reports."""
+        self._ensure_compiled()
+        out = [("serving_prefill", self._prefill_exec)]
+        if self.spec_enabled:
+            out.append(("serving_verify", self._verify_exec))
+        else:
+            out.append(("serving_decode", self._decode_exec))
+        if self._chunk_exec is not None:
+            out.append(("serving_chunk_prefill", self._chunk_exec))
+        return out
+
     def verify(self, analysis_config=None) -> list:
         """Engine A (dslint) verification of the serving program set.
 
         The serving contract, checked against the compiled artifacts
-        themselves: EXACTLY two executables (``static-shapes``), both KV
-        pools donated AND actually aliased input→output in each program
-        (``donation-honored`` — a copied pool silently doubles the
-        dominant HBM consumer), and no fp32 upcasts when the cache dtype
-        says bf16/fp16 (``no-fp32-upcast``). Returns findings; empty =
-        clean. Compiles the two programs if the engine has not run yet."""
+        themselves: EXACTLY ``analysis.max_serving_programs`` executables
+        (``static-shapes``; 0 = auto — :attr:`expected_executables`, the
+        enabled feature set's count), both KV pools donated AND actually
+        aliased input→output in each program (``donation-honored`` — a
+        copied pool silently doubles the dominant HBM consumer), and no
+        fp32 upcasts when the cache dtype says bf16/fp16
+        (``no-fp32-upcast``). Returns findings; empty = clean. Compiles the
+        programs if the engine has not run yet."""
         from ..runtime.config import AnalysisConfig
         from .. import analysis as dsa
 
@@ -707,14 +1100,13 @@ class ServingEngine:
         pool_dims = ",".join(str(d) for d in self.k_pool.shape)
         expected_dtype = pool_dt if pool_dt in ("bf16", "f16") else None
         ctx = dsa.RuleContext(program="serving")
+        budget = int(getattr(acfg, "max_serving_programs", 0) or 0)
         findings = dsa.check_program_budget(
-            len(self.executables), 2, ctx, exact=True
+            len(self.executables), budget or self.expected_executables,
+            ctx, exact=True,
         )
         texts = {}
-        for name, exe in (
-            ("serving_prefill", self._prefill_exec),
-            ("serving_decode", self._decode_exec),
-        ):
+        for name, exe in self.executable_names():
             texts[name] = exe.as_text()
             pctx = dsa.RuleContext(
                 program=name,
@@ -743,11 +1135,12 @@ class ServingEngine:
 
             self._memory_analyses = {}
             self._memory_cfg = mcfg
-            for name in ("serving_prefill", "serving_decode"):
+            for name in texts:
                 ectx = dsmem.context_from_config(
                     mcfg, name,
                     check_donation=False,
                     kv_pool_dims=(pool_dims,),
+                    metadata_dims=self._metadata_dims(),
                 )
                 mem_findings, ana = dsmem.verify_memory_text(
                     texts[name], ectx
@@ -755,6 +1148,22 @@ class ServingEngine:
                 findings.extend(mem_findings)
                 self._memory_analyses[name] = ana
         return findings
+
+    def _metadata_dims(self) -> tuple:
+        """HLO dim strings of the serving control-plane buffers (block
+        tables, draft-token batches, chunk page maps) so Engine E's ledger
+        labels them ``metadata`` instead of ``temp`` — they are the device
+        shadow of the host-side refcount/prefix-index state."""
+        dims = {
+            f"{self.max_slots},{self.pages_per_slot}",  # block tables
+            f"1,{self.pages_per_slot}",                 # chunk table row
+            f"{self.prefill_pages}",                    # prefill page ids
+        }
+        if self.chunk_width:
+            dims.add(f"{self.chunk_width // self.page_size}")  # chunk pages
+        if self.spec_enabled:
+            dims.add(f"{self.max_slots},{self.spec_k + 1}")    # draft batch
+        return tuple(sorted(dims))
 
     def memory_report(self) -> dict:
         """The dsmem (Engine E) profile of both serving executables: peak
@@ -766,6 +1175,10 @@ class ServingEngine:
         from ..runtime.config import AnalysisConfig
 
         mcfg = getattr(self, "_memory_cfg", None) or AnalysisConfig().memory
+        host_meta = (
+            self.prefix_cache.host_metadata_bytes()
+            if self.prefix_cache is not None else 0
+        )
         out = {}
         for name, ana in (self._memory_analyses or {}).items():
             budget = dsmem.resolve_budget(mcfg, name)
@@ -773,6 +1186,11 @@ class ServingEngine:
             rec["budget_bytes"] = budget
             rec["headroom_pct"] = dsmem.headroom_pct(budget, ana.peak_bytes)
             rec["kv_pool_bytes"] = ana.by_category.get("kv-pool", 0)
+            # device control-plane buffers (block tables / draft batches)
+            # plus the host-side refcount & prefix-index footprint they
+            # shadow (ISSUE 10)
+            rec["metadata_bytes"] = ana.by_category.get("metadata", 0)
+            rec["host_metadata_bytes"] = host_meta
             out[name] = rec
         return out
 
@@ -805,12 +1223,46 @@ class ServingEngine:
         out["drained"] = int(self._c_drained.value())
         out["retried"] = int(self._c_retries.value())
         out["draining"] = self._draining
+        # -- ISSUE 10: sharing / speculation / chunking invariant counters --
+        out["kv_pages_shared"] = self.allocator.pages_shared
+        out["kv_cow_forks"] = self.allocator.cow_forks_total
+        out["chunk_prefills"] = int(self._c_chunks.value())
+        if self.prefix_cache is not None:
+            pc = self.prefix_cache
+            lookups = pc.hits_full + pc.hits_partial + pc.misses
+            out["prefix_index_pages"] = len(pc)
+            out["prefix_hits_full"] = pc.hits_full
+            out["prefix_hits_partial"] = pc.hits_partial
+            out["prefix_misses"] = pc.misses
+            out["prefix_evictions"] = pc.evictions
+            out["prefix_hit_rate"] = (
+                (pc.hits_full + pc.hits_partial) / lookups if lookups else None
+            )
+            out["prefix_host_metadata_bytes"] = pc.host_metadata_bytes()
+        if self.spec_enabled:
+            total, n = self._h_accept.stats()
+            out["spec_steps"] = int(self._c_spec_steps.value())
+            out["spec_drafted"] = int(self._c_spec_drafted.value())
+            out["spec_accepted"] = int(self._c_spec_accepted.value())
+            out["spec_accept_len_mean"] = (total / n) if n else None
         return out
 
+    def release_prefix_cache(self) -> int:
+        """Drop every prefix-index reference (teardown / tests): after this,
+        a drained engine's allocator is fully free. → pages released."""
+        if self.prefix_cache is None:
+            return 0
+        n = self.prefix_cache.clear()
+        self._g_index_pages.set(len(self.prefix_cache))
+        self._g_pages_shared.set(self.allocator.pages_shared)
+        return n
+
     def check_no_leaks(self) -> None:
-        """Drain invariant: every page back on the free list, every slot
-        empty, every block-table entry pointing at scratch."""
-        self.allocator.check_no_leaks()
+        """Drain invariant: every page either back on the free list or held
+        by EXACTLY the prefix index (refcount 1), every slot empty, every
+        block-table entry pointing at scratch."""
+        held = self.prefix_cache.held_pages if self.prefix_cache else None
+        self.allocator.check_no_leaks(allowed=held)
         assert all(s.request is None for s in self.slots)
         assert (self.table.block_tables == 0).all()
         assert (self.table.seq_lens == 0).all()

@@ -179,3 +179,93 @@ class TestPagedDecode:
         kp = jnp.zeros((16, 3, 8, 64), jnp.float32)
         with pytest.raises(ValueError, match="divide"):
             paged_decode_attention(q, kp, kp, bt, jnp.asarray([0, 0, 0], jnp.int32), interpret=True)
+
+
+class TestPagedMultitoken:
+    """Multi-token paged attention (ISSUE 10): T query tokens per slot, the
+    attention shape of the speculative verify step and chunked prefill."""
+
+    def _setup(self, B=3, T=4, H=4, KV=4, D=64, page=8, P=24, n=4, seed=0):
+        rs = np.random.RandomState(seed)
+        q = jnp.asarray(rs.randn(B, T, H, D), jnp.float32)
+        kp = jnp.asarray(rs.randn(P, KV, page, D), jnp.float32)
+        vp = jnp.asarray(rs.randn(P, KV, page, D), jnp.float32)
+        bt = jnp.asarray(
+            rs.choice(np.arange(1, P), (B * n,), replace=False).reshape(B, n),
+            jnp.int32,
+        )
+        return q, kp, vp, bt
+
+    def test_kernel_matches_jnp_fallback(self):
+        from deepspeed_tpu.ops.attention import paged_multitoken_cached_attention
+        from deepspeed_tpu.ops.pallas.decode_attention import (
+            paged_multitoken_attention,
+        )
+
+        q, kp, vp, bt = self._setup()
+        base = jnp.asarray([0, 13, 27], jnp.int32)
+        out = paged_multitoken_attention(q, kp, vp, bt, base, interpret=True)
+        ref = paged_multitoken_cached_attention(q, kp, vp, bt, base, impl="jnp")
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+
+    def test_each_query_slice_is_bitwise_the_single_token_path(self):
+        """The property the speculative accept rule rests on: query t of the
+        T-token jnp fallback produces EXACTLY the bits of the single-token
+        dispatcher at pos = base + t."""
+        from deepspeed_tpu.ops.attention import (
+            paged_cached_attention,
+            paged_multitoken_cached_attention,
+        )
+
+        q, kp, vp, bt = self._setup(seed=2)
+        base = jnp.asarray([3, 11, 19], jnp.int32)
+        mt = paged_multitoken_cached_attention(q, kp, vp, bt, base, impl="jnp")
+        for t in range(q.shape[1]):
+            st = paged_cached_attention(
+                q[:, t], kp, vp, bt, base + t, impl="jnp"
+            )
+            assert bool(jnp.all(mt[:, t] == st)), f"query {t} diverged"
+
+    def test_gqa_pool(self):
+        from deepspeed_tpu.ops.attention import paged_multitoken_cached_attention
+        from deepspeed_tpu.ops.pallas.decode_attention import (
+            paged_multitoken_attention,
+        )
+
+        q, _, _, bt = self._setup()
+        rs = np.random.RandomState(3)
+        kp = jnp.asarray(rs.randn(24, 2, 8, 64), jnp.float32)  # KV=2 < H=4
+        vp = jnp.asarray(rs.randn(24, 2, 8, 64), jnp.float32)
+        base = jnp.asarray([1, 9, 22], jnp.int32)
+        out = paged_multitoken_attention(q, kp, vp, bt, base, interpret=True)
+        ref = paged_multitoken_cached_attention(q, kp, vp, bt, base, impl="jnp")
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+
+    def test_causal_offsets_mask_future_positions(self):
+        """Query t sees positions <= base + t: poisoning position base+2
+        changes queries 2.. but leaves queries 0..1 untouched."""
+        from deepspeed_tpu.ops.attention import paged_multitoken_cached_attention
+
+        q, kp, vp, bt = self._setup(B=1, seed=4)
+        base = jnp.asarray([8], jnp.int32)  # positions 8..11 are queries 0..3
+        out1 = paged_multitoken_cached_attention(q, kp, vp, bt, base, impl="jnp")
+        pg, off = int(bt[0, 10 // 8]), 10 % 8  # position base+2 = 10
+        kp2 = kp.at[pg, :, off].set(99.0)
+        vp2 = vp.at[pg, :, off].set(-99.0)
+        out2 = paged_multitoken_cached_attention(q, kp2, vp2, bt, base, impl="jnp")
+        np.testing.assert_array_equal(
+            np.asarray(out1[:, :2]), np.asarray(out2[:, :2])
+        )
+        assert not np.allclose(np.asarray(out1[:, 2:]), np.asarray(out2[:, 2:]))
+
+    def test_vmem_gate(self):
+        from deepspeed_tpu.ops.pallas.decode_attention import (
+            paged_multitoken_attention_ok,
+        )
+
+        # CPU backend: gate is False regardless of shape
+        assert not paged_multitoken_attention_ok(16, 64, 5)

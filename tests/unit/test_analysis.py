@@ -756,6 +756,70 @@ class TestProgramGate:
     def test_analysis_disabled_skips(self, serving_engine):
         assert serving_engine.verify({"enabled": False}) == []
 
+    def test_serving_budget_parameterized(self, serving_engine):
+        """ISSUE 10 satellite: the Engine A serving budget is
+        ``analysis.max_serving_programs`` (0 = auto-track the engine's
+        feature set) instead of the old hard-coded EXACTLY 2."""
+        # auto (default 0) tracks expected_executables — clean
+        assert serving_engine.expected_executables == 2
+        assert serving_engine.verify() == []
+        # an explicit budget that disagrees with reality trips the gate
+        fs = serving_engine.verify({"max_serving_programs": 5})
+        assert "static-shapes" in rules_of(fs)
+        # an explicit budget that matches passes
+        assert serving_engine.verify({"max_serving_programs": 2}) == []
+
+    def test_feature_enabled_serving_programs_verify_clean(self, gpt2_tiny_cfg):
+        """Speculative verify + chunk-prefill executables pass the full
+        A/D/E gate under the AUTO budget — the new programs must not trip
+        the static-shapes, donation, or memory-budget rules (ISSUE 10
+        acceptance)."""
+        from deepspeed_tpu.inference.engine import InferenceEngine
+        from deepspeed_tpu.models import gpt2
+
+        tiny_cfg = gpt2_tiny_cfg
+        params = gpt2.init_params(tiny_cfg, jax.random.PRNGKey(0))
+        eng = InferenceEngine(
+            gpt2.make_module(tiny_cfg), params=params, dtype=jnp.float32
+        )
+        srv = eng.serve({
+            "max_slots": 4, "page_size": 4, "num_pages": 64,
+            "max_prompt_len": 12, "max_new_tokens": 8,
+            "kv_cache_dtype": "float32",
+            "speculative": {"enabled": True, "k": 4},
+            "prefix_cache": {"enabled": True},
+            "prefill_chunk_tokens": 4,
+        })
+        assert srv.expected_executables == 3
+        assert srv.verify() == []
+        names = [n for n, _ in srv.executable_names()]
+        assert names == [
+            "serving_prefill", "serving_verify", "serving_chunk_prefill"
+        ]
+        # the verify program's pools are donated-and-aliased like decode's
+        pool_dims = ",".join(str(d) for d in srv.k_pool.shape)
+        for _, exe in srv.executable_names():
+            txt = exe.as_text()
+            aliased = H._aliased_params(txt)
+            pools = [
+                num for num, (dt, dd, _) in H._entry_params(txt).items()
+                if dd == pool_dims
+            ]
+            assert len(pools) == 2 and all(p in aliased for p in pools)
+        # Engine E labels the draft/block-table control plane "metadata"
+        ana = srv._memory_analyses["serving_verify"]
+        assert ana.by_category.get("metadata", 0) > 0
+
+    def test_max_serving_programs_config_validation(self):
+        from deepspeed_tpu.runtime.config import (
+            AnalysisConfig,
+            DeepSpeedConfigError,
+        )
+
+        assert AnalysisConfig(max_serving_programs=3).max_serving_programs == 3
+        with pytest.raises(DeepSpeedConfigError, match="max_serving_programs"):
+            AnalysisConfig(max_serving_programs=-1)
+
 
 # ---------------------------------------------------------------------------
 # config section + env_report satellite
