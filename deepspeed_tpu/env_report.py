@@ -206,6 +206,46 @@ def main() -> int:
     except Exception as e:
         print(f"dsmem ............... {RED_NO} ({type(e).__name__}: {e})")
     print("-" * 60)
+    print("Request tracing (ISSUE 11):")
+    try:
+        from deepspeed_tpu.runtime.config import ServingConfig, TelemetryConfig
+        from deepspeed_tpu.telemetry.request_trace import (
+            SCHEMA,
+            RequestTracer,  # noqa: F401
+        )
+
+        tcfg = TelemetryConfig()
+        print(
+            f"request tracer ...... {GREEN_OK} schema {SCHEMA} "
+            f"(telemetry.request_trace — "
+            f"{'on' if tcfg.request_trace.enabled else 'off'} by default; "
+            "host-side events, StepTracer rotation)"
+        )
+        slo = ServingConfig().slo
+        print(
+            "slo classes ......... "
+            + (
+                f"{len(slo.classes)} configured "
+                f"({', '.join(sorted(slo.classes))})"
+                if slo.classes
+                else "none by default (serving.slo.classes — goodput/"
+                "attainment gauges activate with the first class)"
+            )
+        )
+        from deepspeed_tpu.serving import generate_workload  # noqa: F401
+
+        print(
+            f"replay harness ...... {GREEN_OK} serving/replay.py "
+            "(seeded bursty arrivals + heavy-tailed prompts + hot-tenant "
+            "prefix skew)"
+        )
+        print(
+            "report CLI .......... python -m deepspeed_tpu.tools.request_trace "
+            "requests.jsonl [--waterfall N] [--diff B.jsonl]"
+        )
+    except Exception as e:
+        print(f"request tracing ..... {RED_NO} ({type(e).__name__}: {e})")
+    print("-" * 60)
     return 0
 
 

@@ -438,6 +438,45 @@ class WatchdogConfig(DSConfigModel):
 
 
 @dataclass
+class RequestTraceConfig(DSConfigModel):
+    """telemetry.request_trace section (ISSUE 11 tentpole): the
+    request-lifecycle tracing plane (``telemetry/request_trace.py``). When
+    enabled, a :class:`~deepspeed_tpu.telemetry.request_trace.RequestTracer`
+    records a span-structured per-request timeline (submit, cause-attributed
+    queue waits, prefill chunks, per-step decode/verify emissions with
+    drafted/accepted counts, retries, eviction/finish) and emits ONE
+    schema-versioned JSONL record per terminal request through the
+    StepTracer machinery — buffered appends, size-capped atomic rotation
+    (``max_mb`` → ``<file>.1``), dsan-shimmed locking. All recording is
+    host-side list appends: no device syncs, always-on-cheap (bench pins
+    ≤ 2% on the offered-load sweep). ``path`` "" puts ``requests.jsonl``
+    under ``telemetry.trace_path``. ``max_events_per_request`` bounds one
+    request's event list (further events are counted dropped, never
+    unbounded memory). Consumed by ``ServingEngine`` (the scheduler is the
+    event source), ``tools/request_trace.py`` (waterfall / SLO report /
+    diff CLI) and ``serving/replay.py`` (the trace-replay harness scores
+    goodput + SLO attainment from the emitted records)."""
+
+    enabled: bool = False
+    path: str = ""  # "" = <telemetry.trace_path>/requests.jsonl
+    flush_interval: int = 20
+    max_mb: int = 64  # 0 = unbounded
+    max_events_per_request: int = 4096
+
+    def __post_init__(self):
+        if int(self.max_events_per_request) < 1:
+            raise DeepSpeedConfigError(
+                "telemetry.request_trace.max_events_per_request must be "
+                f">= 1, got {self.max_events_per_request}"
+            )
+        if int(self.flush_interval) < 1:
+            raise DeepSpeedConfigError(
+                "telemetry.request_trace.flush_interval must be >= 1, got "
+                f"{self.flush_interval}"
+            )
+
+
+@dataclass
 class TelemetryConfig(DSConfigModel):
     """telemetry section (TPU-native; no reference analog — subsumes the
     reference's scattered observability: timer log lines, flops-profiler
@@ -464,6 +503,8 @@ class TelemetryConfig(DSConfigModel):
     trace_max_mb: int = 64  # 0 = unbounded
     introspection: IntrospectionConfig = field(default_factory=IntrospectionConfig)
     watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
+    # ISSUE 11: request-lifecycle tracing (serving) — see RequestTraceConfig
+    request_trace: RequestTraceConfig = field(default_factory=RequestTraceConfig)
 
 
 @dataclass
@@ -763,6 +804,81 @@ class PrefixCacheConfig(DSConfigModel):
 
 
 @dataclass
+class SLOConfig(DSConfigModel):
+    """serving.slo section (ISSUE 11): declarative per-class latency
+    targets feeding goodput / SLO-attainment accounting.
+
+    ``classes`` maps a class name to its targets::
+
+        "slo": {
+          "classes": {
+            "interactive": {"ttft_target_s": 0.5, "tpot_target_s": 0.05},
+            "batch":       {"ttft_target_s": 30.0}
+          },
+          "default_class": "batch"
+        }
+
+    A target of 0 (or an omitted key) means "no target on this axis". A
+    request submitted with ``slo_class=None`` lands in ``default_class``
+    ("" = the first declared class); an unknown class also degrades to the
+    default (recorded in the request trace) rather than rejecting — SLO
+    accounting is observability, not admission control. A FINISHED request
+    **meets** its SLO when TTFT ≤ ``ttft_target_s`` AND mean TPOT ≤
+    ``tpot_target_s`` (each axis skipped when untargeted); every other
+    terminal status misses. **Attainment** per class = met / evaluated;
+    **goodput** = tokens of SLO-met requests per wall-clock second —
+    surfaced as ``serving_slo_attainment{slo_class}`` /
+    ``serving_goodput_tokens_per_sec`` gauges, ``stats()["slo"]``, and the
+    per-request trace records (docs/REQUEST_TRACING.md)."""
+
+    classes: Dict[str, Dict[str, float]] = field(default_factory=dict)
+    default_class: str = ""  # "" = first declared class
+
+    def __post_init__(self):
+        for name, targets in (self.classes or {}).items():
+            if not isinstance(targets, dict):
+                raise DeepSpeedConfigError(
+                    f"serving.slo.classes[{name!r}] must be a dict of "
+                    f"targets, got {type(targets).__name__}"
+                )
+            for k, v in targets.items():
+                if k not in ("ttft_target_s", "tpot_target_s"):
+                    raise DeepSpeedConfigError(
+                        f"serving.slo.classes[{name!r}]: unknown target "
+                        f"{k!r} (ttft_target_s | tpot_target_s)"
+                    )
+                if float(v) < 0:
+                    raise DeepSpeedConfigError(
+                        f"serving.slo.classes[{name!r}].{k} must be >= 0, "
+                        f"got {v}"
+                    )
+        if self.default_class and self.default_class not in (self.classes or {}):
+            raise DeepSpeedConfigError(
+                f"serving.slo.default_class {self.default_class!r} is not a "
+                f"declared class ({sorted(self.classes or {})})"
+            )
+
+    def resolve_class(self, name: Optional[str]) -> str:
+        """The class a request lands in: its own when declared, else the
+        default (explicit ``default_class`` or the first declared class),
+        else ""."""
+        if name and name in (self.classes or {}):
+            return name
+        if self.default_class:
+            return self.default_class
+        return next(iter(self.classes), "") if self.classes else ""
+
+    def targets(self, name: str) -> Dict[str, float]:
+        """{"ttft_target_s": x, "tpot_target_s": y} for a class (0 = no
+        target on that axis; unknown class = no targets)."""
+        t = (self.classes or {}).get(name, {})
+        return {
+            "ttft_target_s": float(t.get("ttft_target_s", 0.0) or 0.0),
+            "tpot_target_s": float(t.get("tpot_target_s", 0.0) or 0.0),
+        }
+
+
+@dataclass
 class ServingConfig(DSConfigModel):
     """serving section (TPU-native; no reference analog — the reference serves
     one static batch per ``InferenceEngine.forward`` call). Drives the
@@ -815,6 +931,8 @@ class ServingConfig(DSConfigModel):
     # whole-prompt prefill; prefix-cache tails always use the chunk program
     # (width = this value when set, else one page).
     prefill_chunk_tokens: int = 0
+    # --- ISSUE 11: per-tenant SLO classes + goodput accounting -------------
+    slo: SLOConfig = field(default_factory=SLOConfig)
 
     def __post_init__(self):
         for key in ("max_slots", "page_size", "num_pages", "max_prompt_len",
@@ -829,6 +947,8 @@ class ServingConfig(DSConfigModel):
             self.speculative = SpeculativeConfig.from_dict(self.speculative)
         if isinstance(self.prefix_cache, dict):
             self.prefix_cache = PrefixCacheConfig.from_dict(self.prefix_cache)
+        if isinstance(self.slo, dict):
+            self.slo = SLOConfig.from_dict(self.slo)
         if int(self.prefill_chunk_tokens) < 0:
             raise DeepSpeedConfigError(
                 "serving.prefill_chunk_tokens must be >= 0, got "

@@ -11,9 +11,13 @@ The layer above the kernels that wins serving throughput at scale (PAPERS.md
 - :mod:`~deepspeed_tpu.serving.scheduler` — :class:`ServingEngine`: slots,
   admission control, deadlines, speculation drafts, telemetry
 - :mod:`~deepspeed_tpu.serving.request` — request lifecycle
+- :mod:`~deepspeed_tpu.serving.replay` — the seeded trace-replay workload
+  harness (bursty arrivals, heavy-tailed prompts, hot-tenant prefix skew;
+  ISSUE 11) that scores goodput + SLO attainment from request traces
 
 Entry point: ``deepspeed_tpu.init_inference(...).serve(serving_config)``, or
-the ``serving`` section of the engine config. See docs/SERVING.md.
+the ``serving`` section of the engine config. See docs/SERVING.md and
+docs/REQUEST_TRACING.md.
 """
 
 from .kv_cache import (
@@ -23,6 +27,13 @@ from .kv_cache import (
     SlotTable,
     pages_for,
 )
+from .replay import (
+    ReplayClock,
+    ReplayItem,
+    WorkloadSpec,
+    generate_workload,
+    replay,
+)
 from .request import Request, RequestStatus
 from .scheduler import ServingEngine
 
@@ -30,9 +41,14 @@ __all__ = [
     "PageAllocator",
     "PageAllocatorError",
     "PrefixCache",
+    "ReplayClock",
+    "ReplayItem",
     "Request",
     "RequestStatus",
     "ServingEngine",
     "SlotTable",
+    "WorkloadSpec",
+    "generate_workload",
     "pages_for",
+    "replay",
 ]

@@ -55,6 +55,13 @@ class Request:
     # many tokens (None = healthy); set by the scheduler at admission
     stall_after: Optional[int] = None
 
+    # -- SLO / tenancy (ISSUE 11) --------------------------------------
+    # tenant is a free-form accounting dimension (per-tenant counters +
+    # trace records); slo_class names a ``serving.slo.classes`` entry —
+    # the scheduler resolves unknown/empty to the configured default
+    tenant: str = "default"
+    slo_class: str = ""
+
     # -- prefix cache (ISSUE 10) ---------------------------------------
     # prompt tokens served from shared prefix-index pages at admission
     # (0 = cold); the tail past this point was prefilled normally
@@ -68,8 +75,19 @@ class Request:
     tokens: List[int] = field(default_factory=list)
     detail: str = ""            # why rejected/truncated
     t_submit: float = 0.0
+    t_admit: Optional[float] = None   # queue wait ends: slot assigned
+    # set on retry rewind: the request re-entered the queue at this time,
+    # so the next admission's queue wait measures from here, not from the
+    # original submit (which would fold the failed attempt's service time
+    # into a wait that never happened)
+    t_requeue: Optional[float] = None
     t_first_token: Optional[float] = None
     t_finish: Optional[float] = None
+    # one wall timestamp per emitted token (parallel to ``tokens``): a
+    # speculative verify step emits its accepted run at ONE instant, so the
+    # entries repeat — exactly what a streaming client observes (ISSUE 11;
+    # inter-token quantiles derive from these, not from the mean)
+    t_emissions: List[float] = field(default_factory=list)
 
     @property
     def done(self) -> bool:
@@ -105,8 +123,33 @@ class Request:
         return self.t_first_token - self.t_submit
 
     @property
+    def queue_wait_s(self) -> Optional[float]:
+        """Enqueue → slot assignment (admission); None while still queued
+        or rejected at the door. After a retry rewind the wait measures
+        from the re-queue, not the original submit."""
+        if self.t_admit is None:
+            return None
+        return self.t_admit - (
+            self.t_requeue if self.t_requeue is not None else self.t_submit
+        )
+
+    @property
     def tpot_s(self) -> Optional[float]:
         """Mean time per output token AFTER the first (decode cadence)."""
         if self.t_finish is None or self.t_first_token is None or len(self.tokens) < 2:
             return None
         return (self.t_finish - self.t_first_token) / (len(self.tokens) - 1)
+
+    @property
+    def inter_token_gaps_s(self) -> List[float]:
+        """Per-token arrival deltas from the emission timestamps — the
+        streaming-client view. Tokens a verify step emitted together have
+        gap 0; the gap preceding an accepted run carries that step's whole
+        latency. ``serving_tpot_seconds`` observes THESE (ISSUE 11), so its
+        quantiles are what a client percentile-monitors, not the
+        per-request mean. Delegates to the one derivation the offline
+        scorer also uses, so the stats()-reproduces-trace cross-check can
+        never drift."""
+        from ..telemetry.request_trace import inter_token_gaps
+
+        return inter_token_gaps(self.t_emissions)

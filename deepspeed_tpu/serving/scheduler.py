@@ -71,6 +71,7 @@ import numpy as np
 
 from ..models.gpt2 import GPT2Config
 from ..telemetry.registry import MetricsRegistry
+from ..telemetry.request_trace import LATENCY_BUCKETS, RequestTracer
 from ..utils.logging import log_dist
 from . import model as smodel
 from .kv_cache import (
@@ -83,12 +84,10 @@ from .kv_cache import (
 )
 from .request import Request, RequestStatus
 
-# TTFT/TPOT histogram buckets (seconds): sub-ms CPU-sim steps through
-# multi-second queue waits
-LATENCY_BUCKETS = (
-    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
-    1.0, 2.5, 5.0, 10.0, 30.0,
-)
+# TTFT/TPOT/queue-wait histogram buckets (seconds): sub-ms CPU-sim steps
+# through multi-second queue waits. Defined in telemetry/request_trace.py so
+# trace-derived quantiles (tools/request_trace.py) interpolate over the SAME
+# bounds as these histograms and reproduce stats() exactly (ISSUE 11).
 
 
 def _host_prng_key(seed: int) -> np.ndarray:
@@ -147,7 +146,8 @@ class ServingEngine:
     ledger before relaxing this — Engine C will flag the first thread this
     module grows that touches them."""
 
-    def __init__(self, engine, config=None, clock=time.monotonic, fault_injector=None):
+    def __init__(self, engine, config=None, clock=time.monotonic, fault_injector=None,
+                 tracer=None):
         from ..runtime.config import ServingConfig
 
         if config is None:
@@ -157,6 +157,14 @@ class ServingEngine:
         self.config = config
         self.engine = engine
         self.clock = clock
+        # request-lifecycle tracing (ISSUE 11): explicit tracer wins, else
+        # the owning engine's telemetry plane provides one
+        # (telemetry.request_trace), else tracing is off (zero overhead —
+        # every hook is one None check)
+        self.tracer: Optional[RequestTracer] = (
+            tracer if tracer is not None
+            else getattr(getattr(engine, "telemetry", None), "request_tracer", None)
+        )
         # resilience (ISSUE 7): deterministic fault injection + drain state
         self.fault_injector = (
             fault_injector
@@ -211,6 +219,21 @@ class ServingEngine:
         self.completed: List[Request] = []
         self._sampling = float(config.temperature) > 0.0
 
+        # -- ISSUE 11: SLO classes + per-tenant accounting -----------------
+        self._slo = getattr(config, "slo", None)
+        self._slo_enabled = bool(self._slo and self._slo.classes)
+        # class -> [met, evaluated]; tenant -> accounting dict
+        self._slo_counts: dict = {}
+        self.tenants: dict = {}
+        # per-ENGINE terminal-status counts: the tracer ledger and the
+        # registry counter are both telemetry-plane-scoped, so two engines
+        # sharing one plane would report each other's requests through
+        # either — stats()["by_status"] must stay this engine's own
+        self._status_counts: dict = {}
+        self._slo_good_tokens = 0
+        self._t_first_submit: Optional[float] = None
+        self._backoff_pending = False  # a retry is (possibly) in its window
+
         # -- ISSUE 10: speculative decode / prefix cache / chunked prefill --
         self.spec = getattr(config, "speculative", None)
         self.spec_enabled = bool(self.spec and self.spec.enabled)
@@ -249,7 +272,14 @@ class ServingEngine:
             "serving_ttft_seconds", "submit → first token", buckets=LATENCY_BUCKETS
         )
         self._h_tpot = m.histogram(
-            "serving_tpot_seconds", "mean per-token decode latency per request",
+            "serving_tpot_seconds",
+            "inter-token emission latency, streaming-client view (one "
+            "observation per gap; a speculative accepted run lands at one "
+            "instant, so its intra-run gaps are 0)",
+            buckets=LATENCY_BUCKETS,
+        )
+        self._h_qwait = m.histogram(
+            "serving_queue_wait_seconds", "submit → slot admission",
             buckets=LATENCY_BUCKETS,
         )
         self._h_step = m.histogram(
@@ -332,6 +362,26 @@ class ServingEngine:
         )
         self._g_index_pages = m.gauge(
             "serving_prefix_index_pages", "pages held live by the prefix index"
+        )
+        # -- ISSUE 11: SLO / goodput / per-tenant instruments --------------
+        self._g_slo = m.gauge(
+            "serving_slo_attainment",
+            "SLO-met / SLO-evaluated terminal requests per class",
+            labelnames=("slo_class",),
+        )
+        self._g_goodput = m.gauge(
+            "serving_goodput_tokens_per_sec",
+            "tokens from SLO-met requests per wall second since first submit",
+        )
+        self._c_tenant_requests = m.counter(
+            "serving_tenant_requests_total",
+            "terminal requests by tenant and status (tenant cardinality is "
+            "the caller's responsibility)",
+            labelnames=("tenant", "status"),
+        )
+        self._c_tenant_tokens = m.counter(
+            "serving_tenant_tokens_total", "generated tokens by tenant",
+            labelnames=("tenant",),
         )
         # anomaly watchdog (ISSUE 5): shared with the owning engine's
         # telemetry when present — straggler trips land in the same trace
@@ -448,17 +498,32 @@ class ServingEngine:
         seed: int = 0,
         eos_token_id: Optional[int] = None,
         deadline_s: Optional[float] = None,
+        tenant: str = "default",
+        slo_class: Optional[str] = None,
     ) -> Request:
         """Enqueue one request. Backpressure REJECTS at the door (queue depth,
         or a prompt that can never fit); an over-long ``max_new_tokens`` is
-        clamped and the response marked TRUNCATED at finish."""
+        clamped and the response marked TRUNCATED at finish. ``tenant`` is a
+        free-form accounting dimension; ``slo_class`` names a
+        ``serving.slo.classes`` entry (unknown/None → the configured
+        default — SLO accounting is observability, never admission
+        control)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         mnt = int(self.config.max_new_tokens if max_new_tokens is None else max_new_tokens)
         req = Request(
             prompt=prompt, max_new_tokens=mnt, seed=int(seed),
             eos_token_id=eos_token_id, deadline_s=deadline_s,
+            tenant=str(tenant),
+            slo_class=(
+                self._slo.resolve_class(slo_class) if self._slo_enabled
+                else (slo_class or "")
+            ),
         )
         req.t_submit = self.clock()
+        if self._t_first_submit is None:
+            self._t_first_submit = req.t_submit
+        if self.tracer is not None:
+            self.tracer.submit(req, req.t_submit)
         plen = req.prompt_len
         if plen < 1 or plen > int(self.config.max_prompt_len):
             return self._reject(
@@ -475,18 +540,23 @@ class ServingEngine:
             req.max_new_tokens = cap
             req.detail = f"max_new_tokens clamped {mnt} -> {cap}"
         if self._draining:
-            return self._reject(req, "engine draining (admission stopped)")
+            return self._reject(req, "engine draining (admission stopped)",
+                                cause="draining")
         if len(self.queue) >= int(self.config.max_queue_depth):
-            return self._reject(req, f"queue full ({self.config.max_queue_depth})")
+            return self._reject(req, f"queue full ({self.config.max_queue_depth})",
+                                cause="queue_depth")
         self.queue.append(req)
         self._g_queue.set(len(self.queue))
         return req
 
-    def _reject(self, req: Request, why: str) -> Request:
+    def _reject(self, req: Request, why: str, cause: str = "invalid") -> Request:
         req.status = RequestStatus.REJECTED
         req.detail = why
         req.t_finish = self.clock()
         self._c_requests.inc(status=RequestStatus.REJECTED)
+        if self.tracer is not None:
+            self.tracer.event(req, "reject", req.t_finish, cause=cause)
+        self._req_terminal(req, req.t_finish)
         self.completed.append(req)
         return req
 
@@ -524,10 +594,26 @@ class ServingEngine:
                     req.detail = "deadline exceeded while queued"
                     req.t_finish = now
                     self._c_requests.inc(status=RequestStatus.TIMED_OUT)
+                    self._req_terminal(req, now)
                     self.completed.append(req)
                 else:
                     keep.append(req)
             self.queue = keep
+
+        # queue-wait attribution (ISSUE 11): requests sitting out a retry
+        # backoff window are waiting on themselves, not on capacity — note
+        # it once per scheduler step so the trace can split queue wait by
+        # cause (the admission loop below attributes the capacity causes).
+        # _backoff_pending gates the queue scan: retries are rare and a
+        # deep queue would otherwise pay the walk every step
+        if self.tracer is not None and self._backoff_pending:
+            waiting = False
+            for r in self.queue:
+                if r.not_before > now:
+                    self.tracer.note_wait(r, "backoff")
+                    waiting = True
+            if not waiting:
+                self._backoff_pending = False
 
         # 2. prefill insertions: FIFO admission into free slots, gated by the
         # KV-page budget (head-of-line blocks until draining slots free
@@ -542,6 +628,17 @@ class ServingEngine:
                 (i for i, s in enumerate(self.slots) if s.request is None), None
             )
             if free is None:
+                # all slots busy: the ready head of line waited this step
+                # on slot capacity (queue depth, in SLO terms). The ready
+                # scan only serves that attribution — skip it untraced
+                if self.tracer is not None:
+                    idx = next(
+                        (j for j, r in enumerate(self.queue)
+                         if r.not_before <= now),
+                        None,
+                    )
+                    if idx is not None:
+                        self.tracer.note_wait(self.queue[idx], "no_free_slot")
                 break
             idx = next(
                 (j for j, r in enumerate(self.queue) if r.not_before <= now),
@@ -560,6 +657,8 @@ class ServingEngine:
                     # allocate past the pool
                     need = self._pages_needed(req)
                 if need > self.allocator.free_pages:
+                    if self.tracer is not None:
+                        self.tracer.note_wait(req, "page_budget")
                     break
             del self.queue[idx]
             self._admit(free, req)
@@ -617,14 +716,45 @@ class ServingEngine:
                 dt if self._ema_step_s == 0.0
                 else 0.8 * self._ema_step_s + 0.2 * dt
             )
+            # pass 1 — tokens + trace events for EVERY slot, batched into
+            # ONE tracer ingestion (one lock round-trip per step, not per
+            # slot), and ingested BEFORE any retirement below can fold a
+            # finishing request's buffer into its terminal record
+            emitted: list = []
+            ev_batch: list = []
             for i in active:
-                slot = self.slots[i]
-                req = slot.request
+                req = self.slots[i].request
                 if self.spec_enabled:
                     toks = self._accept_tokens(req, drafts[i], out_np[i])
                 else:
                     toks = [int(out_np[i])]
                 req.tokens.extend(toks)
+                # one emission timestamp per token: an accepted speculative
+                # run lands at ONE instant — the streaming-client truth the
+                # TPOT quantiles derive from (ISSUE 11)
+                req.t_emissions.extend([now] * len(toks))
+                if self.tracer is not None:
+                    ev_batch.append((req.id, {
+                        "e": "verify", "t": now, "step": self._step_count,
+                        "slot": i, "emitted": len(toks),
+                        "drafted": self.spec_k, "accepted": len(toks) - 1,
+                        "total": len(req.tokens),
+                    } if self.spec_enabled else (
+                        # plain decode: the lean columnar series (emitted
+                        # is always 1) — this line runs for every slot of
+                        # every step the engine ever takes
+                        now, self._step_count, i,
+                    )))
+                emitted.append((i, toks))
+            if ev_batch:
+                if self.spec_enabled:
+                    self.tracer.step_events(ev_batch)
+                else:
+                    self.tracer.decode_events(ev_batch)
+            # pass 2 — advance/retire the slots
+            for i, toks in emitted:
+                slot = self.slots[i]
+                req = slot.request
                 slot.pos += len(toks)
                 slot.step += 1
                 self.table.seq_lens[i] = slot.pos
@@ -741,6 +871,11 @@ class ServingEngine:
 
     def _admit(self, slot_i: int, req: Request) -> None:
         self._admissions += 1
+        # queue wait ends here: the request owns a slot
+        req.t_admit = self.clock()
+        qw = req.queue_wait_s
+        if qw is not None:
+            self._h_qwait.observe(qw)
         if (
             req.stall_after is None
             and self.fault_injector is not None
@@ -794,6 +929,18 @@ class ServingEngine:
         slot.prefilling = False
         req.prefix_shared_tokens = shared_tokens
         req.cow_forked = cow_page is not None
+        if self.tracer is not None:
+            self.tracer.event(
+                req, "admit", req.t_admit, step=self._step_count,
+                slot=slot_i, queue_wait_s=qw, pages=total,
+                shared_pages=len(shared), shared_tokens=shared_tokens,
+                prefix_kind=(
+                    ("full" if cow_page is not None
+                     else ("partial" if shared else "miss"))
+                    if self.prefix_cache is not None else None
+                ),
+                retries=req.retries,
+            )
 
         use_chunks = self.chunk_width > 0 and (
             shared_tokens > 0
@@ -828,6 +975,12 @@ class ServingEngine:
         # deliberate sync: TTFT is defined by the first token reaching the
         # host, and an at-admission EOS must retire the slot before decode
         tok0 = int(jax.device_get(first)[0])  # dslint: disable=host-sync-in-step
+        if self.tracer is not None:
+            self.tracer.event(
+                req, "prefill", self.clock(), step=self._step_count,
+                slot=slot_i, width=self.prefill_width,
+                prompt_len=req.prompt_len,
+            )
         self._start_decoding(slot_i, tok0)
 
     def _advance_chunk(self, slot_i: int) -> None:
@@ -856,6 +1009,12 @@ class ServingEngine:
         self.k_pool, self.v_pool = kp, vp
         self._c_chunks.inc()
         slot.prefill_pos = start + C
+        if self.tracer is not None:
+            self.tracer.event(
+                req, "prefill_chunk", self.clock(), step=self._step_count,
+                slot=slot_i, start=start, width=C,
+                final=slot.prefill_pos >= req.prompt_len,
+            )
         if slot.prefill_pos < req.prompt_len:
             return  # more chunks; the decode batch advances meanwhile
         self._c_prefills.inc()
@@ -877,9 +1036,18 @@ class ServingEngine:
             slot.prefilling = False
             slot.row = None
         req.status = RequestStatus.RUNNING
+        # TTFT = the first SAMPLED token reaching the host. Under chunked
+        # prefill that is the LAST chunk's sample (earlier chunks emit
+        # nothing a client could stream) — the ISSUE 11 pin.
         req.t_first_token = now
         self._h_ttft.observe(now - req.t_submit)
         req.tokens.append(tok0)
+        req.t_emissions.append(now)
+        if self.tracer is not None:
+            self.tracer.event(
+                req, "first_token", now, step=self._step_count, slot=slot_i,
+                ttft_s=now - req.t_submit,
+            )
         slot.pos = req.prompt_len
         self.table.seq_lens[slot_i] = slot.pos
         self.table.tokens[slot_i] = tok0
@@ -925,15 +1093,69 @@ class ServingEngine:
         if detail:
             req.detail = detail
         req.t_finish = now
-        tpot = req.tpot_s
-        if tpot is not None:
-            self._h_tpot.observe(tpot)
+        # ISSUE 11 fix: observe per-emission inter-token gaps, not the
+        # per-request mean — a speculative verify step emits k+1 tokens at
+        # one instant, and a streaming client's p99 sees those 0-gaps plus
+        # the full step latency before the run, not a flattering average
+        for gap in req.inter_token_gaps_s:
+            self._h_tpot.observe(gap)
         self._c_requests.inc(status=status)
         self._c_tokens.inc(len(req.tokens))
         self.allocator.free(slot.pages)
         self.table.clear(slot_i)
         self.slots[slot_i] = _Slot()
+        self._req_terminal(req, now)
         self.completed.append(req)
+
+    def _slo_verdict(self, req: Request) -> Optional[dict]:
+        """The request's SLO outcome against its class targets, or None
+        when no SLO accounting applies (no classes configured, or the
+        class declares no targets). Only a FINISHED request can meet its
+        SLO; a missing TPOT measurement (< 2 tokens) passes that axis."""
+        if not self._slo_enabled:
+            return None
+        t = self._slo.targets(req.slo_class)
+        if t["ttft_target_s"] <= 0 and t["tpot_target_s"] <= 0:
+            return None
+        met = req.status == RequestStatus.FINISHED
+        if met and t["ttft_target_s"] > 0:
+            met = req.ttft_s is not None and req.ttft_s <= t["ttft_target_s"]
+        if met and t["tpot_target_s"] > 0:
+            tp = req.tpot_s
+            met = tp is None or tp <= t["tpot_target_s"]
+        return {"class": req.slo_class, **t, "met": bool(met)}
+
+    def _req_terminal(self, req: Request, now: float) -> None:
+        """Every terminal transition funnels here (ISSUE 11): the SLO
+        verdict + goodput ledger, per-tenant accounting, and the trace
+        record. ``req.t_finish`` is already set."""
+        self._status_counts[req.status] = (
+            self._status_counts.get(req.status, 0) + 1
+        )
+        verdict = self._slo_verdict(req)
+        if verdict is not None:
+            cnt = self._slo_counts.setdefault(req.slo_class, [0, 0])
+            cnt[1] += 1
+            if verdict["met"]:
+                cnt[0] += 1
+                self._slo_good_tokens += len(req.tokens)
+            self._g_slo.set(cnt[0] / cnt[1], slo_class=req.slo_class)
+        ten = self.tenants.setdefault(req.tenant, {
+            "requests": 0, "tokens": 0, "slo_met": 0, "slo_evaluated": 0,
+        })
+        ten["requests"] += 1
+        ten["tokens"] += len(req.tokens)
+        if verdict is not None:
+            ten["slo_evaluated"] += 1
+            ten["slo_met"] += int(verdict["met"])
+        self._c_tenant_requests.inc(tenant=req.tenant, status=req.status)
+        if req.tokens:
+            self._c_tenant_tokens.inc(len(req.tokens), tenant=req.tenant)
+        if self.tracer is not None:
+            self.tracer.finish(
+                req, req.t_finish if req.t_finish is not None else now,
+                slo=verdict,
+            )
 
     def _fail_slot(self, slot_i: int, why: str, now: float) -> None:
         """Transient slot failure (ISSUE 7): reclaim the slot and pages
@@ -951,15 +1173,26 @@ class ServingEngine:
             req.stall_after = None  # the injected fault is one-shot
             req.tokens = []
             # the retry regenerates from scratch — drop the incremental
-            # drafter index built over the discarded output
+            # drafter index built over the discarded output, and the
+            # emission/admission timeline with it (queue wait and TPOT are
+            # re-measured from the re-admission)
             object.__setattr__(req, "_draft_state", None)
             req.status = RequestStatus.QUEUED
             req.t_first_token = None
+            req.t_admit = None
+            req.t_requeue = now
+            req.t_emissions = []
             req.not_before = now + float(
                 getattr(self.config, "retry_backoff_s", 0.05)
             ) * (2 ** (req.retries - 1))
             req.detail = f"retry {req.retries}/{retry_max}: {why}"
             self._c_retries.inc()
+            self._backoff_pending = True
+            if self.tracer is not None:
+                self.tracer.event(
+                    req, "retry", now, cause=why, retries=req.retries,
+                    not_before=req.not_before,
+                )
             self.queue.append(req)
             self._g_queue.set(len(self.queue))
         else:
@@ -969,6 +1202,7 @@ class ServingEngine:
             )
             req.t_finish = now
             self._c_requests.inc(status=RequestStatus.FAILED)
+            self._req_terminal(req, now)
             self.completed.append(req)
 
     def drain(self, deadline_s: Optional[float] = None) -> dict:
@@ -995,6 +1229,7 @@ class ServingEngine:
             req.t_finish = start
             self._c_requests.inc(status=RequestStatus.PREEMPTED)
             self._c_drained.inc()
+            self._req_terminal(req, start)
             self.completed.append(req)
             preempted += 1
         finished = 0
@@ -1016,6 +1251,9 @@ class ServingEngine:
         self._g_queue.set(0)
         self._g_util.set(0.0)
         self._g_pages.set(self.allocator.pages_in_use)
+        if self.tracer is not None:
+            # every request is terminal now — make the records durable
+            self.tracer.flush()
         log_dist(
             f"serving drain complete in {now - start:.3f}s: "
             f"{finished} finished in-flight, {preempted} preempted"
@@ -1204,7 +1442,7 @@ class ServingEngine:
         out: dict = {}
         for name, hist in (
             ("ttft", self._h_ttft), ("tpot", self._h_tpot),
-            ("decode_step", self._h_step),
+            ("decode_step", self._h_step), ("queue_wait", self._h_qwait),
         ):
             total, n = hist.stats()
             entry = {"count": n, "mean_s": (total / n) if n else None}
@@ -1224,6 +1462,40 @@ class ServingEngine:
         out["retried"] = int(self._c_retries.value())
         out["draining"] = self._draining
         # -- ISSUE 10: sharing / speculation / chunking invariant counters --
+        # -- ISSUE 11: per-terminal-status counts + SLO/goodput/tenancy ----
+        # engine-local (every terminal path funnels _req_terminal): the
+        # tracer ledger and registry counters are telemetry-plane-scoped
+        # and would mix engines sharing one plane
+        out["by_status"] = dict(self._status_counts)
+        now = self.clock()
+        goodput = None
+        if self._slo_enabled and self._t_first_submit is not None:
+            span = max(now - self._t_first_submit, 1e-12)
+            goodput = self._slo_good_tokens / span
+            self._g_goodput.set(goodput)
+            out["slo"] = {
+                "goodput_tokens_per_sec": goodput,
+                "classes": {
+                    cls: {
+                        "met": met, "evaluated": ev,
+                        "attainment": (met / ev) if ev else None,
+                    }
+                    for cls, (met, ev) in sorted(self._slo_counts.items())
+                },
+            }
+        if self.tenants:
+            out["tenants"] = {t: dict(v) for t, v in sorted(self.tenants.items())}
+        if self.tracer is not None:
+            out["request_trace"] = {
+                "path": self.tracer.file_path,
+                "records": self.tracer.records_emitted,
+                "live": self.tracer.live_requests,
+                "rotations": self.tracer.rotations,
+                "events_dropped": self.tracer.events_dropped,
+                "records_lost": self.tracer.records_lost,
+            }
+            if self.tracer.encode_error is not None:
+                out["request_trace"]["encode_error"] = self.tracer.encode_error
         out["kv_pages_shared"] = self.allocator.pages_shared
         out["kv_cow_forks"] = self.allocator.cow_forks_total
         out["chunk_prefills"] = int(self._c_chunks.value())

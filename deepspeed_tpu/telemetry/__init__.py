@@ -19,6 +19,7 @@ None check per step.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Dict, List, Optional
 
@@ -26,6 +27,7 @@ from . import compile_stats, introspect
 from . import watchdog as watchdog_mod
 from .exporters import MonitorBridge, PrometheusTextfileExporter
 from .registry import Counter, Gauge, Histogram, MetricsRegistry
+from .request_trace import RequestTracer
 from .tracer import Span, StepTracer, aggregate_scalars, spans_to_tree
 from .watchdog import AnomalyError, AnomalyWatchdog
 
@@ -33,7 +35,7 @@ __all__ = [
     "AnomalyError", "AnomalyWatchdog",
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "MonitorBridge", "PrometheusTextfileExporter",
-    "Span", "StepTracer", "Telemetry",
+    "RequestTracer", "Span", "StepTracer", "Telemetry",
     "aggregate_scalars", "device_hbm_stats", "from_config", "introspect",
     "spans_to_tree",
 ]
@@ -93,6 +95,18 @@ class Telemetry:
             registry=self.registry,
             tracer=self.tracer,
         )
+        # ISSUE 11: request-lifecycle tracing — picked up by ServingEngine
+        # (the scheduler is the event source; nothing here is per-step)
+        self.request_tracer: Optional[RequestTracer] = None
+        rt = getattr(config, "request_trace", None)
+        if rt is not None and getattr(rt, "enabled", False):
+            self.request_tracer = RequestTracer(
+                rt.path or os.path.join(config.trace_path or ".", "requests.jsonl"),
+                flush_interval=int(rt.flush_interval),
+                max_bytes=int(rt.max_mb) * 2**20,
+                max_events_per_request=int(rt.max_events_per_request),
+                process_index=process_index,
+            )
         compile_stats.install(self.registry)
 
     # -- wiring --------------------------------------------------------
@@ -242,6 +256,8 @@ class Telemetry:
     def flush(self) -> None:
         if self.tracer is not None:
             self.tracer.flush()
+        if self.request_tracer is not None:
+            self.request_tracer.flush()
         if self.prometheus is not None:
             self.prometheus.export()
 
@@ -249,6 +265,8 @@ class Telemetry:
         self.flush()
         if self.tracer is not None:
             self.tracer.close()
+        if self.request_tracer is not None:
+            self.request_tracer.close()
 
 
 def _is_num(v) -> bool:

@@ -30,6 +30,29 @@ DEFAULT_BUCKETS = (
 _INF = float("inf")
 
 
+def quantile_from_buckets(buckets, counts, n, q):
+    """The Prometheus ``histogram_quantile`` estimator over CUMULATIVE
+    bucket ``counts`` (``counts[i]`` = observations <= ``buckets[i]``):
+    linear interpolation inside the landing bucket; the +Inf bucket
+    clamps to its lower edge. The ONE shared implementation —
+    ``Histogram.quantile`` and ``telemetry.request_trace`` both call it,
+    which is what keeps trace-derived quantiles equal to ``stats()``."""
+    if n == 0:
+        return None
+    rank = q * n
+    prev_bound, prev_cum = 0.0, 0
+    for bound, cum in zip(buckets, counts):
+        if cum >= rank:
+            if bound == _INF:
+                return prev_bound
+            if cum == prev_cum:
+                return bound
+            frac = (rank - prev_cum) / (cum - prev_cum)
+            return prev_bound + (bound - prev_bound) * frac
+        prev_bound, prev_cum = (0.0 if bound == _INF else bound), cum
+    return prev_bound
+
+
 def _escape_label(v: str) -> str:
     return str(v).replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
 
@@ -159,20 +182,7 @@ class Histogram(_Metric):
                 self._key(labels), ([0] * len(self.buckets), 0.0, 0)
             )
             counts = list(counts)  # buckets are cumulative (observe() adds
-        if n == 0:                 # to every bucket >= value)
-            return None
-        rank = q * n
-        prev_bound, prev_cum = 0.0, 0
-        for bound, cum in zip(self.buckets, counts):
-            if cum >= rank:
-                if bound == _INF:
-                    return prev_bound
-                if cum == prev_cum:
-                    return bound
-                frac = (rank - prev_cum) / (cum - prev_cum)
-                return prev_bound + (bound - prev_bound) * frac
-            prev_bound, prev_cum = (0.0 if bound == _INF else bound), cum
-        return prev_bound
+        return quantile_from_buckets(self.buckets, counts, n, q)
 
     def value(self, **labels) -> float:
         raise TypeError(

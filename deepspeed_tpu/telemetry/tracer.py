@@ -175,6 +175,19 @@ class StepTracer:
             if len(self._buffer) >= self.flush_interval:
                 self._flush_locked()
 
+    def emit_serialized(self, line: str) -> None:
+        """Append one ALREADY-SERIALIZED JSONL line, skipping the
+        ``_jsonable`` sanitize + re-encode of :meth:`emit`. For callers
+        that construct records JSON-native end to end (RequestTracer's
+        terminal records — ISSUE 11): the defensive per-record sanitize
+        pass was the request-tracing plane's single biggest hot-path cost.
+        Same buffering, flush cadence and size-capped rotation as emit."""
+        with self._lock:
+            self._note_buffer_write()
+            self._buffer.append(line)
+            if len(self._buffer) >= self.flush_interval:
+                self._flush_locked()
+
     def emit_aggregate(self, record: Dict[str, Any]) -> None:
         """Rank-0-only aggregated record (caller runs aggregate_scalars)."""
         clean = {k: _jsonable(v) for k, v in record.items()}

@@ -1,0 +1,789 @@
+"""Request-lifecycle tracing plane (ISSUE 11): RequestTracer schema +
+rotation, replay-harness determinism, SLO/goodput math, TTFT/TPOT streaming
+accounting, stats() satellites and the CLI — all on the deterministic CPU
+serving simulation.
+
+The acceptance pin: a seeded replay emits per-request JSONL from which
+``tools/request_trace.py`` reproduces the engine's own ``stats()``
+TTFT/TPOT quantiles, and the traced engine's token streams stay
+bit-identical to sequential ``generate``.
+"""
+
+import json
+import os
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models import gpt2
+from deepspeed_tpu.serving import (
+    ReplayClock,
+    RequestStatus,
+    WorkloadSpec,
+    generate_workload,
+    replay,
+)
+from deepspeed_tpu.telemetry.request_trace import (
+    SCHEMA,
+    RequestTraceError,
+    RequestTracer,
+    histogram_quantile,
+    inter_token_gaps,
+    load_request_records,
+    score_requests,
+    time_binned,
+)
+from deepspeed_tpu.tools import request_trace as cli
+
+warnings.filterwarnings("ignore")
+
+pytestmark = pytest.mark.serving
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class TickingClock:
+    def __init__(self, dt=0.05):
+        self.t = 0.0
+        self.dt = dt
+
+    def __call__(self):
+        t, self.t = self.t, self.t + self.dt
+        return t
+
+
+@pytest.fixture(scope="module")
+def tiny_cfg():
+    return gpt2.get_config("gpt2-tiny", attn_impl="jnp")
+
+
+@pytest.fixture(scope="module")
+def inference_engine(tiny_cfg):
+    from deepspeed_tpu.inference.engine import InferenceEngine
+
+    params = gpt2.init_params(tiny_cfg, jax.random.PRNGKey(0))
+    return InferenceEngine(
+        gpt2.make_module(tiny_cfg), params=params, dtype=jnp.float32
+    )
+
+
+SERVING_CFG = {
+    "max_slots": 4,
+    "page_size": 4,
+    "num_pages": 64,
+    "max_prompt_len": 12,
+    "max_new_tokens": 8,
+    "kv_cache_dtype": "float32",
+}
+
+SLO_CFG = {
+    "classes": {
+        "interactive": {"ttft_target_s": 0.5, "tpot_target_s": 0.2},
+        "batch": {"ttft_target_s": 5.0},
+    },
+    "default_class": "batch",
+}
+
+
+def _mk_tracer(tmp_path, **kw):
+    return RequestTracer(str(tmp_path / "requests.jsonl"), flush_interval=1, **kw)
+
+
+def _traced_engine(inference_engine, tmp_path, scfg=None, clock=None, **kw):
+    tr = _mk_tracer(tmp_path, **kw)
+    srv = inference_engine.serve(
+        dict(SERVING_CFG, **(scfg or {})),
+        clock=clock if clock is not None else TickingClock(0.01),
+        tracer=tr,
+    )
+    return srv, tr
+
+
+# ---------------------------------------------------------------------------
+# schema round-trip + correlation keys
+# ---------------------------------------------------------------------------
+
+class TestTracerSchema:
+    def test_records_roundtrip_and_correlate(self, tiny_cfg, inference_engine, tmp_path):
+        srv, tr = _traced_engine(
+            inference_engine, tmp_path, scfg={"slo": SLO_CFG}
+        )
+        rs = np.random.RandomState(5)
+        reqs = []
+        for i, plen in enumerate((3, 7, 11, 5, 8, 2)):
+            p = rs.randint(0, tiny_cfg.vocab_size, (plen,)).astype(np.int32)
+            reqs.append(srv.submit(
+                p, max_new_tokens=5, seed=i, tenant=f"t{i % 2}",
+                slo_class="interactive" if i % 2 else None,
+            ))
+        srv.run()
+        srv.check_no_leaks()
+        tr.flush()
+        recs = load_request_records(tr.file_path)
+        assert len(recs) == 6
+        by_id = {r["id"]: r for r in recs}
+        for req in reqs:
+            rec = by_id[req.id]
+            assert rec["schema"] == SCHEMA and rec["kind"] == "request"
+            assert rec["status"] == RequestStatus.FINISHED
+            assert rec["tenant"] == req.tenant
+            # unknown/None slo_class resolved to the configured default
+            assert rec["slo_class"] in ("interactive", "batch")
+            assert rec["n_tokens"] == len(req.tokens) == 5
+            # one emission timestamp per token, non-decreasing
+            assert len(rec["emissions"]) == 5
+            assert rec["emissions"] == sorted(rec["emissions"])
+            assert rec["queue_wait_s"] is not None and rec["queue_wait_s"] >= 0
+            assert rec["ttft_s"] == pytest.approx(req.ttft_s)
+            assert rec["slo"] is not None and rec["slo"]["met"] in (True, False)
+            kinds = [e["e"] for e in rec["events"]]
+            assert kinds[0] == "submit"
+            assert "admit" in kinds and "first_token" in kinds
+            # the columnar decode series carries the (step, slot)
+            # correlation key: one [t, step, slot] triple per decode step
+            decodes = rec["decode"]
+            assert len(decodes) == 4  # 5 tokens: 1 from prefill + 4 decodes
+            assert all(
+                len(d) == 3 and isinstance(d[1], int) and isinstance(d[2], int)
+                for d in decodes
+            )
+            # the series' timestamps ARE the post-first-token emissions
+            assert [d[0] for d in decodes] == rec["emissions"][1:]
+        # correlation across requests: concurrently-resident slots share
+        # batched step ordinals
+        all_steps = [
+            {d[1] for d in by_id[r.id]["decode"]}
+            for r in reqs[:4]  # first four were co-resident (4 slots)
+        ]
+        assert set.intersection(*all_steps)
+        # tracer ledger == engine view
+        assert tr.status_counts == {"finished": 6}
+        assert tr.records_emitted == 6 and tr.live_requests == 0
+
+    def test_reject_timeout_and_wait_causes(self, tiny_cfg, inference_engine, tmp_path):
+        clock = FakeClock()
+        srv, tr = _traced_engine(
+            inference_engine, tmp_path,
+            scfg={"max_queue_depth": 2, "max_slots": 1, "num_pages": 8},
+            clock=clock,
+        )
+        rs = np.random.RandomState(0)
+        mk = lambda n: rs.randint(0, tiny_cfg.vocab_size, (n,)).astype(np.int32)
+        a = srv.submit(mk(4), max_new_tokens=4)          # will run
+        srv.step()                                       # admits a
+        b = srv.submit(mk(4), max_new_tokens=4)          # queued behind a
+        c = srv.submit(mk(4), max_new_tokens=4)          # queued (depth 2)
+        d = srv.submit(mk(4), max_new_tokens=4)          # queue full -> reject
+        assert d.status == RequestStatus.REJECTED
+        e = srv.submit(mk(20), max_new_tokens=4)         # oversize -> reject
+        assert e.status == RequestStatus.REJECTED
+        srv.step()  # b and c wait on the single busy slot
+        srv.step()
+        srv.run()
+        tr.flush()
+        recs = {r["id"]: r for r in load_request_records(tr.file_path)}
+        assert recs[d.id]["status"] == RequestStatus.REJECTED
+        assert recs[d.id]["events"][-1]["e"] == "reject"
+        assert recs[d.id]["events"][-1]["cause"] == "queue_depth"
+        assert recs[e.id]["events"][-1]["cause"] == "invalid"
+        # the head of line waited on the busy slot, attributed by cause
+        assert recs[b.id]["waits"].get("no_free_slot", 0) >= 1
+        assert set(recs) == {a.id, b.id, c.id, d.id, e.id}
+        by_status = srv.stats()["by_status"]
+        assert by_status == {"finished": 3, "rejected": 2}
+        assert by_status == tr.status_counts
+        srv.check_no_leaks()
+
+    def test_rotation_under_dsan_shim_zero_findings(self, tmp_path):
+        """Size-capped rotation while the dsan runtime sanitizer observes
+        the tracer's real lock schedule — records survive the roll and the
+        sanitizer reports nothing."""
+        from deepspeed_tpu.analysis import runtime_sanitizer as S
+        from deepspeed_tpu.serving import Request
+
+        san = S.enable(S.RuntimeSanitizer())
+        try:
+            tr = RequestTracer(
+                str(tmp_path / "rot.jsonl"), flush_interval=1, max_bytes=4096
+            )
+            n = 40
+            for i in range(n):
+                req = Request(prompt=np.arange(4, dtype=np.int32), max_new_tokens=2)
+                req.t_submit = float(i)
+                tr.submit(req, req.t_submit)
+                tr.event(req, "admit", float(i) + 0.1, step=i, slot=0)
+                req.status = RequestStatus.FINISHED
+                req.t_admit = req.t_submit + 0.1
+                req.t_first_token = req.t_submit + 0.2
+                req.t_finish = req.t_submit + 0.3
+                req.tokens = [1, 2]
+                req.t_emissions = [req.t_first_token, req.t_finish]
+                tr.finish(req, req.t_finish)
+            tr.flush()
+            assert tr.rotations >= 1
+            assert os.path.exists(tr.file_path + ".1")
+            # ONE rolled generation is kept (disk bounded at ~2x the cap):
+            # the loader returns the most recent records, contiguous and
+            # whole — no torn or half-rotated lines
+            recs = load_request_records(tr.file_path)  # reads .1 then live
+            assert 0 < len(recs) <= n
+            subs = [r["t_submit"] for r in recs]
+            assert subs == [float(i) for i in range(n - len(recs), n)]
+            assert san.findings() == []
+        finally:
+            S.disable()
+
+    def test_schema_and_corruption_errors(self, tmp_path):
+        bad = tmp_path / "bad.jsonl"
+        bad.write_text('{"kind": "request", "schema": "v999", "id": 1}\n')
+        with pytest.raises(RequestTraceError, match="schema"):
+            load_request_records(str(bad))
+        binary = tmp_path / "bin.jsonl"
+        binary.write_bytes(b"\x00\xff\x00\xff" * 64)
+        with pytest.raises(RequestTraceError):
+            load_request_records(str(binary))
+        # a torn TAIL is tolerated (killed run mid-append)
+        ok = tmp_path / "torn.jsonl"
+        rec = {"kind": "request", "schema": SCHEMA, "id": 1, "status": "finished",
+               "t_submit": 0.0, "t_finish": 1.0, "n_tokens": 2}
+        ok.write_text(json.dumps(rec) + "\n" + '{"kind": "requ')
+        assert len(load_request_records(str(ok))) == 1
+        with pytest.raises(RequestTraceError, match="no such"):
+            load_request_records(str(tmp_path / "absent.jsonl"))
+
+    def test_event_cap_counts_drops(self, tmp_path):
+        from deepspeed_tpu.serving import Request
+
+        tr = RequestTracer(
+            str(tmp_path / "cap.jsonl"), flush_interval=1,
+            max_events_per_request=3,
+        )
+        req = Request(prompt=np.arange(2, dtype=np.int32), max_new_tokens=1)
+        tr.submit(req, 0.0)
+        for i in range(10):
+            tr.event(req, "decode", float(i), step=i, slot=0)
+        req.status = RequestStatus.FINISHED
+        req.t_finish = 1.0
+        tr.finish(req, 1.0)
+        tr.flush()
+        rec = load_request_records(tr.file_path)[0]
+        assert len(rec["events"]) == 3
+        assert rec["events_dropped"] == 8
+        assert tr.events_dropped == 8
+
+
+# ---------------------------------------------------------------------------
+# TTFT/TPOT streaming accounting (ISSUE 11 satellite)
+# ---------------------------------------------------------------------------
+
+class TestStreamingLatencyAccounting:
+    def test_chunked_prefill_ttft_is_first_sampled_token(
+        self, tiny_cfg, inference_engine, tmp_path
+    ):
+        """Chunked prefill: TTFT is pinned to the FIRST SAMPLED token —
+        which the LAST chunk emits — not to any earlier chunk's dispatch."""
+        clock = TickingClock(0.01)
+        srv, tr = _traced_engine(
+            inference_engine, tmp_path,
+            scfg={"prefill_chunk_tokens": 4}, clock=clock,
+        )
+        rs = np.random.RandomState(2)
+        p = rs.randint(0, tiny_cfg.vocab_size, (12,)).astype(np.int32)
+        req = srv.submit(p, max_new_tokens=3)
+        srv.run()
+        srv.check_no_leaks()
+        tr.flush()
+        rec = load_request_records(tr.file_path)[0]
+        chunks = [e for e in rec["events"] if e["e"] == "prefill_chunk"]
+        assert len(chunks) == 3  # 12 tokens / 4-wide chunks
+        first = next(e for e in rec["events"] if e["e"] == "first_token")
+        # the first token exists only after the final chunk ran
+        assert first["t"] >= max(c["t"] for c in chunks)
+        assert rec["emissions"][0] == req.t_first_token == pytest.approx(
+            rec["t_first_token"]
+        )
+        # the final chunk is flagged, earlier ones are not
+        assert [c["final"] for c in chunks] == [False, False, True]
+
+    def test_verify_step_emissions_share_one_instant(
+        self, tiny_cfg, inference_engine, tmp_path
+    ):
+        """Speculative verify: an accepted run lands at one timestamp, so
+        the streaming TPOT histogram sees its intra-run gaps as 0 — not a
+        flattering per-request mean."""
+        srv, tr = _traced_engine(
+            inference_engine, tmp_path,
+            scfg={"speculative": {"enabled": True, "k": 4}},
+        )
+        # a repetitive prompt the n-gram drafter nails
+        p = np.asarray([7, 8, 9] * 4, np.int32)
+        req = srv.submit(p, max_new_tokens=8)
+        srv.run()
+        srv.check_no_leaks()
+        tr.flush()
+        rec = load_request_records(tr.file_path)[0]
+        verifies = [e for e in rec["events"] if e["e"] == "verify"]
+        assert verifies and any(e["emitted"] > 1 for e in verifies)
+        assert all(e["drafted"] == 4 for e in verifies)
+        # emissions of one verify step share a timestamp → 0 gaps
+        gaps = inter_token_gaps(rec["emissions"])
+        assert len(gaps) == len(req.tokens) - 1
+        assert any(g == 0.0 for g in gaps)
+        # the engine histogram observed exactly these gaps
+        total, n = srv.metrics.histogram("serving_tpot_seconds").stats()
+        assert n == len(gaps)
+        assert total == pytest.approx(sum(gaps))
+
+    def test_tpot_histogram_counts_gaps_not_requests(
+        self, tiny_cfg, inference_engine, tmp_path
+    ):
+        srv, tr = _traced_engine(inference_engine, tmp_path)
+        rs = np.random.RandomState(9)
+        for i in range(3):
+            p = rs.randint(0, tiny_cfg.vocab_size, (4,)).astype(np.int32)
+            srv.submit(p, max_new_tokens=5, seed=i)
+        srv.run()
+        srv.check_no_leaks()
+        _, n = srv.metrics.histogram("serving_tpot_seconds").stats()
+        assert n == 3 * 4  # (5 tokens - 1) gaps per request
+
+
+# ---------------------------------------------------------------------------
+# stats() satellite: queue wait + by-status
+# ---------------------------------------------------------------------------
+
+class TestStatsSatellite:
+    def test_queue_wait_quantiles_and_by_status(self, tiny_cfg, inference_engine):
+        srv = inference_engine.serve(
+            dict(SERVING_CFG, max_slots=2), clock=TickingClock(0.02)
+        )
+        rs = np.random.RandomState(4)
+        for i in range(6):  # 6 requests over 2 slots: real queue waits
+            p = rs.randint(0, tiny_cfg.vocab_size, (4 + i,)).astype(np.int32)
+            srv.submit(p, max_new_tokens=4, seed=i)
+        srv.run()
+        srv.check_no_leaks()
+        st = srv.stats()
+        qw = st["queue_wait"]
+        assert qw["count"] == 6
+        assert qw["p50_s"] is not None and qw["p99_s"] is not None
+        assert qw["p50_s"] <= qw["p95_s"] <= qw["p99_s"]
+        # without a tracer the terminal counts come from the registry
+        assert st["by_status"] == {"finished": 6}
+        g = srv.metrics.get("serving_queue_wait_seconds")
+        assert g is not None and g.stats()[1] == 6
+
+    def test_slo_and_tenant_accounting(self, tiny_cfg, inference_engine):
+        srv = inference_engine.serve(
+            dict(SERVING_CFG, slo=SLO_CFG), clock=TickingClock(0.01)
+        )
+        rs = np.random.RandomState(6)
+        for i in range(4):
+            p = rs.randint(0, tiny_cfg.vocab_size, (5,)).astype(np.int32)
+            srv.submit(
+                p, max_new_tokens=4, seed=i,
+                tenant=f"tenant-{i % 2}",
+                slo_class="interactive" if i < 2 else "batch",
+            )
+        srv.run()
+        srv.check_no_leaks()
+        st = srv.stats()
+        slo = st["slo"]
+        assert slo["goodput_tokens_per_sec"] > 0
+        assert slo["classes"]["interactive"]["evaluated"] == 2
+        assert slo["classes"]["batch"]["evaluated"] == 2
+        for cls in ("interactive", "batch"):  # generous targets: all met
+            assert slo["classes"][cls]["attainment"] == 1.0
+        assert st["tenants"]["tenant-0"]["requests"] == 2
+        assert st["tenants"]["tenant-1"]["tokens"] == 8
+        m = srv.metrics
+        assert m.counter(
+            "serving_tenant_requests_total", labelnames=("tenant", "status")
+        ).value(tenant="tenant-0", status="finished") == 2
+        assert m.gauge(
+            "serving_slo_attainment", labelnames=("slo_class",)
+        ).value(slo_class="interactive") == 1.0
+        assert m.gauge("serving_goodput_tokens_per_sec").value() > 0
+
+
+# ---------------------------------------------------------------------------
+# replay harness determinism
+# ---------------------------------------------------------------------------
+
+class TestReplayHarness:
+    SPEC = dict(
+        n_requests=10, vocab_size=256, max_prompt_len=12, max_new_tokens=4,
+        base_interarrival_s=0.02, diurnal_amplitude=0.6, burst_factor=2.0,
+        n_tenants=3, prefix_fraction=0.5,
+        slo_classes=["interactive", "batch"],
+    )
+
+    def test_same_seed_identical_workload(self):
+        a = generate_workload(WorkloadSpec(seed=11, **self.SPEC))
+        b = generate_workload(WorkloadSpec(seed=11, **self.SPEC))
+        c = generate_workload(WorkloadSpec(seed=12, **self.SPEC))
+        assert [it.key() for it in a] == [it.key() for it in b]
+        assert [it.key() for it in a] != [it.key() for it in c]
+        # arrivals strictly ordered, prompts within budget, tenants skewed
+        ts = [it.t_arrival for it in a]
+        assert ts == sorted(ts) and ts[0] > 0
+        assert all(1 <= len(it.prompt) <= 12 for it in a)
+        assert len({it.tenant for it in a}) >= 2
+
+    def test_replay_trace_deterministic(self, tiny_cfg, inference_engine, tmp_path):
+        spec = WorkloadSpec(seed=21, **self.SPEC)
+
+        def run(sub):
+            d = tmp_path / sub
+            d.mkdir()
+            tr = RequestTracer(str(d / "requests.jsonl"), flush_interval=1)
+            srv = inference_engine.serve(
+                SERVING_CFG, clock=ReplayClock(), tracer=tr
+            )
+            res = replay(srv, generate_workload(spec), step_dt=0.01)
+            srv.check_no_leaks()
+            tr.flush()
+            recs = load_request_records(tr.file_path)
+            # strip wall-clock/identity fields the StepTracer stamps
+            for r in recs:
+                r.pop("ts", None)
+                r.pop("host", None)
+                r.pop("id", None)
+            return res, sorted(recs, key=lambda r: r["t_submit"])
+
+        res_a, recs_a = run("a")
+        res_b, recs_b = run("b")
+        assert res_a["steps"] == res_b["steps"]
+        assert recs_a == recs_b  # identical per-request traces, field for field
+
+    def test_replay_emits_waits_under_overload(self, tiny_cfg, inference_engine, tmp_path):
+        spec = WorkloadSpec(
+            seed=3, **dict(self.SPEC, n_requests=16, base_interarrival_s=0.001)
+        )
+        tr = _mk_tracer(tmp_path)
+        srv = inference_engine.serve(
+            dict(SERVING_CFG, max_slots=2), clock=ReplayClock(), tracer=tr
+        )
+        replay(srv, generate_workload(spec), step_dt=0.05)
+        srv.check_no_leaks()
+        tr.flush()
+        recs = load_request_records(tr.file_path)
+        assert len(recs) == 16
+        # near-simultaneous arrivals over 2 slots: someone waited on slots
+        assert any(r["waits"].get("no_free_slot") for r in recs)
+        assert any(r["queue_wait_s"] > 0 for r in recs)
+
+
+# ---------------------------------------------------------------------------
+# SLO / goodput math on hand-built traces
+# ---------------------------------------------------------------------------
+
+def _hand_record(i, cls, status, t0, t1, n_tokens, met, tenant="t0"):
+    rec = {
+        "kind": "request", "schema": SCHEMA, "id": i, "tenant": tenant,
+        "slo_class": cls, "status": status, "detail": "",
+        "prompt_len": 4, "max_new_tokens": n_tokens, "n_tokens": n_tokens,
+        "retries": 0, "t_submit": t0, "t_admit": t0 + 0.1,
+        "t_first_token": t0 + 0.2, "t_finish": t1,
+        "queue_wait_s": 0.1, "ttft_s": 0.2,
+        "tpot_mean_s": 0.05 if n_tokens > 1 else None,
+        "emissions": [t0 + 0.2 + 0.05 * k for k in range(n_tokens)],
+        "prefix": {"shared_tokens": 0, "cow": False},
+        "waits": {}, "events_dropped": 0, "events": [],
+    }
+    if met is not None:
+        rec["slo"] = {"class": cls, "ttft_target_s": 0.5,
+                      "tpot_target_s": 0.2, "met": met}
+    return rec
+
+
+class TestSLOMath:
+    def test_score_requests_exact(self):
+        # wall clock: first submit t=0, last finish t=10 → 10s span
+        recs = [
+            _hand_record(1, "gold", "finished", 0.0, 1.0, 10, True),
+            _hand_record(2, "gold", "finished", 2.0, 3.0, 10, True),
+            _hand_record(3, "gold", "truncated", 4.0, 5.0, 6, False),
+            _hand_record(4, "", "finished", 6.0, 10.0, 8, None),  # no SLO
+        ]
+        score = score_requests(recs)
+        assert score["wall_s"] == pytest.approx(10.0)
+        gold = score["groups"]["gold"]
+        assert gold["slo_evaluated"] == 3 and gold["slo_met"] == 2
+        assert gold["slo_attainment"] == pytest.approx(2 / 3)
+        # goodput counts ONLY SLO-met tokens over the whole wall span
+        assert gold["goodput_tokens_per_sec"] == pytest.approx(20 / 10.0)
+        assert gold["throughput_tokens_per_sec"] == pytest.approx(26 / 10.0)
+        overall = score["overall"]
+        assert overall["slo_attainment"] == pytest.approx(2 / 3)
+        assert overall["goodput_tokens_per_sec"] == pytest.approx(2.0)
+        assert overall["throughput_tokens_per_sec"] == pytest.approx(3.4)
+        # tenant grouping view
+        by_tenant = score_requests(recs, key=lambda r: r["tenant"])
+        assert by_tenant["groups"]["t0"]["requests"] == 4
+
+    def test_queue_waits_counts_every_admission(self):
+        """A retried request is admitted twice and the engine histogram
+        observed both waits — scoring must too (the summary field keeps
+        only the final admission)."""
+        from deepspeed_tpu.telemetry.request_trace import queue_waits
+
+        rec = _hand_record(1, "gold", "finished", 0.0, 1.0, 4, True)
+        assert queue_waits(rec) == [0.1]  # summary fallback: no admit events
+        rec["events"] = [
+            {"e": "submit", "t": 0.0},
+            {"e": "admit", "t": 0.05, "queue_wait_s": 0.05},
+            {"e": "retry", "t": 0.2, "retries": 1},
+            {"e": "admit", "t": 0.4, "queue_wait_s": 0.2},
+        ]
+        assert queue_waits(rec) == [0.05, 0.2]
+        score = score_requests([rec])
+        # both admissions land in the queue-wait quantile source
+        assert score["groups"]["gold"]["queue_wait_p99_s"] is not None
+
+    def test_failed_records_excluded_from_tpot(self):
+        """The engine only observes inter-token gaps on the _finish_slot
+        path; a FAILED request (retry budget spent) keeps its partial
+        emissions in the trace but they must not enter trace-derived TPOT
+        — otherwise the CLI diverges from stats() on fault-injected
+        runs."""
+        ok = _hand_record(1, "gold", "finished", 0.0, 1.0, 4, True)
+        bad = _hand_record(2, "gold", "failed", 0.0, 1.0, 4, False)
+        # give the failed record wildly slow emissions: if they leak into
+        # the gap pool the p99 jumps an order of magnitude
+        bad["emissions"] = [0.2 + 2.0 * k for k in range(4)]
+        only_ok = score_requests([ok])["groups"]["gold"]
+        both = score_requests([ok, bad])["groups"]["gold"]
+        assert both["tpot_p99_s"] == only_ok["tpot_p99_s"]
+
+    def test_overall_metrics_ttft_counts_every_attempt(self):
+        """The CLI/bench run-level TTFT quantiles read every attempt's
+        first_token event (the engine histogram observed each), not just
+        the final attempt's summary field — the retry twin of the
+        queue-wait pin above."""
+        from deepspeed_tpu.telemetry.request_trace import ttfts
+        from deepspeed_tpu.tools.request_trace import _overall_metrics
+
+        rec = _hand_record(1, "gold", "finished", 0.0, 1.0, 4, True)
+        assert ttfts(rec) == [0.2]  # summary fallback: no events
+        rec["events"] = [
+            {"e": "first_token", "t": 0.3, "ttft_s": 0.3},
+            {"e": "retry", "t": 0.5, "retries": 1},
+            {"e": "first_token", "t": 1.3, "ttft_s": 1.3},
+        ]
+        assert ttfts(rec) == [0.3, 1.3]
+        # both attempts move the p99: with only the summary field (0.2)
+        # the quantile would sit in the 0.25 bucket, not up at 1.3's
+        m = _overall_metrics([rec])
+        assert m["ttft_p99_s"] > 0.5
+
+    def test_queue_wait_remeasured_from_requeue(self):
+        """A retry rewind re-enqueues the request: the next admission's
+        queue wait measures from the re-queue, not the original submit —
+        the failed attempt's service time is not admission pressure."""
+        from deepspeed_tpu.serving.request import Request
+
+        req = Request(prompt=np.zeros(2, np.int32), max_new_tokens=4)
+        req.t_submit = 0.1
+        req.t_admit = 0.2
+        assert req.queue_wait_s == pytest.approx(0.1)
+        # attempt fails at t=5.1 after ~5s of decode; rewind re-queues
+        req.t_admit = None
+        req.t_requeue = 5.1
+        assert req.queue_wait_s is None
+        req.t_admit = 5.25
+        assert req.queue_wait_s == pytest.approx(0.15)
+
+    def test_histogram_quantile_matches_registry(self):
+        from deepspeed_tpu.telemetry.registry import MetricsRegistry
+        from deepspeed_tpu.telemetry.request_trace import LATENCY_BUCKETS
+
+        rs = np.random.RandomState(0)
+        values = rs.exponential(0.05, 200).tolist()
+        h = MetricsRegistry().histogram("x", buckets=LATENCY_BUCKETS)
+        for v in values:
+            h.observe(v)
+        for q in (0.5, 0.95, 0.99):
+            assert histogram_quantile(values, q) == pytest.approx(h.quantile(q))
+
+    def test_time_binned_shape(self):
+        recs = [
+            _hand_record(i, "gold", "finished", float(i), float(i) + 1.0, 4, True)
+            for i in range(8)
+        ]
+        bins = time_binned(recs, bins=4)
+        assert len(bins) == 4
+        assert sum(b["arrivals"] for b in bins) == 8
+        assert all(b["decode_mean_s"] is not None for b in bins if b["arrivals"])
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def trace_file(tiny_cfg, inference_engine, tmp_path):
+    tr = _mk_tracer(tmp_path)
+    srv = inference_engine.serve(
+        dict(SERVING_CFG, slo=SLO_CFG), clock=TickingClock(0.01), tracer=tr
+    )
+    spec = WorkloadSpec(
+        n_requests=8, seed=1, vocab_size=tiny_cfg.vocab_size,
+        max_prompt_len=12, max_new_tokens=4, base_interarrival_s=0.0,
+        slo_classes=["interactive", "batch"],
+    )
+    replay(srv, generate_workload(spec))
+    srv.check_no_leaks()
+    tr.flush()
+    return srv, tr.file_path
+
+
+class TestCLI:
+    def test_report_and_waterfall_exit0(self, trace_file, capsys):
+        _, path = trace_file
+        assert cli.main([path, "--waterfall", "3", "--bins", "4"]) == 0
+        out = capsys.readouterr().out
+        assert "SLO attainment" in out and "req " in out and "window" in out
+        assert cli.main([path, "--by", "tenant", "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["by"] == "tenant" and doc["records"] == 8
+
+    def test_single_request_waterfall(self, trace_file, capsys):
+        _, path = trace_file
+        rid = load_request_records(path)[0]["id"]
+        assert cli.main([path, "--request", str(rid)]) == 0
+        assert f"req {rid}" in capsys.readouterr().out
+        assert cli.main([path, "--request", "999999"]) == 2
+
+    def test_diff_identical_exit0_degraded_exit1(self, trace_file, tmp_path, capsys):
+        _, path = trace_file
+        assert cli.main([path, "--diff", path]) == 0
+        # hand-degrade: double every latency, halve goodput via longer wall
+        recs = load_request_records(path)
+        for r in recs:
+            r["ttft_s"] *= 4.0
+            r["queue_wait_s"] *= 4.0
+            r["t_finish"] = r["t_submit"] + 4.0 * (r["t_finish"] - r["t_submit"])
+            r["emissions"] = [r["t_submit"] + 4.0 * (t - r["t_submit"])
+                              for t in r["emissions"]]
+        bad = tmp_path / "degraded.jsonl"
+        bad.write_text("".join(json.dumps(r) + "\n" for r in recs))
+        assert cli.main([path, "--diff", str(bad), "--threshold-pct", "50"]) == 1
+        assert "REGRESSED" in capsys.readouterr().out
+
+    def test_min_attainment_gate(self, trace_file, tmp_path, capsys):
+        _, path = trace_file
+        assert cli.main([path, "--min-attainment", "0"]) == 0
+        # force misses: rewrite verdicts to false
+        recs = load_request_records(path)
+        for r in recs:
+            if r.get("slo"):
+                r["slo"]["met"] = False
+        bad = tmp_path / "missed.jsonl"
+        bad.write_text("".join(json.dumps(r) + "\n" for r in recs))
+        assert cli.main([str(bad), "--min-attainment", "50"]) == 1
+
+    def test_parse_errors_exit2(self, tmp_path):
+        junk = tmp_path / "junk.jsonl"
+        junk.write_bytes(b"\xde\xad\xbe\xef" * 32)
+        assert cli.main([str(junk)]) == 2
+        empty = tmp_path / "empty.jsonl"
+        empty.write_text("")
+        assert cli.main([str(empty)]) == 2
+        assert cli.main([str(tmp_path / "nope.jsonl")]) == 2
+
+
+# ---------------------------------------------------------------------------
+# acceptance: trace reproduces the engine's own stats; bit-equivalence holds
+# ---------------------------------------------------------------------------
+
+class TestAcceptance:
+    def test_cli_reproduces_engine_quantiles(self, tiny_cfg, inference_engine, tmp_path):
+        """stats() quantiles are bucket-interpolated estimates; the CLI uses
+        the SAME buckets + estimator over the traced values, so the numbers
+        agree to float precision — the trace IS the engine's truth."""
+        tr = _mk_tracer(tmp_path)
+        srv = inference_engine.serve(
+            dict(SERVING_CFG, slo=SLO_CFG), clock=TickingClock(0.013), tracer=tr
+        )
+        spec = WorkloadSpec(
+            n_requests=12, seed=7, vocab_size=tiny_cfg.vocab_size,
+            max_prompt_len=12, max_new_tokens=6, base_interarrival_s=0.05,
+            slo_classes=["interactive", "batch"],
+        )
+        replay(srv, generate_workload(spec))
+        srv.check_no_leaks()
+        tr.flush()
+        st = srv.stats()
+        m = cli._overall_metrics(load_request_records(tr.file_path))
+        assert m["ttft_p50_s"] == pytest.approx(st["ttft"]["p50_s"], rel=1e-9)
+        assert m["ttft_p99_s"] == pytest.approx(st["ttft"]["p99_s"], rel=1e-9)
+        assert m["tpot_p50_s"] == pytest.approx(st["tpot"]["p50_s"], rel=1e-9)
+        assert m["tpot_p99_s"] == pytest.approx(st["tpot"]["p99_s"], rel=1e-9)
+        assert m["queue_wait_p99_s"] == pytest.approx(
+            st["queue_wait"]["p99_s"], rel=1e-9
+        )
+        assert m["slo_attainment"] is not None
+        assert st["slo"]["goodput_tokens_per_sec"] > 0
+
+    def test_bit_equivalence_with_tracing_enabled(
+        self, tiny_cfg, inference_engine, tmp_path
+    ):
+        """Tracing is pure host-side observation: the traced engine's token
+        streams stay bit-identical to sequential generate."""
+        srv, tr = _traced_engine(inference_engine, tmp_path)
+        rs = np.random.RandomState(13)
+        reqs = []
+        for i, plen in enumerate((3, 8, 5, 12)):
+            p = rs.randint(0, tiny_cfg.vocab_size, (plen,)).astype(np.int32)
+            reqs.append((p, srv.submit(p, max_new_tokens=6, seed=i)))
+        srv.run()
+        srv.check_no_leaks()
+        for p, req in reqs:
+            ref = np.asarray(
+                inference_engine.generate(p[None, :], max_new_tokens=6)
+            )[0]
+            np.testing.assert_array_equal(req.output, ref)
+        tr.flush()
+        assert len(load_request_records(tr.file_path)) == 4
+
+    def test_telemetry_config_builds_tracer(self, tiny_cfg, tmp_path):
+        """The telemetry.request_trace config path: an engine built with the
+        section enabled serves with tracing on, no explicit tracer."""
+        from deepspeed_tpu.inference.engine import InferenceEngine
+
+        params = gpt2.init_params(tiny_cfg, jax.random.PRNGKey(0))
+        eng = InferenceEngine(
+            gpt2.make_module(tiny_cfg), params=params, dtype=jnp.float32,
+            config={"telemetry": {
+                "enabled": True,
+                "trace_path": str(tmp_path / "tel"),
+                "request_trace": {"enabled": True},
+            }},
+        )
+        assert eng.telemetry.request_tracer is not None
+        srv = eng.serve(SERVING_CFG, clock=TickingClock(0.01))
+        assert srv.tracer is eng.telemetry.request_tracer
+        srv.submit(np.arange(4, dtype=np.int32), max_new_tokens=3)
+        srv.run()
+        srv.check_no_leaks()
+        eng.telemetry.flush()
+        recs = load_request_records(eng.telemetry.request_tracer.file_path)
+        assert len(recs) == 1 and recs[0]["status"] == "finished"
+
+    def test_env_report_request_tracing_section(self, capsys):
+        from deepspeed_tpu import env_report
+
+        assert env_report.main() == 0
+        out = capsys.readouterr().out
+        assert "Request tracing" in out
+        assert "replay harness" in out
