@@ -1005,6 +1005,204 @@ def run_replay_bench():
     return pr11
 
 
+def run_kv_quant_bench():
+    """BENCH_pr12.json (ISSUE 12): quantized KV pages + quantized remaining
+    wire. Four measurements:
+
+    1. Engine E kv-pool ledger, bf16 vs int8 at the same num_pages — the
+       acceptance pin: int8 kv-pool bytes <= 0.55x the bf16 pool's (it is
+       exactly 0.5x; scales land under metadata).
+    2. Resident sessions at fixed HBM: how many max-size requests fit the
+       SAME pool byte budget under each dtype (codes + scales both counted
+       for int8) — the "double the sessions per HBM byte" headline.
+    3. Decode-step latency at the 151 MB-equivalent pool (the PR-10 scaling
+       config): f32 vs int8 pools, same num_pages. On CPU this records the
+       dequantize-math cost honestly (the bandwidth win is a TPU property —
+       the kernel reads half the bytes; the pin here is only that decode
+       stays pool-size-independent in both modes).
+    4. comm_wire_bytes logical-vs-wire for the two NEW collective paths —
+       the ZeRO-3 compressed param all-gather and the MoE EP all-to-all —
+       with the PR-2-style >= 3x wire-reduction pin (needs >= 2 devices;
+       recorded as skipped otherwise — BENCH_KVQUANT_ONLY=1 pins 8 host
+       devices so CI always exercises it)."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.models import gpt2
+    from deepspeed_tpu.serving.kv_cache import pages_for, scales_bytes
+
+    cfg = gpt2.get_config("gpt2-tiny", attn_impl="jnp")
+    params = jax.jit(lambda r: gpt2.init_params(cfg, r))(jax.random.PRNGKey(0))
+    eng = InferenceEngine(gpt2.make_module(cfg), params=params, dtype=jnp.float32)
+    base = {
+        "max_slots": 4, "page_size": 4, "num_pages": 64,
+        "max_prompt_len": 12, "max_new_tokens": 8,
+    }
+
+    # -- 1. Engine E ledger: int8 (and f32, the CPU-native reference)
+    # measured kv-pool bytes, pinned against the ANALYTIC bf16 pool — a
+    # bf16 pool on this f32 engine would litter the ledger with full-pool
+    # convert temps and upcast findings (a mismatched config, not a fair
+    # denominator); the bf16 pool's bytes are exact by construction
+    from deepspeed_tpu.serving.kv_cache import pool_bytes as _pool_bytes
+
+    ledger = {}
+    for dt in ("float32", "int8"):
+        srv = eng.serve(dict(base, kv_cache_dtype=dt))
+        findings = srv.verify()
+        ledger[dt] = {
+            name: {
+                "peak_bytes": rec["peak_bytes"],
+                "kv_pool_bytes": rec["kv_pool_bytes"],
+                "metadata_bytes": rec["metadata_bytes"],
+                "kv_scales_bytes": rec["kv_scales_bytes"],
+            }
+            for name, rec in srv.memory_report().items()
+        }
+        ledger[dt]["verify_findings"] = len(findings)
+    bf16_pool = _pool_bytes(
+        cfg.n_layer, base["num_pages"], cfg.n_head, base["page_size"],
+        cfg.head_dim, itemsize=2,
+    )
+    pool_ratios = {
+        qname: rec["kv_pool_bytes"] / bf16_pool
+        for qname, rec in ledger["int8"].items()
+        if isinstance(rec, dict)
+    }
+    pool_pin_ok = bool(pool_ratios) and all(r <= 0.55 for r in pool_ratios.values())
+
+    # -- 2. resident sessions at a fixed HBM byte budget ----------------
+    page = base["page_size"]
+    per_page = {
+        "bf16": 2 * cfg.n_layer * cfg.n_head * page * cfg.head_dim * 2,
+        "int8": 2 * cfg.n_layer * cfg.n_head * page * cfg.head_dim * 1
+                + scales_bytes(cfg.n_layer, 1, cfg.n_head),
+    }
+    budget = base["num_pages"] * per_page["bf16"]  # the bf16 pool's bytes
+    pages_per_session = pages_for(
+        base["max_prompt_len"] + base["max_new_tokens"], page
+    )
+    sessions = {
+        k: (budget // v - 1) // pages_per_session  # page 0 stays scratch
+        for k, v in per_page.items()
+    }
+
+    # -- 3. decode-step latency at the 151 MB-equivalent pool -----------
+    per_page_f32 = 2 * cfg.n_layer * cfg.n_head * page * cfg.head_dim * 4
+    big_pages = max(2, int(151e6) // per_page_f32)
+    latency = {"num_pages": big_pages,
+               "pool_mb_f32": round(big_pages * per_page_f32 / 1e6, 1)}
+    for dt in ("float32", "int8"):
+        srv = eng.serve(dict(base, kv_cache_dtype=dt, num_pages=big_pages))
+        rs = np.random.RandomState(0)
+        for i in range(3):  # fill the slots, warm the decode executable
+            srv.submit(rs.randint(0, cfg.vocab_size, (8,)).astype(np.int32),
+                       max_new_tokens=base["max_new_tokens"], seed=i)
+        srv.step()
+        times = []
+        for _ in range(10):
+            if not any(s.request is not None for s in srv.slots):
+                for i in range(3):
+                    srv.submit(
+                        rs.randint(0, cfg.vocab_size, (8,)).astype(np.int32),
+                        max_new_tokens=base["max_new_tokens"], seed=i,
+                    )
+            t0 = _time.monotonic()
+            srv.step()
+            times.append(_time.monotonic() - t0)
+        latency[f"decode_step_ms_{dt}"] = round(
+            float(np.median(times)) * 1e3, 3
+        )
+        srv.drain(deadline_s=5.0)
+        srv.check_no_leaks()
+
+    # -- 4. the two new compressed collective paths ---------------------
+    from deepspeed_tpu.comm import compressed as cco
+
+    wire = {}
+    devs = jax.devices()
+    world = 8 if len(devs) >= 8 else (len(devs) if len(devs) >= 2 else 0)
+    if world >= 2:
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from deepspeed_tpu.moe.sharded_moe import (
+            MoEConfig, init_moe_mlp_params, moe_mlp_ep,
+        )
+        from deepspeed_tpu.runtime.config import CommCompressionConfig
+        from deepspeed_tpu.runtime.zero.partitioning import (
+            gather_full_compressed,
+        )
+
+        # ZeRO-3 param all-gather: a dp-sharded stand-in param tree
+        mesh = Mesh(np.array(devs[:world]), ("dp",))
+        cco.reset_records()
+        leaf = jax.device_put(
+            jnp.asarray(np.random.RandomState(0).randn(world * 256, 16),
+                        jnp.float32),
+            NamedSharding(mesh, P("dp")),
+        )
+        gather_full_compressed({"w": leaf}, mesh, "dp")
+        rec = cco.records().get(("all_gather", "dp"))
+        if rec:
+            wire["zero3_all_gather"] = {
+                "logical_bytes": rec["logical_bytes"],
+                "wire_bytes": rec["wire_bytes"],
+                "ratio": round(rec["logical_bytes"] / rec["wire_bytes"], 2),
+            }
+        # MoE EP all-to-all through moe_mlp_ep
+        mesh_ep = Mesh(np.array(devs[:world]), ("ep",))
+        mcfg = MoEConfig(num_experts=world, k=1, drop_tokens=False)
+        mparams = init_moe_mlp_params(jax.random.PRNGKey(0), 16, 32, world)
+        x = jnp.asarray(np.random.RandomState(1).randn(world * 2, 4, 16),
+                        jnp.float32)
+        cc = CommCompressionConfig(enabled=True, axes=["ep"])
+        cco.reset_records()
+        jax.jit(lambda p, xx: moe_mlp_ep(
+            p, xx, mcfg, mesh_ep, train=False, comm_compression=cc
+        ))(mparams, x)
+        rec = cco.records().get(("all_to_all", "ep"))
+        if rec:
+            wire["moe_all_to_all"] = {
+                "logical_bytes": rec["logical_bytes"],
+                "wire_bytes": rec["wire_bytes"],
+                "ratio": round(rec["logical_bytes"] / rec["wire_bytes"], 2),
+            }
+    wire_pin_ok = (
+        bool(wire)
+        and all(v["ratio"] >= 3.0 for v in wire.values())
+    ) if world >= 2 else None
+
+    pr12 = {
+        "schema": "bench_pr12_kv_quant_v1",
+        "model": "gpt2-tiny",
+        "backend": jax.default_backend(),
+        "serving_config": base,
+        "engine_e_ledger": ledger,
+        "bf16_pool_bytes_analytic": bf16_pool,
+        "kv_pool_int8_over_bf16": {
+            k: round(v, 4) for k, v in pool_ratios.items()
+        },
+        "kv_pool_pin_max": 0.55,
+        "kv_pool_pin_ok": pool_pin_ok,
+        "resident_sessions_at_fixed_hbm": {
+            "hbm_budget_bytes": budget,
+            "pages_per_session": pages_per_session,
+            "sessions": sessions,
+            "ratio": round(sessions["int8"] / max(1, sessions["bf16"]), 3),
+        },
+        "decode_latency_151mb_equiv": latency,
+        "comm_wire": wire or {"skipped": f"{len(devs)} device(s)"},
+        "wire_pin_min_ratio": 3.0,
+        "wire_pin_ok": wire_pin_ok,
+    }
+    with open(os.path.join(_BENCH_DIR, "BENCH_pr12.json"), "w") as fh:
+        json.dump(pr12, fh, indent=1)
+    return pr12
+
+
 def run_resilience_bench():
     """BENCH_pr7.json (ISSUE 7): save-overhead-per-step of the async
     integrity-checked checkpoint path, and recovery time through the
@@ -1773,6 +1971,20 @@ def main():
             result["replay_slo_by_class"] = pr11["slo_by_class_at_capacity"]
         except Exception as e:
             result["pr11_error"] = f"{type(e).__name__}: {e}"
+    # --- BENCH_pr12.json (ISSUE 12): int8 KV pages + quantized remaining
+    # wire — Engine E kv-pool bf16-vs-int8, resident sessions at fixed HBM,
+    # decode latency at the 151MB-equivalent pool, and the two new
+    # compressed collective paths' wire ratios
+    if os.environ.get("BENCH_SERVING", "1") == "1":
+        try:
+            pr12 = run_kv_quant_bench()
+            result["pr12_artifact"] = "BENCH_pr12.json"
+            result["kv_pool_int8_over_bf16"] = pr12["kv_pool_int8_over_bf16"]
+            result["kv_resident_session_ratio"] = (
+                pr12["resident_sessions_at_fixed_hbm"]["ratio"]
+            )
+        except Exception as e:
+            result["pr12_error"] = f"{type(e).__name__}: {e}"
     # --- BENCH_pr5.json (ISSUE 5): performance-introspection artifact — the
     # HLO analyzer's MFU + per-category flops/bytes from the forced sampled
     # step's record (vs the analytic MFU above), plus a trace_diff self-check:
@@ -1887,6 +2099,16 @@ if __name__ == "__main__":
     elif os.environ.get("BENCH_REPLAY_ONLY", "0") == "1":
         # ISSUE 11: just the trace-replay harness (BENCH_pr11.json)
         print(json.dumps(run_replay_bench()))
+    elif os.environ.get("BENCH_KVQUANT_ONLY", "0") == "1":
+        # ISSUE 12: just the KV-quantization + compressed-wire bench
+        # (BENCH_pr12.json) — pins 8 host devices so the collective paths
+        # always run
+        _flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in _flags:
+            os.environ["XLA_FLAGS"] = (
+                _flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        print(json.dumps(run_kv_quant_bench()))
     elif os.environ.get("BENCH_RESILIENCE_ONLY", "0") == "1":
         print(json.dumps(run_resilience_bench()))
     elif os.environ.get("BENCH_DSAN_ONLY", "0") == "1":

@@ -130,6 +130,11 @@ class MemoryRuleContext:
     # batches, page maps) labeled "metadata"; only integer/pred dtypes
     # match, so a float activation sharing a dim string stays put
     metadata_dims: Sequence[str] = ()
+    # dim strings of the quantized KV pool's per-page scales ([L,P,KV,2]
+    # fp32, ISSUE 12) — also "metadata" (they are bookkeeping beside the
+    # pool, not page payload), but FLOAT, so they get their own declared
+    # list instead of widening metadata_dims' dtype guard
+    scales_dims: Sequence[str] = ()
     # metadata source/op hint that marks a temp buffer as an activation
     activation_hint: str = r"models/|attention|attn|mlp|embed|transformer"
 
@@ -229,6 +234,9 @@ def _categorize(inst: NamedInstruction, ctx: MemoryRuleContext,
         dd in meta_dims and dt in _METADATA_DTYPES
         for dt, dd in inst.result_shapes
     ):
+        return "metadata"
+    scl_dims = frozenset(ctx.scales_dims)
+    if scl_dims and any(dd in scl_dims for _, dd in inst.result_shapes):
         return "metadata"
     if act_re is not None:
         op_m = _META_OP.search(inst.line)
@@ -451,6 +459,8 @@ def analyze_memory_text(
         if dd in pool_dims:
             category = "kv-pool"
         elif dd in meta_dims and dt in _METADATA_DTYPES:
+            category = "metadata"
+        elif dd in frozenset(ctx.scales_dims):
             category = "metadata"
         else:
             category = "params"

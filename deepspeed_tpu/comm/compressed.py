@@ -58,15 +58,12 @@ _FP8_QMAX = 448.0
 # block-scaled quantizers
 # ---------------------------------------------------------------------------
 
-def quantize_blocks(x: jnp.ndarray, method: str = "int8", block: int = 256) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """fp32 ``[n]`` (n % block == 0) -> (payload ``[n]`` int8/fp8, scales
-    ``[n/block]`` fp32). Scale = amax/qmax per block (zero blocks get scale 1
-    so the payload is exactly zero)."""
-    if method not in METHODS:
-        raise ValueError(f"unknown compression method {method!r}; use one of {METHODS}")
-    n = x.shape[-1]
-    assert n % block == 0, (n, block)
-    xb = x.reshape(-1, block).astype(jnp.float32)
+def _quantize_exact(xb: jnp.ndarray, method: str) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Core block codec: ``[..., block]`` fp32 -> (payload, scale ``[..., 1]``).
+    Scale = amax/qmax per block (zero blocks get scale 1 so the payload is
+    exactly zero). The ONE place the scale/round/clip rule lives — the grad
+    collectives, the weight quantizer (``ops/quantizer``) and the KV page
+    codec all route here."""
     amax = jnp.max(jnp.abs(xb), axis=-1, keepdims=True)
     qmax = _INT8_QMAX if method == "int8" else _FP8_QMAX
     scale = jnp.where(amax > 0, amax / qmax, 1.0)
@@ -75,21 +72,61 @@ def quantize_blocks(x: jnp.ndarray, method: str = "int8", block: int = 256) -> T
         q = jnp.clip(jnp.round(y), -_INT8_QMAX, _INT8_QMAX).astype(jnp.int8)
     else:
         q = y.astype(jnp.float8_e4m3fn)
-    return q.reshape(x.shape), scale.reshape(x.shape[:-1] + (n // block,))
+    return q, scale
+
+
+def quantize_blocks(x: jnp.ndarray, method: str = "int8", block: int = 256) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """fp32 ``[..., n]`` -> (payload ``[..., n]`` int8/fp8, scales
+    ``[..., ceil(n/block)]`` fp32).
+
+    When ``n % block == 0`` — every hot caller: the grad buckets are padded
+    to the collective multiple, and KV pages are exact multiples by
+    construction (``block = page * head_dim``) — this is a pure reshape, no
+    copy. A trailing remainder is quantized as one short block with its own
+    scale (head reshaped + tail sliced in place — never a padded copy of
+    the whole array)."""
+    if method not in METHODS:
+        raise ValueError(f"unknown compression method {method!r}; use one of {METHODS}")
+    n = x.shape[-1]
+    rem = n % block
+    if rem == 0:
+        xb = x.reshape(x.shape[:-1] + (n // block, block)).astype(jnp.float32)
+        q, scale = _quantize_exact(xb, method)
+        return q.reshape(x.shape), scale.reshape(x.shape[:-1] + (n // block,))
+    head = n - rem
+    hb = x[..., :head].reshape(x.shape[:-1] + (head // block, block)).astype(jnp.float32)
+    q_h, s_h = _quantize_exact(hb, method)
+    q_t, s_t = _quantize_exact(x[..., head:].astype(jnp.float32), method)
+    q = jnp.concatenate([q_h.reshape(x.shape[:-1] + (head,)), q_t], axis=-1)
+    s = jnp.concatenate([s_h.reshape(x.shape[:-1] + (head // block,)),
+                         s_t.reshape(x.shape[:-1] + (1,))], axis=-1)
+    return q, s
 
 
 def dequantize_blocks(payload: jnp.ndarray, scales: jnp.ndarray, block: int = 256) -> jnp.ndarray:
-    """Inverse of :func:`quantize_blocks`: low-precision payload -> fp32."""
+    """Inverse of :func:`quantize_blocks`: low-precision payload -> fp32.
+    Mirrors its remainder handling (the tail is one short block)."""
     n = payload.shape[-1]
-    pb = payload.reshape(payload.shape[:-1] + (n // block, block)).astype(jnp.float32)
-    out = pb * scales[..., None]
-    return out.reshape(payload.shape)
+    rem = n % block
+    if rem == 0:
+        pb = payload.reshape(payload.shape[:-1] + (n // block, block)).astype(jnp.float32)
+        out = pb * scales[..., None]
+        return out.reshape(payload.shape)
+    head = n - rem
+    hb = payload[..., :head].reshape(
+        payload.shape[:-1] + (head // block, block)
+    ).astype(jnp.float32)
+    out_h = (hb * scales[..., : head // block, None]).reshape(
+        payload.shape[:-1] + (head,)
+    )
+    out_t = payload[..., head:].astype(jnp.float32) * scales[..., -1:]
+    return jnp.concatenate([out_h, out_t], axis=-1)
 
 
 def wire_bytes(n: int, method: str = "int8", block: int = 256) -> int:
     """Actual bytes on the wire for ``n`` compressed elements: 1-byte payload
-    plus one fp32 scale per block."""
-    return n + (n // block) * 4
+    plus one fp32 scale per (possibly short trailing) block."""
+    return n + (-(-n // block)) * 4
 
 
 # ---------------------------------------------------------------------------
@@ -242,6 +279,68 @@ def compressed_reduce_scatter(
     )
     vals = dequantize_blocks(q_r, s_r, block)
     return jnp.sum(vals, axis=0) / world, residual
+
+
+def compressed_all_gather(
+    x: jnp.ndarray,
+    axis_name: str,
+    world: int,
+    method: str = "int8",
+    block: int = 256,
+) -> jnp.ndarray:
+    """Low-precision all-gather (ISSUE 12): replicate every rank's ``[n]``
+    shard across ``axis_name`` with the payload on the wire as int8/fp8 +
+    per-block scales — the ZeRO-3 param all-gather's wire format
+    (``runtime/zero/partitioning.gather_full_compressed``). Returns the
+    gathered ``[world * n]`` fp32 array.
+
+    Unlike the reduce collectives there is NO error-feedback residual: a
+    gather is pure data movement, not an accumulating reduction — the
+    quantization error is a one-shot, per-element bounded rounding (the
+    round-trip tests pin it), and every rank dequantizes the SAME codes, so
+    the gathered copy is bit-identical across ranks (the property a
+    replicated param tree must keep).
+
+    Ledger convention (module-wide, PR-2): logical bytes are
+    fp32-NORMALIZED (4 per element) regardless of the source dtype —
+    against a bf16 baseline the true reduction is ~half the recorded
+    ratio."""
+    n = x.shape[0]
+    q, s = quantize_blocks(x.astype(jnp.float32), method, block)
+    _record_compressed("all_gather", axis_name, 4 * n, wire_bytes(n, method, block))
+    all_q = lax.all_gather(q, axis_name, axis=0, tiled=False)  # [world, n]
+    all_s = lax.all_gather(s, axis_name, axis=0, tiled=False)
+    return dequantize_blocks(all_q, all_s, block).reshape(world * n)
+
+
+def compressed_all_to_all(
+    x: jnp.ndarray,
+    axis_name: str,
+    world: int,
+    method: str = "int8",
+    block: int = 256,
+) -> jnp.ndarray:
+    """Low-precision all-to-all (ISSUE 12): rank r's chunk ``x[r]`` travels
+    to rank r as int8/fp8 + per-chunk block scales — the MoE expert
+    all-to-all's wire format (``moe/sharded_moe.moe_mlp_ep``). ``x`` is
+    ``[world, chunk]``; returns the exchanged ``[world, chunk]`` fp32.
+
+    Like the gather, this is pure data movement: no reduction, no error
+    feedback — the parity tests bound the one-shot rounding against the
+    uncompressed exchange. ``chunk`` need not divide ``block`` (the codec's
+    trailing-remainder path covers ragged expert capacities). Logical
+    bytes in the ledger are fp32-normalized, as everywhere in this
+    module."""
+    w, chunk = x.shape
+    assert w == world, (w, world)
+    q, s = quantize_blocks(x.astype(jnp.float32), method, block)
+    _record_compressed(
+        "all_to_all", axis_name, 4 * world * chunk,
+        world * wire_bytes(chunk, method, block),
+    )
+    q_r = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0, tiled=False)
+    s_r = lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0, tiled=False)
+    return dequantize_blocks(q_r, s_r, block)
 
 
 # ---------------------------------------------------------------------------

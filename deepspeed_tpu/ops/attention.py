@@ -93,24 +93,49 @@ def cached_attention(q, k_cache, v_cache, pos, impl: str = "auto", sm_scale: Opt
     return o.reshape(B, H, D).astype(q.dtype)
 
 
+def gather_pool_pages(k_pool, v_pool, block_tables, scales=None):
+    """Gather each slot's pages ([B,n,KV,page,D]) and, for int8 pools,
+    dequantize them through ``scales [P,KV,2]`` (per-page per-kv-head block
+    scales, K at index 0 / V at 1 — ISSUE 12). Pure data movement when
+    ``scales`` is None. The ONE dense-view gather both the serving-model
+    jnp branches and the dispatcher fallbacks below share — a scale-layout
+    change lands everywhere or nowhere."""
+    kd = k_pool[block_tables]
+    vd = v_pool[block_tables]
+    if scales is not None:
+        st = scales[block_tables]  # [B, n, KV, 2]
+        kd = kd.astype(jnp.float32) * st[..., 0][..., None, None]
+        vd = vd.astype(jnp.float32) * st[..., 1][..., None, None]
+    return kd, vd
+
+
 def paged_cached_attention(
     q, k_pool, v_pool, block_tables, pos, impl: str = "auto",
-    sm_scale: Optional[float] = None,
+    sm_scale: Optional[float] = None, scales=None,
 ):
     """Single-token decode attention against a PAGED KV cache (the serving
     subsystem's layout): q [B,H,D], pools [P,KV,page,D] (KV == H or
     H % KV == 0), block_tables [B,n] i32 pool-page ids per slot, pos [B] i32
-    per-slot highest valid index (inclusive) → [B,H,D].
+    per-slot highest valid index (inclusive) → [B,H,D]. ``scales``
+    [P,KV,2] dequantizes int8 pools (ISSUE 12) — required iff the pool
+    dtype is int8.
 
     Dispatch mirrors :func:`cached_attention`: the Pallas paged kernel on TPU
-    (the block-table gather IS the kernel's index map — no dense copy), and a
-    pure-jnp fallback that gathers the slot's pages into a dense view and
-    runs the exact grouped einsum of :func:`cached_attention` with a per-slot
-    mask, so the two paths agree bit-for-bit with the dense cache."""
+    (the block-table gather IS the kernel's index map — no dense copy; int8
+    pages dequantize INSIDE the kernel, so HBM traffic is the halved code
+    bytes), and a pure-jnp fallback that gathers the slot's pages into a
+    dense view and runs the exact grouped einsum of :func:`cached_attention`
+    with a per-slot mask, so the two paths agree with the dense cache."""
     B, H, D = q.shape
     P, KV, page, _ = k_pool.shape
     if H % KV != 0:
         raise ValueError(f"q heads {H} must divide by KV heads {KV}")
+    if (scales is None) == (k_pool.dtype == jnp.int8):
+        raise ValueError(
+            "paged_cached_attention: scales must be given exactly when the "
+            f"pool is int8 (pool dtype {k_pool.dtype}, scales "
+            f"{'given' if scales is not None else 'missing'})"
+        )
     if impl in ("auto", "pallas"):
         from .pallas.decode_attention import (
             paged_decode_attention,
@@ -120,7 +145,8 @@ def paged_cached_attention(
         if impl == "pallas" or paged_decode_attention_ok(page, D, k_pool.dtype.itemsize):
             try:
                 return paged_decode_attention(
-                    q, k_pool, v_pool, block_tables, pos, sm_scale=sm_scale
+                    q, k_pool, v_pool, block_tables, pos, sm_scale=sm_scale,
+                    scales=scales,
                 )
             except Exception as e:  # pragma: no cover
                 if impl == "pallas":
@@ -129,9 +155,11 @@ def paged_cached_attention(
     elif impl != "jnp":
         raise ValueError(f"unknown attention impl {impl}")
     # gather [B,n,KV,page,D] → logical [B,T,KV,D] per slot (pure data
-    # movement), then the same grouped math as cached_attention's fallback
-    kd = jnp.swapaxes(k_pool[block_tables], 2, 3).reshape(B, -1, KV, D)
-    vd = jnp.swapaxes(v_pool[block_tables], 2, 3).reshape(B, -1, KV, D)
+    # movement; int8 pools dequantize here), then the same grouped math as
+    # cached_attention's fallback
+    kd, vd = gather_pool_pages(k_pool, v_pool, block_tables, scales)
+    kd = jnp.swapaxes(kd, 2, 3).reshape(B, -1, KV, D)
+    vd = jnp.swapaxes(vd, 2, 3).reshape(B, -1, KV, D)
     scale = sm_scale if sm_scale is not None else 1.0 / (D**0.5)
     S = kd.shape[1]
     mask = jnp.arange(S)[None, None, :] <= pos[:, None, None]  # [B,1,S]
@@ -147,7 +175,7 @@ def paged_cached_attention(
 
 def paged_multitoken_cached_attention(
     q, k_pool, v_pool, block_tables, base, impl: str = "auto",
-    sm_scale: Optional[float] = None,
+    sm_scale: Optional[float] = None, scales=None,
 ):
     """T-token causal decode attention against a PAGED KV cache (ISSUE 10:
     the speculative verify step and chunked prefill): q [B,T,H,D], pools
@@ -165,6 +193,11 @@ def paged_multitoken_cached_attention(
     P, KV, page, _ = k_pool.shape
     if H % KV != 0:
         raise ValueError(f"q heads {H} must divide by KV heads {KV}")
+    if (scales is None) == (k_pool.dtype == jnp.int8):
+        raise ValueError(
+            "paged_multitoken_cached_attention: scales must be given "
+            f"exactly when the pool is int8 (pool dtype {k_pool.dtype})"
+        )
     if impl in ("auto", "pallas"):
         from .pallas.decode_attention import (
             paged_multitoken_attention,
@@ -176,7 +209,8 @@ def paged_multitoken_cached_attention(
         ):
             try:
                 return paged_multitoken_attention(
-                    q, k_pool, v_pool, block_tables, base, sm_scale=sm_scale
+                    q, k_pool, v_pool, block_tables, base, sm_scale=sm_scale,
+                    scales=scales,
                 )
             except Exception as e:  # pragma: no cover
                 if impl == "pallas":
@@ -187,8 +221,9 @@ def paged_multitoken_cached_attention(
                 )
     elif impl != "jnp":
         raise ValueError(f"unknown attention impl {impl}")
-    kd = jnp.swapaxes(k_pool[block_tables], 2, 3).reshape(B, -1, KV, D)
-    vd = jnp.swapaxes(v_pool[block_tables], 2, 3).reshape(B, -1, KV, D)
+    kd, vd = gather_pool_pages(k_pool, v_pool, block_tables, scales)
+    kd = jnp.swapaxes(kd, 2, 3).reshape(B, -1, KV, D)
+    vd = jnp.swapaxes(vd, 2, 3).reshape(B, -1, KV, D)
     scale = sm_scale if sm_scale is not None else 1.0 / (D**0.5)
     S = kd.shape[1]
     # [B, T, S]: key j visible to query t iff j <= base + t

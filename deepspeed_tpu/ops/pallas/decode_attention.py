@@ -122,16 +122,27 @@ def decode_attention(
 # ---------------------------------------------------------------------------
 
 
-def _paged_kernel(bt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
-                  m_ref, l_ref, acc_ref, *, sm_scale: float, page: int):
+def _paged_kernel(bt_ref, pos_ref, q_ref, k_ref, v_ref, *rest,
+                  sm_scale: float, page: int, rep: int = 1,
+                  quantized: bool = False):
     """Online-softmax accumulation over one slot's pages.
 
     Grid (B, H, n_pages): TPU grids run sequentially, so the (m, l, acc)
     scratch persists across the innermost page dimension — reset at page 0,
     emitted at the last page. Pages wholly past ``pos`` skip their compute
     (their DMA still runs; block-table rows pad with the scratch page, so the
-    wasted bandwidth is one page per padded entry)."""
+    wasted bandwidth is one page per padded entry).
+
+    ``quantized`` (ISSUE 12): K/V blocks arrive as int8 codes and a fourth
+    input carries the page's [1, KV, 2] scales (gathered by the SAME
+    block-table index map) — dequantization happens here in VMEM, so the
+    HBM read per page is the halved code bytes plus 8 bytes of scale."""
+    if quantized:
+        s_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        s_ref, (o_ref, m_ref, l_ref, acc_ref) = None, rest
     b = pl.program_id(0)
+    g = pl.program_id(1) // rep  # this program's kv-head column
     j = pl.program_id(2)
     D = q_ref.shape[-1]
 
@@ -148,6 +159,9 @@ def _paged_kernel(bt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[...].reshape(1, D)
         k = k_ref[0, 0]  # [page, D]
         v = v_ref[0, 0]
+        if quantized:
+            k = k.astype(jnp.float32) * s_ref[0, g, 0]
+            v = v.astype(jnp.float32) * s_ref[0, g, 1]
         s = jnp.dot(k, q.T, preferred_element_type=jnp.float32) * sm_scale  # [page,1]
         idx = jax.lax.broadcasted_iota(jnp.int32, (page, 1), 0) + j * page
         s = jnp.where(idx <= pos, s, -1e30)
@@ -176,6 +190,7 @@ def paged_decode_attention(
     pos: jnp.ndarray,  # [B] i32: highest valid cache index per slot (inclusive)
     sm_scale: Optional[float] = None,
     interpret: bool = False,
+    scales: Optional[jnp.ndarray] = None,  # [P, KV, 2] f32 for int8 pools
 ) -> jnp.ndarray:
     """Single-token attention against a PAGED cache → [B, H, D].
 
@@ -183,7 +198,10 @@ def paged_decode_attention(
     the index map gathers page ``j`` of slot ``b`` straight from the pool
     (scalar-prefetched table), streaming one page per grid step through VMEM
     with an online softmax. GQA as in :func:`decode_attention` (KV < H reads
-    the group's pool column)."""
+    the group's pool column). ``scales`` (ISSUE 12): int8 pools ride the
+    same index map — page ``bt[b, j]``'s [KV, 2] scale row DMAs beside the
+    code block and the dequantize runs in VMEM, so the memory-bound decode
+    read is half the bf16 bytes."""
     B, H, D = q.shape
     P, KV, page, _ = k_pool.shape
     n_pages = block_tables.shape[1]
@@ -191,25 +209,35 @@ def paged_decode_attention(
         raise ValueError(f"q heads {H} must divide by KV heads {KV}")
     rep = H // KV
     scale = sm_scale if sm_scale is not None else 1.0 / (D**0.5)
+    quantized = scales is not None
 
-    kernel = functools.partial(_paged_kernel, sm_scale=float(scale), page=page)
+    kernel = functools.partial(
+        _paged_kernel, sm_scale=float(scale), page=page, rep=rep,
+        quantized=quantized,
+    )
     q4 = q.reshape(B, H, 1, D)
+    pool_spec = pl.BlockSpec(
+        (1, 1, page, D), lambda b, h, j, bt, pos: (bt[b, j], h // rep, 0, 0)
+    )
+    in_specs = [
+        pl.BlockSpec((1, 1, 1, D), lambda b, h, j, bt, pos: (b, h, 0, 0)),
+        pool_spec,
+        pool_spec,
+    ]
+    operands = [q4, k_pool, v_pool]
+    if quantized:
+        # the scale row rides the block-table gather: trailing (KV, 2)
+        # block == the array's own trailing dims, Mosaic-legal for any KV
+        in_specs.append(pl.BlockSpec(
+            (1, KV, 2), lambda b, h, j, bt, pos: (bt[b, j], 0, 0)
+        ))
+        operands.append(jnp.asarray(scales, jnp.float32))
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,  # block table + per-slot positions
             grid=(B, H, n_pages),
-            in_specs=[
-                pl.BlockSpec((1, 1, 1, D), lambda b, h, j, bt, pos: (b, h, 0, 0)),
-                pl.BlockSpec(
-                    (1, 1, page, D),
-                    lambda b, h, j, bt, pos: (bt[b, j], h // rep, 0, 0),
-                ),
-                pl.BlockSpec(
-                    (1, 1, page, D),
-                    lambda b, h, j, bt, pos: (bt[b, j], h // rep, 0, 0),
-                ),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((1, 1, 1, D), lambda b, h, j, bt, pos: (b, h, 0, 0)),
             scratch_shapes=[
                 pltpu.SMEM((1,), jnp.float32),  # running max
@@ -222,16 +250,14 @@ def paged_decode_attention(
     )(
         jnp.asarray(block_tables, jnp.int32),
         jnp.asarray(pos, jnp.int32),
-        q4,
-        k_pool,
-        v_pool,
+        *operands,
     )
     return out.reshape(B, H, D)
 
 
-def _paged_multitoken_kernel(bt_ref, base_ref, q_ref, k_ref, v_ref, o_ref,
-                             m_ref, l_ref, acc_ref, *, sm_scale: float,
-                             page: int, T: int):
+def _paged_multitoken_kernel(bt_ref, base_ref, q_ref, k_ref, v_ref, *rest,
+                             sm_scale: float, page: int, T: int,
+                             rep: int = 1, quantized: bool = False):
     """Online-softmax over one slot's pages for T query tokens at once.
 
     The verify-step / chunked-prefill analog of :func:`_paged_kernel`
@@ -240,8 +266,14 @@ def _paged_multitoken_kernel(bt_ref, base_ref, q_ref, k_ref, v_ref, o_ref,
     the extra column dimension turns the scalar (m, l) softmax state into
     [1, T] rows and the accumulator into [T, D], everything else is the
     same sequential-grid accumulation. Pages wholly past ``base + T - 1``
-    skip their compute."""
+    skip their compute. ``quantized``: int8 K/V codes dequantize in VMEM
+    through the page's [1, KV, 2] scale row (ISSUE 12)."""
+    if quantized:
+        s_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        s_ref, (o_ref, m_ref, l_ref, acc_ref) = None, rest
     b = pl.program_id(0)
+    g = pl.program_id(1) // rep
     j = pl.program_id(2)
     D = q_ref.shape[-1]
 
@@ -258,6 +290,9 @@ def _paged_multitoken_kernel(bt_ref, base_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[...].reshape(T, D)
         k = k_ref[0, 0]  # [page, D]
         v = v_ref[0, 0]
+        if quantized:
+            k = k.astype(jnp.float32) * s_ref[0, g, 0]
+            v = v.astype(jnp.float32) * s_ref[0, g, 1]
         s = jnp.dot(k, q.T, preferred_element_type=jnp.float32) * sm_scale  # [page,T]
         idx = jax.lax.broadcasted_iota(jnp.int32, (page, T), 0) + j * page
         t_col = jax.lax.broadcasted_iota(jnp.int32, (page, T), 1)
@@ -287,6 +322,7 @@ def paged_multitoken_attention(
     base: jnp.ndarray,  # [B] i32: query t of slot b sits at position base[b]+t
     sm_scale: Optional[float] = None,
     interpret: bool = False,
+    scales: Optional[jnp.ndarray] = None,  # [P, KV, 2] f32 for int8 pools
 ) -> jnp.ndarray:
     """T-token causal attention against a PAGED cache → [B, T, H, D].
 
@@ -302,27 +338,33 @@ def paged_multitoken_attention(
         raise ValueError(f"q heads {H} must divide by KV heads {KV}")
     rep = H // KV
     scale = sm_scale if sm_scale is not None else 1.0 / (D**0.5)
+    quantized = scales is not None
 
     kernel = functools.partial(
-        _paged_multitoken_kernel, sm_scale=float(scale), page=page, T=T
+        _paged_multitoken_kernel, sm_scale=float(scale), page=page, T=T,
+        rep=rep, quantized=quantized,
     )
     q4 = jnp.swapaxes(q, 1, 2)  # [B, H, T, D]: trailing block == array dims
+    pool_spec = pl.BlockSpec(
+        (1, 1, page, D), lambda b, h, j, bt, base: (bt[b, j], h // rep, 0, 0)
+    )
+    in_specs = [
+        pl.BlockSpec((1, 1, T, D), lambda b, h, j, bt, base: (b, h, 0, 0)),
+        pool_spec,
+        pool_spec,
+    ]
+    operands = [q4, k_pool, v_pool]
+    if quantized:
+        in_specs.append(pl.BlockSpec(
+            (1, KV, 2), lambda b, h, j, bt, base: (bt[b, j], 0, 0)
+        ))
+        operands.append(jnp.asarray(scales, jnp.float32))
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,  # block table + per-slot base positions
             grid=(B, H, n_pages),
-            in_specs=[
-                pl.BlockSpec((1, 1, T, D), lambda b, h, j, bt, base: (b, h, 0, 0)),
-                pl.BlockSpec(
-                    (1, 1, page, D),
-                    lambda b, h, j, bt, base: (bt[b, j], h // rep, 0, 0),
-                ),
-                pl.BlockSpec(
-                    (1, 1, page, D),
-                    lambda b, h, j, bt, base: (bt[b, j], h // rep, 0, 0),
-                ),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec(
                 (1, 1, T, D), lambda b, h, j, bt, base: (b, h, 0, 0)
             ),
@@ -337,9 +379,7 @@ def paged_multitoken_attention(
     )(
         jnp.asarray(block_tables, jnp.int32),
         jnp.asarray(base, jnp.int32),
-        q4,
-        k_pool,
-        v_pool,
+        *operands,
     )
     return jnp.swapaxes(out, 1, 2)  # [B, T, H, D]
 

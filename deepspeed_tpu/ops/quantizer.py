@@ -22,10 +22,24 @@ default; ``key=`` engages unbiased stochastic rounding
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple, Optional
+from typing import Any, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+# ONE block-scale codec (ISSUE 12): the scale/round/clip rule lives in
+# comm/compressed.py and is re-exported here so the weight quantizer, the
+# KV page codec below, and the compressed collectives can never drift —
+# the shared round-trip bound test exercises it through both import paths.
+from ..comm.compressed import dequantize_blocks, quantize_blocks
+
+__all__ = [
+    "QuantizedWeight", "AsymQuantizedWeight", "quantize", "quantize_asym",
+    "dequantize", "dequantize_asym", "maybe_dequantize", "quantize_tree",
+    "quantization_error", "quantize_blocks", "dequantize_blocks",
+    "quantize_kv_pages", "dequantize_kv_pages", "kv_page_scale",
+    "quantize_kv_token",
+]
 
 PyTree = Any
 
@@ -66,8 +80,25 @@ def _grouped(w: jnp.ndarray, groups: int):
 def quantize(w: jnp.ndarray, groups: int = 64, scale_dtype=jnp.bfloat16,
              key: Optional[jax.Array] = None) -> QuantizedWeight:
     """Symmetric group int8 quantization of ``w [..., I, O]``; stochastic
-    rounding when ``key`` is given."""
+    rounding when ``key`` is given.
+
+    The round-to-nearest path delegates to the shared block codec
+    (``comm/compressed.quantize_blocks``) — groups run along the
+    contraction dim (axis -2), so the weight is transposed to put each
+    group's elements on the trailing axis, coded, and transposed back;
+    the codes and scales are bit-identical to the historical in-place
+    formula. Stochastic rounding keeps its own arithmetic (the codec is
+    deterministic by contract — the collectives depend on every rank
+    producing identical codes)."""
     wg = _grouped(w, groups)
+    if key is None:
+        wt = jnp.swapaxes(wg, -1, -2)  # [..., G, O, I/G]: group elems last
+        q, s = quantize_blocks(wt, "int8", wt.shape[-1])
+        # s: [..., G, O, 1] (one block per row) -> the [..., G, 1, O] layout
+        return QuantizedWeight(
+            q=jnp.swapaxes(q, -1, -2),
+            scale=jnp.swapaxes(s, -1, -2).astype(scale_dtype),
+        )
     amax = jnp.max(jnp.abs(wg), axis=-2, keepdims=True)
     scale = jnp.where(amax > 0, amax / 127.0, 1.0)
     q = jnp.clip(_round(wg / scale, key), -127, 127).astype(jnp.int8)
@@ -132,6 +163,52 @@ def quantize_tree(params: PyTree, groups: int = 64, dtype=jnp.bfloat16,
         return x
 
     return jax.tree.map(visit, params)
+
+
+# ---------------------------------------------------------------------------
+# KV page codec (ISSUE 12): int8 KV cache pages with per-(page, kv-head)
+# scales. A page's (page_size, head_dim) slab per head is ONE block of the
+# shared codec — exact multiple by construction, so quantization is the
+# zero-copy fast path of quantize_blocks.
+# ---------------------------------------------------------------------------
+
+
+def quantize_kv_pages(chunks: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """``[..., KV, page, D]`` float K/V chunks -> (codes int8 same shape,
+    scales ``[..., KV]`` fp32): one symmetric block scale per page per
+    kv-head (``serving/kv_cache.init_pools`` keeps the scales beside the
+    pool). Delegates to the shared block codec with block = page * D."""
+    *lead, kv, page, d = chunks.shape
+    flat = chunks.reshape(*lead, kv, page * d)
+    q, s = quantize_blocks(flat, "int8", page * d)
+    return q.reshape(chunks.shape), s.reshape(*lead, kv)
+
+
+def dequantize_kv_pages(codes: jnp.ndarray, scales: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of :func:`quantize_kv_pages`: ``codes [..., KV, page, D]``
+    int8 + ``scales [..., KV]`` -> fp32. A fresh pool's scale is 0, so
+    never-written pages dequantize to exact zeros."""
+    return codes.astype(jnp.float32) * scales[..., None, None]
+
+
+def kv_page_scale(values: jnp.ndarray) -> jnp.ndarray:
+    """The codec's scale for ``values [..., D]`` reduced over the trailing
+    axis — the single-token write path uses it to ESTABLISH a page's scale
+    from the first token written at offset 0 (the scale is then frozen for
+    the page's lifetime, so later writes never re-code earlier positions:
+    the order-independence the speculative-verify bit-equivalence contract
+    rests on). Matches ``quantize_blocks``'s rule exactly: amax/127, zero
+    content -> 1.0."""
+    amax = jnp.max(jnp.abs(values.astype(jnp.float32)), axis=-1)
+    return jnp.where(amax > 0, amax / 127.0, 1.0)
+
+
+def quantize_kv_token(values: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    """Code one token's ``[..., D]`` K/V slab against an already-frozen page
+    ``scale [...]`` (clipping saturates at the codec's qmax — the price of
+    the frozen scale; the parity suite bounds the effect)."""
+    y = values.astype(jnp.float32) / scale[..., None]
+    return jnp.clip(jnp.round(y), -127.0, 127.0).astype(jnp.int8)
 
 
 def quantization_error(w: jnp.ndarray, groups: int = 64) -> float:

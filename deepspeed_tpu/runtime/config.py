@@ -174,14 +174,20 @@ class CommCompressionConfig(DSConfigModel):
     ``method``: ``int8`` (block-scaled symmetric, ~3.9x wire reduction at
     block 256, the robust default) or ``fp8`` (e4m3 — wider dynamic range
     within a block, slightly higher rounding error). ``axes`` selects which
-    mesh axes compress (only ``dp`` — the grad reduce — is implemented;
-    other names are ignored with a warning). ``bucketing`` (also available
-    with compression off) reworks the grad accumulation to reduce in
-    size-capped flat buckets (``zero_optimization.reduce_bucket_size``)
-    emitted as INDEPENDENT collectives, giving XLA's latency-hiding
-    scheduler separate ops to overlap with backward compute; ``None``
-    keeps the legacy fused per-leaf path. Compression requires a dp-only
-    mesh, ZeRO stage <= 2, and bf16/fp32 (no fp16 dynamic loss scale)."""
+    mesh axes compress: ``dp`` covers the grad reduce at stage <= 2 and the
+    EXPLICIT param all-gather at stage 3 (``engine.gather_params()`` /
+    ``gather_full_compressed`` — ISSUE 12; the train step's implicit
+    per-use gathers are untouched), ``ep`` covers the MoE expert
+    all-to-all (``moe/sharded_moe.moe_mlp_ep``); other names are ignored
+    with a warning. ``bucketing`` (also available with compression off)
+    reworks the grad accumulation to reduce in size-capped flat buckets
+    (``zero_optimization.reduce_bucket_size``) emitted as INDEPENDENT
+    collectives, giving XLA's latency-hiding scheduler separate ops to
+    overlap with backward compute; ``None`` keeps the legacy fused per-leaf
+    path. The compressed GRAD path requires a dp-only mesh, ZeRO stage <= 2,
+    and bf16/fp32 (no fp16 dynamic loss scale); the gather/all-to-all paths
+    are pure data movement (no error feedback — see
+    docs/COMM_COMPRESSION.md)."""
 
     enabled: bool = False
     method: str = "int8"  # int8 | fp8
@@ -897,7 +903,16 @@ class ServingConfig(DSConfigModel):
     request SEEDS vary freely, per-request sampling params would retrace).
     ``default_deadline_s`` > 0 gives every request a deadline; a request past
     its deadline degrades to a truncated response and its slot/pages are
-    reclaimed — a stuck request never wedges the batch."""
+    reclaimed — a stuck request never wedges the batch.
+
+    ``kv_cache_dtype = "int8"`` (ISSUE 12) stores KV pages as block-scaled
+    int8 codes with per-(layer, page, kv-head) scales living beside the
+    pool: half the bf16 pool's HBM and decode read traffic, ~2x resident
+    sessions per HBM byte, dequantized inside the paged attention kernels.
+    Greedy streams stay bit-identical across serving features (speculation,
+    prefix sharing, chunking) but carry bounded quantization error vs a
+    full-precision cache — docs/SERVING.md "int8 KV pages" for the scale
+    layout, COW semantics, and parity caveats."""
 
     enabled: bool = False
     max_slots: int = 8
@@ -910,7 +925,14 @@ class ServingConfig(DSConfigModel):
     temperature: float = 0.0
     top_k: int = 0
     top_p: float = 1.0
-    kv_cache_dtype: str = ""  # "" = the inference engine's dtype
+    # "" = the inference engine's dtype; "int8" (ISSUE 12) stores KV pages
+    # as block-quantized codes with per-(layer, page, kv-head) scales beside
+    # the pool — half the bf16 pool's HBM and decode-read traffic, double
+    # the resident sessions per byte; dequantized inside the paged attention
+    # kernels. Greedy streams stay bit-identical ACROSS serving features
+    # (speculation on/off etc.) but carry bounded quantization error vs a
+    # full-precision cache (docs/SERVING.md "int8 KV pages").
+    kv_cache_dtype: str = ""
     # --- resilience (ISSUE 7): graceful drain + transient-failure retry ---
     # drain(): stop admission, finish in-flight up to this budget, evict the
     # rest as PREEMPTED (slot/pages reclaimed — never wedged)
@@ -953,6 +975,13 @@ class ServingConfig(DSConfigModel):
             raise DeepSpeedConfigError(
                 "serving.prefill_chunk_tokens must be >= 0, got "
                 f"{self.prefill_chunk_tokens}"
+            )
+        if self.kv_cache_dtype not in (
+            "", "bfloat16", "float16", "float32", "int8"
+        ):
+            raise DeepSpeedConfigError(
+                "serving.kv_cache_dtype must be one of '', 'bfloat16', "
+                f"'float16', 'float32', 'int8'; got {self.kv_cache_dtype!r}"
             )
         if self.speculative.enabled and float(self.temperature) > 0.0:
             raise DeepSpeedConfigError(

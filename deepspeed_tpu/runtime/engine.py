@@ -185,19 +185,36 @@ class DeepSpeedEngine:
         cc = config.comm_compression
         self.comm_compression = cc
         self._grad_bucketing = bool(cc.bucketing)
+        # stage <= 2: dp compression means the compressed grad reduce.
+        # stage 3 (ISSUE 12): the grad region needs the dp-sharded params
+        # gathered INSIDE it, so grads reduce uncompressed — dp compression
+        # instead covers the explicit param all-gather (gather_params()).
         self._compress_grads = bool(
             cc.enabled and "dp" in cc.axes and self.dp_world_size > 1
+            and self.policy.supports_compressed_grads()
         )
         if cc.enabled:
             from ..utils.logging import warning_once
 
-            unknown_axes = [a for a in cc.axes if a != "dp"]
+            # 'dp' compresses the grad reduce (stage <= 2) and the explicit
+            # stage-3 param all-gather (gather_params); 'ep' compresses the
+            # MoE expert all-to-all (moe/sharded_moe.moe_mlp_ep) — ISSUE 12
+            unknown_axes = [a for a in cc.axes if a not in ("dp", "ep")]
             if unknown_axes:
                 warning_once(
                     f"comm_compression.axes {unknown_axes} are not implemented "
-                    "(only the 'dp' grad reduce compresses); ignoring them"
+                    "(dp = grad reduce / stage-3 param gather, ep = MoE "
+                    "all-to-all); ignoring them"
                 )
-            if not self._compress_grads:
+            if self.zero_stage >= 3 and "dp" in cc.axes and self.dp_world_size > 1:
+                warning_once(
+                    "comm_compression at ZeRO stage 3: the grad reduce stays "
+                    "uncompressed (dp-sharded params would need an "
+                    "uncompressed allgather inside the mapped grad region); "
+                    "compression applies to the explicit param all-gather "
+                    "(engine.gather_params / gather_full_compressed)"
+                )
+            elif not self._compress_grads and "ep" not in cc.axes:
                 warning_once(
                     "comm_compression.enabled has no effect: the grad reduce "
                     "axis is dp and "
@@ -214,12 +231,6 @@ class DeepSpeedEngine:
                     "comm_compression does not support fp16 dynamic loss "
                     "scaling (overflow handling would need the scale inside "
                     "the mapped region); use bf16"
-                )
-            if not self.policy.supports_compressed_grads():
-                raise ValueError(
-                    "comm_compression requires ZeRO stage <= 2 (stage 3's "
-                    "dp-sharded params would need an uncompressed allgather "
-                    "inside the mapped grad region)"
                 )
             if (
                 self.tp_world_size > 1
@@ -2403,6 +2414,20 @@ class DeepSpeedEngine:
         ``ops.sparse_attention.from_ds_config`` / ``gpt2.get_config``
         (reference DeepSpeedEngine.sparse_attention_config)."""
         return self.config.sparse_attention
+
+    def gather_params(self):
+        """Materialize a fully-replicated copy of the params — the
+        ``GatheredParameters`` analog for export / eval / serving hand-off
+        (defeats ZeRO-3 memory savings for the copy's lifetime, use
+        sparingly). With ``comm_compression`` enabled at stage 3 (and 'dp'
+        in its axes), the all-gather runs on the compressed wire (ISSUE 12:
+        block-scaled int8/fp8 payload + per-block scales, ~3.9x fewer bytes,
+        recorded in the ``comm_wire_bytes`` ledger); otherwise a plain
+        replicated device_put. The train step's implicit per-use stage-3
+        gathers are untouched either way."""
+        return self.policy.param_gather_fn(self.comm_compression)(
+            self.state.params
+        )
 
     def zero_optimization(self) -> bool:
         return self.zero_stage > 0

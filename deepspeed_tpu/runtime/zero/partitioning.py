@@ -28,6 +28,7 @@ over ``dp``. This is the `FSDP + TP` layout used by production JAX LLM stacks.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import jax
@@ -211,6 +212,34 @@ class ZeroShardingPolicy:
         the wire savings. Stage <= 2 with a nontrivial axis qualifies."""
         return self.stage <= 2 and self.mesh.shape.get(self.zero_axis, 1) > 1
 
+    def supports_compressed_param_gather(self) -> bool:
+        """The OTHER side of the compression story (ISSUE 12): at stage 3
+        the dominant wire transfer is the param all-gather, and an explicit
+        materialization (:func:`gather_full`) can run it block-quantized.
+        Stage 3 with a nontrivial axis qualifies."""
+        return self.stage >= 3 and self.mesh.shape.get(self.zero_axis, 1) > 1
+
+    def param_gather_fn(self, comp_cfg=None) -> Callable[[PyTree], PyTree]:
+        """→ callable(tree) materializing fully-replicated params: the
+        compressed all-gather (``comm/compressed.compressed_all_gather``)
+        when ``comm_compression`` covers this policy — enabled, stage 3,
+        ``zero_axis`` listed in ``axes`` — else plain :func:`gather_full`.
+        The gate lives HERE so the ZeRO stage stays the single source of
+        truth for how params move, exactly like ``grad_reduce_op``."""
+        if (
+            comp_cfg is not None
+            and bool(getattr(comp_cfg, "enabled", False))
+            and self.zero_axis in tuple(getattr(comp_cfg, "axes", ()) or ())
+            and self.supports_compressed_param_gather()
+        ):
+            method = str(getattr(comp_cfg, "method", "int8"))
+            block = int(getattr(comp_cfg, "block_size", 256))
+            return lambda tree: gather_full_compressed(
+                tree, self.mesh, zero_axis=self.zero_axis,
+                method=method, block=block,
+            )
+        return lambda tree: gather_full(tree, self.mesh)
+
     def residual_shardings(self, abstract_params: PyTree) -> PyTree:
         """Shardings for the error-feedback residuals
         (``TrainState.comm_error``): one ``[world, ...]``-leading buffer per
@@ -335,3 +364,90 @@ def gather_full(tree: PyTree, mesh: Mesh) -> PyTree:
     memory savings, exactly like the reference warns)."""
     replicated = NamedSharding(mesh, PartitionSpec())
     return jax.tree.map(lambda x: jax.device_put(x, replicated), tree)
+
+
+def _leaf_zero_dim(leaf, zero_axis: str) -> Optional[int]:
+    """The dim a leaf is sharded over ``zero_axis`` on — only when the dim's
+    spec entry is EXACTLY the zero axis (a composite ``(tp, dp)`` entry
+    would interleave shards from two axes in the flat gather order; those
+    leaves take the plain device_put path instead). None = not dp-sharded."""
+    sharding = getattr(leaf, "sharding", None)
+    spec = getattr(sharding, "spec", None)
+    if spec is None:
+        return None
+    for d, entry in enumerate(spec):
+        if entry == zero_axis:
+            return d
+    return None
+
+
+def gather_full_compressed(
+    tree: PyTree,
+    mesh: Mesh,
+    zero_axis: str = "dp",
+    method: str = "int8",
+    block: int = 256,
+) -> PyTree:
+    """ZeRO-3 param all-gather on the compressed wire (ISSUE 12): the
+    low-precision :func:`gather_full`. Each leaf sharded over ``zero_axis``
+    all-gathers as block-scaled int8/fp8 + per-block fp32 scales
+    (``comm/compressed.compressed_all_gather``) — ~3.9x less ICI/DCN bytes
+    at block 256 than the fp32 gather — and lands bit-identical on every
+    rank (all ranks dequantize the same codes). Leaves not sharded over the
+    axis (persistence-threshold params, scalars, composite-sharded dims)
+    replicate as-is.
+
+    LOSSY, bounded by the block quantizer's round-trip error: this is the
+    export / eval-time materialization path (checkpoint conversion, serving
+    weight hand-off), not the train step — XLA's implicit per-use stage-3
+    gathers are untouched. Every gather records (logical, wire) bytes in
+    the ``comm_wire_bytes`` trace ledger under ``all_gather``/``dp``
+    (logical is fp32-normalized per the module convention — see
+    :func:`~deepspeed_tpu.comm.compressed.compressed_all_gather`)."""
+    world = int(mesh.shape.get(zero_axis, 1))
+    replicated = NamedSharding(mesh, PartitionSpec())
+
+    def gather_leaf(leaf):
+        d = _leaf_zero_dim(leaf, zero_axis)
+        if world <= 1 or d is None:
+            return jax.device_put(leaf, replicated)
+        spec = leaf.sharding.spec
+        mapped = _compressed_gather_program(
+            mesh, zero_axis, world, method, block,
+            tuple(spec), d, tuple(leaf.shape), str(leaf.dtype),
+        )
+        return mapped(leaf)
+
+    return jax.tree.map(gather_leaf, tree)
+
+
+@functools.lru_cache(maxsize=256)
+def _compressed_gather_program(mesh, zero_axis, world, method, block,
+                               spec, d, shape, dtype):
+    """One compiled shard_map program per (mesh, spec, shape, dtype) leaf
+    signature — cached so a param tree with hundreds of leaves compiles
+    only its distinct shapes, once, instead of re-tracing every leaf on
+    every :func:`gather_full_compressed` call (jit caches key on function
+    identity, and a per-leaf closure defeats them)."""
+    import jax.numpy as jnp
+
+    from ...comm import compressed as cco
+    from ...utils.compat import shard_map
+
+    in_spec = PartitionSpec(*spec)
+    out_entries = list(spec) + [None] * (len(shape) - len(spec))
+    out_entries[d] = None
+    out_spec = PartitionSpec(*out_entries)
+
+    def f(local):
+        flat = local.reshape(-1)
+        full = cco.compressed_all_gather(flat, zero_axis, world, method, block)
+        parts = full.reshape((world,) + local.shape)
+        return jnp.concatenate(
+            [parts[i] for i in range(world)], axis=d
+        ).astype(dtype)
+
+    return jax.jit(shard_map(
+        f, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec,
+        check_vma=False,
+    ))

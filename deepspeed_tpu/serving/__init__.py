@@ -25,7 +25,10 @@ from .kv_cache import (
     PageAllocatorError,
     PrefixCache,
     SlotTable,
+    init_pools,
     pages_for,
+    pool_bytes,
+    scales_bytes,
 )
 from .replay import (
     ReplayClock,
@@ -49,6 +52,9 @@ __all__ = [
     "SlotTable",
     "WorkloadSpec",
     "generate_workload",
+    "init_pools",
     "pages_for",
+    "pool_bytes",
     "replay",
+    "scales_bytes",
 ]

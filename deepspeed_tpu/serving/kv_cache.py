@@ -176,21 +176,44 @@ def init_pools(
     head_dim: int,
     dtype: Any = jnp.bfloat16,
 ):
-    """The shared K and V pools, ``[L, P, KV, page, D]`` zeros.
+    """The shared K and V pools, ``[L, P, KV, page, D]`` zeros, plus the
+    per-page scales pool — ``(k_pool, v_pool, scales)``.
 
     Layout is kernel-native: per layer the pool is ``[P, KV, page, D]``, whose
     trailing ``(page, D)`` dims are exactly one Mosaic block — the paged
-    kernel DMAs page ``block_table[b, j]`` without any transpose."""
+    kernel DMAs page ``block_table[b, j]`` without any transpose.
+
+    Quantized pools (ISSUE 12, ``serving.kv_cache_dtype = "int8"``): K/V are
+    stored as int8 codes and ``scales`` is ``[L, P, KV, 2]`` fp32 — one
+    symmetric block scale per (layer, page, kv-head) for K (index 0) and V
+    (index 1), living BESIDE the pool so every page-id mechanism (refcounted
+    sharing, COW fork-by-recompute, prefix-index eviction) carries the scale
+    for free: sharing a page shares its scale row, and a recomputed fork
+    rewrites its own. Zero-initialized: a never-written page dequantizes to
+    exact zeros. Full-precision pools return ``scales = None``."""
     shape = (n_layer, num_pages, n_kv_head, page_size, head_dim)
-    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+    scales = (
+        jnp.zeros((n_layer, num_pages, n_kv_head, 2), jnp.float32)
+        if jnp.dtype(dtype) == jnp.dtype(jnp.int8) else None
+    )
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype), scales
 
 
 def pool_bytes(
     n_layer: int, num_pages: int, n_kv_head: int, page_size: int, head_dim: int,
     itemsize: int = 2,
 ) -> int:
-    """HBM footprint of K+V pools (sizing aid for the ``serving`` config)."""
+    """HBM footprint of K+V pools (sizing aid for the ``serving`` config);
+    ``itemsize = 1`` for int8 pages. Scales are accounted separately
+    (:func:`scales_bytes`) — they are metadata, not page payload."""
     return 2 * n_layer * num_pages * n_kv_head * page_size * head_dim * itemsize
+
+
+def scales_bytes(n_layer: int, num_pages: int, n_kv_head: int) -> int:
+    """HBM footprint of the quantized pools' per-page scales
+    (``[L, P, KV, 2]`` fp32) — reported under Engine E's ``metadata``
+    category, beside the host-side refcount/prefix-index bytes."""
+    return n_layer * num_pages * n_kv_head * 2 * 4
 
 
 # ---------------------------------------------------------------------------

@@ -47,10 +47,78 @@ from jax import lax
 
 from ..models import gpt2
 from ..models.gpt2 import GPT2Config, KVCache, _layer_norm, _mlp
+from ..ops.quantizer import (
+    dequantize_kv_pages,
+    kv_page_scale,
+    quantize_kv_pages,
+    quantize_kv_token,
+)
 from ..ops.quantizer import maybe_dequantize as _deq
 from ..ops.sampling import sample_logits
 
 PyTree = Any
+
+
+# ---------------------------------------------------------------------------
+# int8 KV pages (ISSUE 12): pool write helpers shared by all four programs.
+#
+# ``scales`` is the [L, P, KV, 2] per-page scales pool (None = full-precision
+# pools, every path below reduces to the historical scatter). The scale
+# discipline that keeps the PR-10 equivalence contracts intact under
+# quantization: a page's scale is ESTABLISHED exactly once — by the
+# whole-page write that fills it (prefill / chunk-prefill / COW recompute)
+# or by the token write at offset 0 — and FROZEN until the page is refilled
+# from offset 0 again. Later token writes code against the frozen scale, so
+# a write never re-codes earlier positions: scattering T draft tokens then
+# attending (the verify step) produces bit-identical pool state to writing
+# them one step at a time (the decode step), which is what makes the
+# speculative stream provably equal to sequential int8 decode. Rejected
+# drafts re-write from the accept point next step; a re-write at offset 0
+# re-establishes the scale, and every stale position is overwritten before
+# anything attends it — exactly the bf16 rollback-by-overwrite argument.
+# ---------------------------------------------------------------------------
+
+
+def _write_pool_pages(pool, scales, l, page_ids, chunks, sidx):
+    """Whole-page scatter: ``chunks [n_pp, KV, page, D]`` (compute precision)
+    into layer ``l``'s pages; quantize-at-write when the pool is int8.
+    ``sidx``: 0 = K scales, 1 = V. → (pool, scales, attend_chunks) where
+    ``attend_chunks`` is what attention must read for these tokens — the
+    dequantized codes when quantized (the cache serves DEQUANTIZED values;
+    prefill attending the exact pre-quantization values would make the
+    first token inconsistent with every later read of the same pages)."""
+    if scales is None:
+        return pool.at[l, page_ids].set(chunks.astype(pool.dtype)), None, chunks
+    codes, s = quantize_kv_pages(chunks)
+    pool = pool.at[l, page_ids].set(codes)
+    scales = scales.at[l, page_ids, :, sidx].set(s)
+    return pool, scales, dequantize_kv_pages(codes, s)
+
+
+def _write_pool_token(pool, scales, l, pidx, poff, vals, sidx):
+    """One-token scatter: ``vals [B, KV, D]`` to (layer ``l``, page
+    ``pidx[b]``, offset ``poff[b]``). Offset 0 establishes the page's scale
+    from this token; any other offset codes against the frozen scale."""
+    if scales is None:
+        return pool.at[l, pidx, :, poff].set(vals.astype(pool.dtype)), None
+    s_old = scales[l, pidx, :, sidx]                       # [B, KV]
+    s = jnp.where((poff == 0)[:, None], kv_page_scale(vals), s_old)
+    pool = pool.at[l, pidx, :, poff].set(quantize_kv_token(vals, s))
+    scales = scales.at[l, pidx, :, sidx].set(s)
+    return pool, scales
+
+
+def _gather_dense(k_pool_l, v_pool_l, block_tables, scales_l=None):
+    """Gather each slot's pages into the dense ``[B, n, page, KV, D]`` view
+    the jnp attention branches consume, dequantizing int8 pools through
+    ``scales_l [P, KV, 2]``. Delegates to the dispatcher fallbacks' own
+    gather (``ops.attention.gather_pool_pages``) so the serving-model jnp
+    branches and the ops fallbacks can never disagree on the scale
+    layout."""
+    from ..ops.attention import gather_pool_pages
+
+    kd, vd = gather_pool_pages(k_pool_l, v_pool_l, block_tables, scales_l)
+    return jnp.swapaxes(kd, 2, 3), jnp.swapaxes(vd, 2, 3)
 
 
 # ---------------------------------------------------------------------------
@@ -63,9 +131,13 @@ def _layer_params(params: PyTree, l: int) -> PyTree:
     return jax.tree_util.tree_map(lambda x: x[l], params["blocks"])
 
 
-def _attention_prefill_paged(cfg, lp, h, k_pool, v_pool, page_ids, l):
+def _attention_prefill_paged(cfg, lp, h, k_pool, v_pool, page_ids, l,
+                             scales=None):
     """Causal self-attention over the prompt chunk; K/V written to layer
-    ``l``'s pages of the FULL pool.
+    ``l``'s pages of the FULL pool (quantized at write when ``scales`` is
+    given — the attention then reads the DEQUANTIZED chunk back, so the
+    first sampled token is consistent with every later read of the same
+    pages).
 
     The chunk starts at position 0 of a fresh slot, so "the cache" IS the
     chunk — the dense causal einsum here is exactly ``_attention_cached``'s
@@ -76,8 +148,9 @@ def _attention_prefill_paged(cfg, lp, h, k_pool, v_pool, page_ids, l):
     qkv = h @ _deq(lp["c_attn_w"], h.dtype) + lp["c_attn_b"]
     q, k_, v = jnp.split(qkv, 3, axis=-1)
     q = q.reshape(B, Sp, H, D)
-    k_c = k_.reshape(B, Sp, H, D).astype(k_pool.dtype)
-    v_c = v.reshape(B, Sp, H, D).astype(v_pool.dtype)
+    pool_dt = h.dtype if scales is not None else k_pool.dtype
+    k_c = k_.reshape(B, Sp, H, D).astype(pool_dt)
+    v_c = v.reshape(B, Sp, H, D).astype(pool_dt)
 
     # page-granular scatter: [Sp,H,D] → [n_pp, H, page, D] rows of the pool.
     # Whole pages are overwritten — a slot's pages are fresh at admission and
@@ -85,9 +158,17 @@ def _attention_prefill_paged(cfg, lp, h, k_pool, v_pool, page_ids, l):
     # padded page_ids point at the scratch page.
     n_pp = Sp // page
     chunks = jnp.swapaxes(k_c[0].reshape(n_pp, page, H, D), 1, 2)
-    k_pool = k_pool.at[l, page_ids].set(chunks)
+    k_pool, scales, k_att = _write_pool_pages(
+        k_pool, scales, l, page_ids, chunks, 0
+    )
     chunks_v = jnp.swapaxes(v_c[0].reshape(n_pp, page, H, D), 1, 2)
-    v_pool = v_pool.at[l, page_ids].set(chunks_v)
+    v_pool, scales, v_att = _write_pool_pages(
+        v_pool, scales, l, page_ids, chunks_v, 1
+    )
+    if scales is not None:
+        # [n_pp, KV, page, D] dequantized → the [B, Sp, H, D] chunk view
+        k_c = jnp.swapaxes(k_att, 1, 2).reshape(B, Sp, H, D)
+        v_c = jnp.swapaxes(v_att, 1, 2).reshape(B, Sp, H, D)
 
     scale = 1.0 / np.sqrt(D)
     scores = jnp.einsum(
@@ -100,7 +181,10 @@ def _attention_prefill_paged(cfg, lp, h, k_pool, v_pool, page_ids, l):
     probs = jax.nn.softmax(scores, axis=-1).astype(v_c.dtype)
     o = jnp.einsum("bhst,bthd->bshd", probs, v_c)
     o = o.reshape(B, Sp, E).astype(h.dtype)
-    return o @ _deq(lp["c_proj_w"], h.dtype) + lp["c_proj_b"], k_pool, v_pool
+    return (
+        o @ _deq(lp["c_proj_w"], h.dtype) + lp["c_proj_b"],
+        k_pool, v_pool, scales,
+    )
 
 
 def paged_prefill(
@@ -115,8 +199,10 @@ def paged_prefill(
     temperature: float = 0.0,
     top_k: int = 0,
     top_p: float = 1.0,
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """→ (k_pool, v_pool, first_token [1])."""
+    scales: jnp.ndarray = None,  # [L, P, KV, 2] when the pool is int8
+):
+    """→ (k_pool, v_pool, first_token [1]), with ``scales`` threaded between
+    the pools and the token when the pool is quantized (ISSUE 12)."""
     B, Sp = input_ids.shape
     eps = cfg.layer_norm_epsilon
     positions = jnp.arange(Sp)
@@ -124,10 +210,10 @@ def paged_prefill(
 
     for l in range(cfg.n_layer):
         lp = _layer_params(params, l)
-        a, k_pool, v_pool = _attention_prefill_paged(
+        a, k_pool, v_pool, scales = _attention_prefill_paged(
             cfg, lp["attn"],
             _layer_norm(h, lp["ln_1"]["scale"], lp["ln_1"]["bias"], eps),
-            k_pool, v_pool, page_ids, l,
+            k_pool, v_pool, page_ids, l, scales,
         )
         h = h + a
         m, _aux = _mlp(
@@ -141,6 +227,8 @@ def paged_prefill(
     h_last = _layer_norm(h_last, params["ln_f"]["scale"], params["ln_f"]["bias"], eps)
     logits = (h_last @ params["wte"].T)[..., : cfg.vocab_size]
     first = sample_logits(logits, rng, temperature, top_k, top_p)
+    if scales is not None:
+        return k_pool, v_pool, scales, first
     return k_pool, v_pool, first
 
 
@@ -149,12 +237,14 @@ def paged_prefill(
 # ---------------------------------------------------------------------------
 
 def _attend_decode_shaped(cfg, q, k_pool_l, v_pool_l, block_tables, pos,
-                          out_dtype):
+                          out_dtype, scales_l=None):
     """ONE query token per slot against the paged cache → [B, 1, E].
 
     The decode step's attention, factored so the speculative verify step
     can attend each of its T queries through EXACTLY this code — same
-    shapes, same XLA reduction trees, same bits (ISSUE 10)."""
+    shapes, same XLA reduction trees, same bits (ISSUE 10). ``scales_l``
+    (= ``scales[l]``, [P, KV, 2]) dequantizes an int8 pool in the read
+    path (ISSUE 12)."""
     B, S, H, D = q.shape  # S == 1
     E = H * D
     scale = 1.0 / np.sqrt(D)
@@ -163,7 +253,7 @@ def _attend_decode_shaped(cfg, q, k_pool_l, v_pool_l, block_tables, pos,
 
         o1 = paged_cached_attention(
             q[:, 0], k_pool_l, v_pool_l, block_tables, pos,
-            impl=cfg.attn_impl, sm_scale=scale,
+            impl=cfg.attn_impl, sm_scale=scale, scales=scales_l,
         )
         return o1.reshape(B, 1, E).astype(out_dtype)
 
@@ -175,8 +265,8 @@ def _attend_decode_shaped(cfg, q, k_pool_l, v_pool_l, block_tables, pos,
     # own branch (probs cast to the CACHE dtype before the V einsum) — for
     # bf16 caches the two round differently, and serving must match whichever
     # path generate takes for the model's impl, bit for bit.
-    kd = jnp.swapaxes(k_pool_l[block_tables], 2, 3).reshape(B, -1, H, D)
-    vd = jnp.swapaxes(v_pool_l[block_tables], 2, 3).reshape(B, -1, H, D)
+    kd, vd = _gather_dense(k_pool_l, v_pool_l, block_tables, scales_l)
+    kd, vd = kd.reshape(B, -1, H, D), vd.reshape(B, -1, H, D)
     Smax = kd.shape[1]
     scores = jnp.einsum(
         "bshd,bthd->bhst", q.astype(jnp.float32), kd.astype(jnp.float32)
@@ -189,7 +279,7 @@ def _attend_decode_shaped(cfg, q, k_pool_l, v_pool_l, block_tables, pos,
 
 
 def _attention_decode_paged(cfg, lp, h, k_pool, v_pool, block_tables,
-                            pos, pidx, poff, l):
+                            pos, pidx, poff, l, scales=None):
     """One-token attention per slot against its paged cache (layer ``l`` of
     the FULL pool).
 
@@ -201,19 +291,24 @@ def _attention_decode_paged(cfg, lp, h, k_pool, v_pool, block_tables,
     qkv = h @ _deq(lp["c_attn_w"], h.dtype) + lp["c_attn_b"]
     q, k_, v = jnp.split(qkv, 3, axis=-1)
     q = q.reshape(B, S, H, D)
-    k_c = k_.reshape(B, S, H, D).astype(k_pool.dtype)
-    v_c = v.reshape(B, S, H, D).astype(v_pool.dtype)
+    pool_dt = h.dtype if scales is not None else k_pool.dtype
+    k_c = k_.reshape(B, S, H, D).astype(pool_dt)
+    v_c = v.reshape(B, S, H, D).astype(pool_dt)
 
     # [B,H,D] values to (l, pidx[b], :, poff[b], :) — advanced indices around
     # the head slice put the batch dim first, matching the value layout.
     # Inactive slots target the scratch page.
-    k_pool = k_pool.at[l, pidx, :, poff].set(k_c[:, 0])
-    v_pool = v_pool.at[l, pidx, :, poff].set(v_c[:, 0])
+    k_pool, scales = _write_pool_token(k_pool, scales, l, pidx, poff, k_c[:, 0], 0)
+    v_pool, scales = _write_pool_token(v_pool, scales, l, pidx, poff, v_c[:, 0], 1)
 
     o = _attend_decode_shaped(
-        cfg, q, k_pool[l], v_pool[l], block_tables, pos, h.dtype
+        cfg, q, k_pool[l], v_pool[l], block_tables, pos, h.dtype,
+        scales[l] if scales is not None else None,
     )
-    return o @ _deq(lp["c_proj_w"], h.dtype) + lp["c_proj_b"], k_pool, v_pool
+    return (
+        o @ _deq(lp["c_proj_w"], h.dtype) + lp["c_proj_b"],
+        k_pool, v_pool, scales,
+    )
 
 
 def paged_decode_step(
@@ -228,8 +323,10 @@ def paged_decode_step(
     temperature: float = 0.0,
     top_k: int = 0,
     top_p: float = 1.0,
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """→ (k_pool, v_pool, next_tokens [B])."""
+    scales: jnp.ndarray = None,  # [L, P, KV, 2] when the pool is int8
+):
+    """→ (k_pool, v_pool, next_tokens [B]); ``scales`` threaded through and
+    returned before the tokens when the pool is quantized."""
     B = tokens.shape[0]
     page = k_pool.shape[3]
     eps = cfg.layer_norm_epsilon
@@ -241,10 +338,10 @@ def paged_decode_step(
 
     for l in range(cfg.n_layer):
         lp = _layer_params(params, l)
-        a, k_pool, v_pool = _attention_decode_paged(
+        a, k_pool, v_pool, scales = _attention_decode_paged(
             cfg, lp["attn"],
             _layer_norm(h, lp["ln_1"]["scale"], lp["ln_1"]["bias"], eps),
-            k_pool, v_pool, block_tables, seq_lens, pidx, poff, l,
+            k_pool, v_pool, block_tables, seq_lens, pidx, poff, l, scales,
         )
         h = h + a
         m, _aux = _mlp(
@@ -269,6 +366,8 @@ def paged_decode_step(
                 lg[None, :], kk, temperature, top_k, top_p
             )[0]
         )(logits, keys)
+    if scales is not None:
+        return k_pool, v_pool, scales, nxt
     return k_pool, v_pool, nxt
 
 
@@ -293,10 +392,11 @@ def paged_decode_step(
 
 
 def _attend_multitoken_paged(cfg, h, q, k_pool_l, v_pool_l,
-                             block_tables, base):
+                             block_tables, base, scales_l=None):
     """Batched attention tail of the chunk-prefill program: q [B,T,H,D]
     against the (already updated) paged cache, masked per query. The
-    caller applies the output projection.
+    caller applies the output projection. ``scales_l`` dequantizes an int8
+    pool (ISSUE 12).
 
     Dispatch mirrors ``_attention_decode_paged`` branch for branch; see the
     block comment above for why this form is token-identical but not
@@ -309,15 +409,15 @@ def _attend_multitoken_paged(cfg, h, q, k_pool_l, v_pool_l,
 
         o = paged_multitoken_cached_attention(
             q, k_pool_l, v_pool_l, block_tables, base,
-            impl=cfg.attn_impl, sm_scale=scale,
+            impl=cfg.attn_impl, sm_scale=scale, scales=scales_l,
         )
         return o.reshape(B, T, E).astype(h.dtype)
 
     # jnp impl: dense gather + the exact einsum/cast structure of
     # _attention_decode_paged's jnp branch, extended to T query rows (see
     # that branch for why this is NOT deduplicated into the dispatcher)
-    kd = jnp.swapaxes(k_pool_l[block_tables], 2, 3).reshape(B, -1, H, D)
-    vd = jnp.swapaxes(v_pool_l[block_tables], 2, 3).reshape(B, -1, H, D)
+    kd, vd = _gather_dense(k_pool_l, v_pool_l, block_tables, scales_l)
+    kd, vd = kd.reshape(B, -1, H, D), vd.reshape(B, -1, H, D)
     Smax = kd.shape[1]
     scores = jnp.einsum(
         "bshd,bthd->bhst", q.astype(jnp.float32), kd.astype(jnp.float32)
@@ -333,7 +433,7 @@ def _attend_multitoken_paged(cfg, h, q, k_pool_l, v_pool_l,
 
 
 def _attention_verify_paged(cfg, lp, h, k_pool, v_pool, block_tables,
-                            base, pidx, poff, l):
+                            base, pidx, poff, l, scales=None):
     """T-token attention per slot: scatter every token's K/V to layer ``l``
     at (``pidx[b,t]``, ``poff[b,t]``), then attend query t at position
     ``base + t`` through the block table. Out-of-budget positions arrive
@@ -352,25 +452,45 @@ def _attention_verify_paged(cfg, lp, h, k_pool, v_pool, block_tables,
     qkv = h @ _deq(lp["c_attn_w"], h.dtype) + lp["c_attn_b"]
     q, k_, v = jnp.split(qkv, 3, axis=-1)
     q = q.reshape(B, T, H, D)
-    k_c = k_.reshape(B, T, H, D).astype(k_pool.dtype)
-    v_c = v.reshape(B, T, H, D).astype(v_pool.dtype)
-    # [B,T,H,D] values to (l, pidx[b,t], :, poff[b,t], :): the advanced
-    # index pair around the head slice puts (B,T) first, matching the value
-    # layout
-    k_pool = k_pool.at[l, pidx, :, poff].set(k_c)
-    v_pool = v_pool.at[l, pidx, :, poff].set(v_c)
+    pool_dt = h.dtype if scales is not None else k_pool.dtype
+    k_c = k_.reshape(B, T, H, D).astype(pool_dt)
+    v_c = v.reshape(B, T, H, D).astype(pool_dt)
+    if scales is None:
+        # [B,T,H,D] values to (l, pidx[b,t], :, poff[b,t], :): the advanced
+        # index pair around the head slice puts (B,T) first, matching the
+        # value layout
+        k_pool = k_pool.at[l, pidx, :, poff].set(k_c)
+        v_pool = v_pool.at[l, pidx, :, poff].set(v_c)
+    else:
+        # quantized pools write the T tokens in sequence: a token landing at
+        # a page's offset 0 establishes the page's scale, and the tokens
+        # after it IN THE SAME STEP must code against that scale — exactly
+        # the order the sequential decode steps would have written them, so
+        # the pool state (codes AND scales) is bit-identical to spec-off
+        # int8 decode
+        for t in range(T):
+            k_pool, scales = _write_pool_token(
+                k_pool, scales, l, pidx[:, t], poff[:, t], k_c[:, t], 0
+            )
+            v_pool, scales = _write_pool_token(
+                v_pool, scales, l, pidx[:, t], poff[:, t], v_c[:, t], 1
+            )
     k_l, v_l = k_pool[l], v_pool[l]
+    scales_l = scales[l] if scales is not None else None
     o = jnp.concatenate(
         [
             _attend_decode_shaped(
                 cfg, q[:, t:t + 1], k_l, v_l, block_tables,
-                base + t, h.dtype,
+                base + t, h.dtype, scales_l,
             )
             for t in range(T)
         ],
         axis=1,
     )
-    return o @ _deq(lp["c_proj_w"], h.dtype) + lp["c_proj_b"], k_pool, v_pool
+    return (
+        o @ _deq(lp["c_proj_w"], h.dtype) + lp["c_proj_b"],
+        k_pool, v_pool, scales,
+    )
 
 
 def _verify_write_targets(seq_lens, block_tables, page: int, T: int):
@@ -398,9 +518,11 @@ def paged_verify_step(
     k_pool: jnp.ndarray,        # [L, P, KV, page, D]
     v_pool: jnp.ndarray,
     block_tables: jnp.ndarray,  # [B, W] i32
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    scales: jnp.ndarray = None,  # [L, P, KV, 2] when the pool is int8
+):
     """Self-speculative verify (ISSUE 10): score T = k+1 tokens per slot in
-    one forward pass → (k_pool, v_pool, greedy [B, T]).
+    one forward pass → (k_pool, v_pool, greedy [B, T]); ``scales`` threaded
+    and returned before ``greedy`` when the pool is quantized.
 
     ``greedy[b, t]`` is the argmax next token after prefix ⊕ tokens[b, :t+1]
     — i.e. exactly what ``paged_decode_step`` would emit at that point. The
@@ -424,10 +546,10 @@ def paged_verify_step(
 
     for l in range(cfg.n_layer):
         lp = _layer_params(params, l)
-        a, k_pool, v_pool = _attention_verify_paged(
+        a, k_pool, v_pool, scales = _attention_verify_paged(
             cfg, lp["attn"],
             _layer_norm(h, lp["ln_1"]["scale"], lp["ln_1"]["bias"], eps),
-            k_pool, v_pool, block_tables, seq_lens, pidx, poff, l,
+            k_pool, v_pool, block_tables, seq_lens, pidx, poff, l, scales,
         )
         h = h + a
         m, _aux = _mlp(
@@ -440,6 +562,8 @@ def paged_verify_step(
     h = _layer_norm(h, params["ln_f"]["scale"], params["ln_f"]["bias"], eps)
     logits = (h @ params["wte"].T)[..., : cfg.vocab_size]
     greedy = jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32)
+    if scales is not None:
+        return k_pool, v_pool, scales, greedy
     return k_pool, v_pool, greedy
 
 
@@ -457,9 +581,13 @@ def paged_chunk_prefill(
     temperature: float = 0.0,
     top_k: int = 0,
     top_p: float = 1.0,
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    scales: jnp.ndarray = None,  # [L, P, KV, 2] when the pool is int8
+):
     """One chunk of an incremental prefill (ISSUE 10) → (k_pool, v_pool,
-    token [1]).
+    token [1]); ``scales`` threaded and returned before the token when the
+    pool is quantized (the COW fork-by-recompute path rides this program —
+    the fresh private page is REQUANTIZED here, its own scale written,
+    while the shared original's codes and scale row are never touched).
 
     Positions ``start .. start+C-1`` run through the model attending the
     slot's cached prefix (``< start`` — earlier chunks or shared prefix
@@ -485,17 +613,23 @@ def paged_chunk_prefill(
         q, k_, v = jnp.split(qkv, 3, axis=-1)
         H, D = cfg.n_head, cfg.head_dim
         q = q.reshape(B, C, H, D)
-        k_c = k_.reshape(B, C, H, D).astype(k_pool.dtype)
-        v_c = v.reshape(B, C, H, D).astype(v_pool.dtype)
-        # page-granular scatter, exactly paged_prefill's write
-        k_pool = k_pool.at[l, page_ids].set(
-            jnp.swapaxes(k_c[0].reshape(n_cp, page, H, D), 1, 2)
+        pool_dt = hn.dtype if scales is not None else k_pool.dtype
+        k_c = k_.reshape(B, C, H, D).astype(pool_dt)
+        v_c = v.reshape(B, C, H, D).astype(pool_dt)
+        # page-granular scatter, exactly paged_prefill's write (quantized at
+        # write when the pool is int8; the attention below reads the pool,
+        # so it sees the dequantized codes either way)
+        k_pool, scales, _ = _write_pool_pages(
+            k_pool, scales, l, page_ids,
+            jnp.swapaxes(k_c[0].reshape(n_cp, page, H, D), 1, 2), 0,
         )
-        v_pool = v_pool.at[l, page_ids].set(
-            jnp.swapaxes(v_c[0].reshape(n_cp, page, H, D), 1, 2)
+        v_pool, scales, _ = _write_pool_pages(
+            v_pool, scales, l, page_ids,
+            jnp.swapaxes(v_c[0].reshape(n_cp, page, H, D), 1, 2), 1,
         )
         o = _attend_multitoken_paged(
-            cfg, hn, q, k_pool[l], v_pool[l], block_tables, base
+            cfg, hn, q, k_pool[l], v_pool[l], block_tables, base,
+            scales[l] if scales is not None else None,
         )
         a = o @ _deq(lp["attn"]["c_proj_w"], hn.dtype) + lp["attn"]["c_proj_b"]
         h = h + a
@@ -512,6 +646,8 @@ def paged_chunk_prefill(
     h_last = _layer_norm(h_last, params["ln_f"]["scale"], params["ln_f"]["bias"], eps)
     logits = (h_last @ params["wte"].T)[..., : cfg.vocab_size]
     first = sample_logits(logits, rng, temperature, top_k, top_p)
+    if scales is not None:
+        return k_pool, v_pool, scales, first
     return k_pool, v_pool, first
 
 

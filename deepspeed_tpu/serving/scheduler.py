@@ -81,6 +81,7 @@ from .kv_cache import (
     init_pools,
     pages_for,
     pool_bytes,
+    scales_bytes,
 )
 from .request import Request, RequestStatus
 
@@ -109,6 +110,14 @@ def _host_prng_key(seed: int) -> np.ndarray:
     ):
         return np.array([0, seed], np.uint32)
     return np.asarray(jax.random.PRNGKey(seed))
+
+
+def _split_scales(rest: tuple, quantized: bool):
+    """Program-wrapper operand split: ``rest`` is ``(scales, *inputs)``
+    under int8 pools, plain ``inputs`` otherwise."""
+    if quantized:
+        return rest[0], rest[1:]
+    return None, rest
 
 
 @dataclass
@@ -209,10 +218,15 @@ class ServingEngine:
             else engine.dtype
         )
         self.max_slots = int(config.max_slots)
-        self.k_pool, self.v_pool = init_pools(
+        # int8 KV pages (ISSUE 12): pools store codes, kv_scales carries the
+        # per-(layer, page, kv-head) block scales beside them — every page-id
+        # mechanism (refcounted sharing, COW fork, prefix eviction) moves the
+        # scale with the page for free
+        self.k_pool, self.v_pool, self.kv_scales = init_pools(
             mcfg.n_layer, int(config.num_pages), mcfg.n_head, page,
             mcfg.head_dim, dtype=self.cache_dtype,
         )
+        self.quantized = self.kv_scales is not None
         self.table = SlotTable(self.max_slots, self.pages_per_slot)
         self.slots: List[_Slot] = [_Slot() for _ in range(self.max_slots)]
         self.queue: Deque[Request] = deque()
@@ -400,8 +414,12 @@ class ServingEngine:
         log_dist(
             f"ServingEngine: slots={self.max_slots} page={page} "
             f"pages={config.num_pages} (pool "
-            f"{pool_bytes(mcfg.n_layer, int(config.num_pages), mcfg.n_head, page, mcfg.head_dim, np.dtype(self.cache_dtype).itemsize) / 1e6:.1f} MB) "
-            f"prefill_width={self.prefill_width} dtype={np.dtype(self.cache_dtype).name} "
+            f"{pool_bytes(mcfg.n_layer, int(config.num_pages), mcfg.n_head, page, mcfg.head_dim, np.dtype(self.cache_dtype).itemsize) / 1e6:.1f} MB"
+            + (
+                f" + {scales_bytes(mcfg.n_layer, int(config.num_pages), mcfg.n_head) / 1e6:.2f} MB scales"
+                if self.quantized else ""
+            )
+            + f") prefill_width={self.prefill_width} dtype={np.dtype(self.cache_dtype).name} "
             f"spec_k={self.spec_k if self.spec_enabled else 0} "
             f"prefix_cache={self.prefix_enabled} chunk={self.chunk_width}"
         )
@@ -424,69 +442,101 @@ class ServingEngine:
         cfg = self.model_config
         sc = self.config
         temp, tk, tp = float(sc.temperature), int(sc.top_k), float(sc.top_p)
+        quant = self.quantized
 
-        def prefill_fn(params, k_pool, v_pool, ids, plen, page_ids, key):
+        # int8 pools (ISSUE 12) thread the scales pool as one more donated
+        # operand through every program; the wrappers keep the operand order
+        # (params, k_pool, v_pool[, scales], ...static tables...) so the
+        # step loop below stays mode-agnostic apart from the scales slot
+        def prefill_fn(params, k_pool, v_pool, *rest):
+            scales, (ids, plen, page_ids, key) = _split_scales(rest, quant)
             return smodel.paged_prefill(
                 cfg, params, ids, plen, k_pool, v_pool, page_ids, key,
-                temperature=temp, top_k=tk, top_p=tp,
+                temperature=temp, top_k=tk, top_p=tp, scales=scales,
             )
 
-        def decode_fn(params, k_pool, v_pool, tokens, seq_lens, bt, keys):
+        def decode_fn(params, k_pool, v_pool, *rest):
+            scales, (tokens, seq_lens, bt, keys) = _split_scales(rest, quant)
             return smodel.paged_decode_step(
                 cfg, params, tokens, seq_lens, k_pool, v_pool, bt, keys,
-                temperature=temp, top_k=tk, top_p=tp,
+                temperature=temp, top_k=tk, top_p=tp, scales=scales,
             )
 
-        def verify_fn(params, k_pool, v_pool, tokens, seq_lens, bt):
+        def verify_fn(params, k_pool, v_pool, *rest):
+            scales, (tokens, seq_lens, bt) = _split_scales(rest, quant)
             return smodel.paged_verify_step(
-                cfg, params, tokens, seq_lens, k_pool, v_pool, bt
+                cfg, params, tokens, seq_lens, k_pool, v_pool, bt,
+                scales=scales,
             )
 
-        def chunk_fn(params, k_pool, v_pool, ids, start, plen, page_ids,
-                     bt_row, key):
+        def chunk_fn(params, k_pool, v_pool, *rest):
+            scales, (ids, start, plen, page_ids, bt_row, key) = _split_scales(
+                rest, quant
+            )
             return smodel.paged_chunk_prefill(
                 cfg, params, ids, start, plen, k_pool, v_pool, page_ids,
                 bt_row, key, temperature=temp, top_k=tk, top_p=tp,
+                scales=scales,
             )
 
         S = jax.ShapeDtypeStruct
         i32, u32 = jnp.int32, jnp.uint32
+        donate = (1, 2, 3) if quant else (1, 2)
+        pools = (self.k_pool, self.v_pool) + (
+            (self.kv_scales,) if quant else ()
+        )
         # AOT: lower + compile ONCE with the config-derived static shapes;
         # the compiled objects reject any other shape, enforcing the
-        # executable-count contract structurally (pools are donated — the
-        # cache never exists twice). The verify step REPLACES the decode
-        # step when speculation is on: exactly one decode-shaped program
-        # ever advances the batch.
-        self._prefill_exec = jax.jit(prefill_fn, donate_argnums=(1, 2)).lower(
-            self.engine.params, self.k_pool, self.v_pool,
+        # executable-count contract structurally (pools — and the scales
+        # pool under int8 — are donated: the cache never exists twice). The
+        # verify step REPLACES the decode step when speculation is on:
+        # exactly one decode-shaped program ever advances the batch.
+        self._prefill_exec = jax.jit(prefill_fn, donate_argnums=donate).lower(
+            self.engine.params, *pools,
             S((1, self.prefill_width), i32), S((), i32),
             S((self.prefill_pages,), i32), S((2,), u32),
         ).compile()
         self.executables = [self._prefill_exec]
         if self.spec_enabled:
-            self._verify_exec = jax.jit(verify_fn, donate_argnums=(1, 2)).lower(
-                self.engine.params, self.k_pool, self.v_pool,
+            self._verify_exec = jax.jit(verify_fn, donate_argnums=donate).lower(
+                self.engine.params, *pools,
                 S((self.max_slots, self.spec_k + 1), i32),
                 S((self.max_slots,), i32),
                 S((self.max_slots, self.pages_per_slot), i32),
             ).compile()
             self.executables.append(self._verify_exec)
         else:
-            self._decode_exec = jax.jit(decode_fn, donate_argnums=(1, 2)).lower(
-                self.engine.params, self.k_pool, self.v_pool,
+            self._decode_exec = jax.jit(decode_fn, donate_argnums=donate).lower(
+                self.engine.params, *pools,
                 S((self.max_slots,), i32), S((self.max_slots,), i32),
                 S((self.max_slots, self.pages_per_slot), i32),
                 S((self.max_slots, 2), u32),
             ).compile()
             self.executables.append(self._decode_exec)
         if self.chunk_width > 0:
-            self._chunk_exec = jax.jit(chunk_fn, donate_argnums=(1, 2)).lower(
-                self.engine.params, self.k_pool, self.v_pool,
+            self._chunk_exec = jax.jit(chunk_fn, donate_argnums=donate).lower(
+                self.engine.params, *pools,
                 S((1, self.chunk_width), i32), S((), i32), S((), i32),
                 S((self.chunk_width // self.page_size,), i32),
                 S((1, self.pages_per_slot), i32), S((2,), u32),
             ).compile()
             self.executables.append(self._chunk_exec)
+
+    def _pool_args(self) -> tuple:
+        """The donated pool operands in program order (scales ride along
+        under int8)."""
+        if self.quantized:
+            return (self.k_pool, self.v_pool, self.kv_scales)
+        return (self.k_pool, self.v_pool)
+
+    def _take_pools(self, out: tuple):
+        """Re-home a program's donated outputs; → the program's result
+        (sampled tokens / greedy batch)."""
+        if self.quantized:
+            self.k_pool, self.v_pool, self.kv_scales = out[0], out[1], out[2]
+            return out[3]
+        self.k_pool, self.v_pool = out[0], out[1]
+        return out[2]
 
     # ------------------------------------------------------------------
     # admission control
@@ -691,19 +741,18 @@ class ServingEngine:
                     d = self._draft(self.slots[i].request)
                     drafts[i] = d
                     vt[i, 1:] = d
-                kp, vp, out = self._verify_exec(
-                    self.engine.params, self.k_pool, self.v_pool,
+                out = self._take_pools(self._verify_exec(
+                    self.engine.params, *self._pool_args(),
                     vt, self.table.seq_lens, self.table.block_tables,
-                )
+                ))
                 self._c_spec_steps.inc()
                 self._c_spec_drafted.inc(self.spec_k * len(active))
             else:
-                kp, vp, out = self._decode_exec(
-                    self.engine.params, self.k_pool, self.v_pool,
+                out = self._take_pools(self._decode_exec(
+                    self.engine.params, *self._pool_args(),
                     self.table.tokens, self.table.seq_lens,
                     self.table.block_tables, self.table.keys,
-                )
-            self.k_pool, self.v_pool = kp, vp
+                ))
             # the ONE deliberate sync of the slot loop: the scheduler must
             # read the sampled tokens to retire/advance slots
             out_np = jax.device_get(out)  # dslint: disable=host-sync-in-step
@@ -966,11 +1015,10 @@ class ServingEngine:
         # host-built key + plain numpy operands: the compiled prefill does
         # its own device_put, so admission dispatches exactly one program
         key0 = _host_prng_key(req.seed)
-        kp, vp, first = self._prefill_exec(
-            self.engine.params, self.k_pool, self.v_pool,
+        first = self._take_pools(self._prefill_exec(
+            self.engine.params, *self._pool_args(),
             ids, np.asarray(req.prompt_len, np.int32), page_ids, key0,
-        )
-        self.k_pool, self.v_pool = kp, vp
+        ))
         self._c_prefills.inc()
         # deliberate sync: TTFT is defined by the first token reaching the
         # host, and an at-admission EOS must retire the slot before decode
@@ -1001,12 +1049,11 @@ class ServingEngine:
         avail = slot.row[0, p0: p0 + n_cp]
         page_ids[: len(avail)] = avail
         key0 = _host_prng_key(req.seed)
-        kp, vp, tok = self._chunk_exec(
-            self.engine.params, self.k_pool, self.v_pool,
+        tok = self._take_pools(self._chunk_exec(
+            self.engine.params, *self._pool_args(),
             ids, np.asarray(start, np.int32),
             np.asarray(req.prompt_len, np.int32), page_ids, slot.row, key0,
-        )
-        self.k_pool, self.v_pool = kp, vp
+        ))
         self._c_chunks.inc()
         slot.prefill_pos = start + C
         if self.tracer is not None:
@@ -1302,15 +1349,19 @@ class ServingEngine:
     def executable_names(self) -> List[tuple]:
         """→ [(name, compiled)] for the engine's program set (compiling on
         first use). The names key the dsmem budget ledger and the analysis
-        reports."""
+        reports; int8 pools suffix them ``_int8`` so the quantized programs
+        carry their OWN (lower) budget pins — the halved pool is the point,
+        and sharing the full-precision pins would let a lost quantization
+        regress silently inside the old headroom."""
         self._ensure_compiled()
-        out = [("serving_prefill", self._prefill_exec)]
+        sfx = "_int8" if self.quantized else ""
+        out = [(f"serving_prefill{sfx}", self._prefill_exec)]
         if self.spec_enabled:
-            out.append(("serving_verify", self._verify_exec))
+            out.append((f"serving_verify{sfx}", self._verify_exec))
         else:
-            out.append(("serving_decode", self._decode_exec))
+            out.append((f"serving_decode{sfx}", self._decode_exec))
         if self._chunk_exec is not None:
-            out.append(("serving_chunk_prefill", self._chunk_exec))
+            out.append((f"serving_chunk_prefill{sfx}", self._chunk_exec))
         return out
 
     def verify(self, analysis_config=None) -> list:
@@ -1337,6 +1388,15 @@ class ServingEngine:
         pool_dt = dsa.hlo_dtype(np.dtype(self.cache_dtype))
         pool_dims = ",".join(str(d) for d in self.k_pool.shape)
         expected_dtype = pool_dt if pool_dt in ("bf16", "f16") else None
+        # both pools share one shape: demand two aliased params; int8 pools
+        # additionally demand the donated scales pool aliased (a copied
+        # scales buffer is small, but an unaliased donation means XLA
+        # round-trips it every step)
+        expect_aliased = [(pool_dt, pool_dims)] * 2
+        if self.quantized:
+            expect_aliased.append(
+                ("f32", ",".join(str(d) for d in self.kv_scales.shape))
+            )
         ctx = dsa.RuleContext(program="serving")
         budget = int(getattr(acfg, "max_serving_programs", 0) or 0)
         findings = dsa.check_program_budget(
@@ -1348,8 +1408,7 @@ class ServingEngine:
             texts[name] = exe.as_text()
             pctx = dsa.RuleContext(
                 program=name,
-                # both pools share one shape: demand two aliased params
-                expect_aliased_shapes=[(pool_dt, pool_dims)] * 2,
+                expect_aliased_shapes=list(expect_aliased),
                 expected_dtype=expected_dtype,
                 upcast_allow=acfg.upcast_allow,
                 allgather_min_bytes=acfg.allgather_min_bytes,
@@ -1379,6 +1438,10 @@ class ServingEngine:
                     check_donation=False,
                     kv_pool_dims=(pool_dims,),
                     metadata_dims=self._metadata_dims(),
+                    scales_dims=(
+                        (",".join(str(d) for d in self.kv_scales.shape),)
+                        if self.quantized else ()
+                    ),
                 )
                 mem_findings, ana = dsmem.verify_memory_text(
                     texts[name], ectx
@@ -1417,6 +1480,12 @@ class ServingEngine:
             self.prefix_cache.host_metadata_bytes()
             if self.prefix_cache is not None else 0
         )
+        mcfg_m = self.model_config
+        scl_bytes = (
+            scales_bytes(mcfg_m.n_layer, int(self.config.num_pages),
+                         mcfg_m.n_head)
+            if self.quantized else 0
+        )
         out = {}
         for name, ana in (self._memory_analyses or {}).items():
             budget = dsmem.resolve_budget(mcfg, name)
@@ -1429,6 +1498,11 @@ class ServingEngine:
             # shadow (ISSUE 10)
             rec["metadata_bytes"] = ana.by_category.get("metadata", 0)
             rec["host_metadata_bytes"] = host_meta
+            # int8 pools (ISSUE 12): quantized payload + scales reported
+            # SEPARATELY — the pool entry is codes only, the scales live
+            # under metadata (where Engine E categorizes them)
+            rec["kv_cache_dtype"] = np.dtype(self.cache_dtype).name
+            rec["kv_scales_bytes"] = scl_bytes
             out[name] = rec
         return out
 
@@ -1498,6 +1572,20 @@ class ServingEngine:
                 out["request_trace"]["encode_error"] = self.tracer.encode_error
         out["kv_pages_shared"] = self.allocator.pages_shared
         out["kv_cow_forks"] = self.allocator.cow_forks_total
+        # ISSUE 12: the pool's storage dtype + its HBM split (codes vs
+        # scales) — the ops surface for "how much cache does this engine
+        # actually hold per byte"
+        mc = self.model_config
+        out["kv_cache_dtype"] = np.dtype(self.cache_dtype).name
+        out["kv_pool_bytes"] = pool_bytes(
+            mc.n_layer, int(self.config.num_pages), mc.n_head,
+            self.page_size, mc.head_dim,
+            np.dtype(self.cache_dtype).itemsize,
+        )
+        out["kv_scales_bytes"] = (
+            scales_bytes(mc.n_layer, int(self.config.num_pages), mc.n_head)
+            if self.quantized else 0
+        )
         out["chunk_prefills"] = int(self._c_chunks.value())
         if self.prefix_cache is not None:
             pc = self.prefix_cache

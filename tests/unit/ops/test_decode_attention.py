@@ -269,3 +269,121 @@ class TestPagedMultitoken:
 
         # CPU backend: gate is False regardless of shape
         assert not paged_multitoken_attention_ok(16, 64, 5)
+
+
+class TestQuantizedPagedAttention:
+    """int8 KV pages (ISSUE 12): both paged kernels dequantize codes through
+    the per-page scales operand gathered by the SAME block-table index map;
+    the jnp fallbacks must agree with the interpret-mode kernels."""
+
+    def _setup(self, B=2, H=2, D=64, page=8, P=16, n=4, seed=0):
+        from deepspeed_tpu.ops.quantizer import quantize_kv_pages
+
+        rs = np.random.RandomState(seed)
+        kf = jnp.asarray(rs.randn(P, H, page, D), jnp.float32)
+        vf = jnp.asarray(rs.randn(P, H, page, D), jnp.float32)
+        kq, ks = quantize_kv_pages(kf)
+        vq, vs = quantize_kv_pages(vf)
+        scales = jnp.stack([ks, vs], axis=-1)  # [P, KV, 2]
+        bt = jnp.asarray(
+            rs.choice(np.arange(1, P), (B * n,), replace=False).reshape(B, n),
+            jnp.int32,
+        )
+        q = jnp.asarray(rs.randn(B, H, D), jnp.float32)
+        return q, (kf, vf), (kq, vq, scales), bt
+
+    def test_single_token_kernel_matches_jnp_fallback(self):
+        from deepspeed_tpu.ops.attention import paged_cached_attention
+        from deepspeed_tpu.ops.pallas.decode_attention import (
+            paged_decode_attention,
+        )
+
+        q, _, (kq, vq, scales), bt = self._setup()
+        pos = jnp.asarray([13, 29], jnp.int32)
+        out = paged_decode_attention(
+            q, kq, vq, bt, pos, interpret=True, scales=scales
+        )
+        ref = paged_cached_attention(
+            q, kq, vq, bt, pos, impl="jnp", scales=scales
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+
+    def test_multitoken_kernel_matches_jnp_fallback(self):
+        from deepspeed_tpu.ops.attention import (
+            paged_multitoken_cached_attention,
+        )
+        from deepspeed_tpu.ops.pallas.decode_attention import (
+            paged_multitoken_attention,
+        )
+
+        _, _, (kq, vq, scales), bt = self._setup(seed=1)
+        rs = np.random.RandomState(9)
+        T = 3
+        qm = jnp.asarray(rs.randn(2, T, 2, 64), jnp.float32)
+        base = jnp.asarray([9, 21], jnp.int32)
+        out = paged_multitoken_attention(
+            qm, kq, vq, bt, base, interpret=True, scales=scales
+        )
+        ref = paged_multitoken_cached_attention(
+            qm, kq, vq, bt, base, impl="jnp", scales=scales
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+
+    def test_dequantized_attention_close_to_full_precision(self):
+        """End-to-end quantization error bound: attending the int8 pool is
+        within the block codec's rounding of attending the exact pool."""
+        from deepspeed_tpu.ops.attention import paged_cached_attention
+
+        q, (kf, vf), (kq, vq, scales), bt = self._setup(seed=2)
+        pos = jnp.asarray([20, 31], jnp.int32)
+        exact = paged_cached_attention(q, kf, vf, bt, pos, impl="jnp")
+        deq = paged_cached_attention(
+            q, kq, vq, bt, pos, impl="jnp", scales=scales
+        )
+        amax = float(jnp.max(jnp.abs(exact)))
+        assert float(jnp.max(jnp.abs(deq - exact))) <= 0.02 * amax + 1e-5
+
+    def test_gqa_scale_columns(self):
+        """GQA pools (KV < H): each q head dequantizes through its GROUP's
+        scale column, kernel and fallback alike."""
+        from deepspeed_tpu.ops.attention import paged_cached_attention
+        from deepspeed_tpu.ops.pallas.decode_attention import (
+            paged_decode_attention,
+        )
+        from deepspeed_tpu.ops.quantizer import quantize_kv_pages
+
+        rs = np.random.RandomState(3)
+        kq, ks = quantize_kv_pages(jnp.asarray(rs.randn(16, 2, 8, 64), jnp.float32))
+        vq, vs = quantize_kv_pages(jnp.asarray(rs.randn(16, 2, 8, 64), jnp.float32))
+        scales = jnp.stack([ks, vs], axis=-1)
+        bt = jnp.asarray(
+            rs.choice(np.arange(1, 16), (8,), replace=False).reshape(2, 4),
+            jnp.int32,
+        )
+        q = jnp.asarray(rs.randn(2, 4, 64), jnp.float32)  # H=4 > KV=2
+        pos = jnp.asarray([11, 27], jnp.int32)
+        out = paged_decode_attention(
+            q, kq, vq, bt, pos, interpret=True, scales=scales
+        )
+        ref = paged_cached_attention(
+            q, kq, vq, bt, pos, impl="jnp", scales=scales
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+
+    def test_scales_required_iff_int8(self):
+        from deepspeed_tpu.ops.attention import paged_cached_attention
+
+        q, (kf, vf), (kq, vq, scales), bt = self._setup(seed=4)
+        pos = jnp.asarray([5, 9], jnp.int32)
+        with pytest.raises(ValueError, match="scales"):
+            paged_cached_attention(q, kq, vq, bt, pos, impl="jnp")
+        with pytest.raises(ValueError, match="scales"):
+            paged_cached_attention(
+                q, kf, vf, bt, pos, impl="jnp", scales=scales
+            )

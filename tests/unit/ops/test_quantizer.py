@@ -112,3 +112,88 @@ class TestSTEStochastic:
         c = qn.quantize_params(params, step=100)
         d = qn.quantize_params(params, step=101)
         np.testing.assert_array_equal(np.asarray(c["w"]), np.asarray(d["w"]))
+
+
+class TestSharedBlockCodec:
+    """ISSUE 12 dedupe: ops/quantizer re-exports comm/compressed's block
+    codec — ONE scale/round/clip rule for the grad collectives, the weight
+    quantizer, and the KV page codec — plus the remainder fast path."""
+
+    def test_reexport_is_the_same_function(self):
+        from deepspeed_tpu.comm import compressed as cco
+        from deepspeed_tpu.ops import quantizer as opq
+
+        assert opq.quantize_blocks is cco.quantize_blocks
+        assert opq.dequantize_blocks is cco.dequantize_blocks
+
+    def test_weight_quantize_delegates_bit_identically(self):
+        """quantize(key=None) routes through the shared codec; codes and
+        scales must equal the historical in-place formula exactly."""
+        from deepspeed_tpu.ops.quantizer import quantize
+
+        w = jnp.asarray(np.random.RandomState(0).randn(128, 32), jnp.float32)
+        qw = quantize(w, groups=8, scale_dtype=jnp.float32)
+        wg = np.asarray(w).reshape(8, 16, 32)
+        amax = np.abs(wg).max(axis=-2, keepdims=True)
+        scale = np.where(amax > 0, amax / 127.0, 1.0)
+        ref = np.clip(np.round(wg / scale), -127, 127).astype(np.int8)
+        np.testing.assert_array_equal(np.asarray(qw.q), ref)
+        np.testing.assert_array_equal(
+            np.asarray(qw.scale), scale.astype(np.float32)
+        )
+
+    def test_kv_page_codec_roundtrip_bound(self):
+        from deepspeed_tpu.ops.quantizer import (
+            dequantize_kv_pages,
+            quantize_kv_pages,
+        )
+
+        chunks = jnp.asarray(
+            np.random.RandomState(1).randn(4, 2, 8, 16), jnp.float32
+        )
+        codes, scales = quantize_kv_pages(chunks)
+        assert codes.dtype == jnp.int8 and scales.shape == (4, 2)
+        deq = np.asarray(dequantize_kv_pages(codes, scales))
+        x = np.asarray(chunks)
+        # one block per (page, head): |err| <= amax/(2*127) per block
+        bound = np.abs(x).max(axis=(-2, -1), keepdims=True) / 127.0 * 0.5 + 1e-7
+        assert np.all(np.abs(deq - x) <= bound)
+
+    def test_kv_token_write_matches_page_codec_at_offset_zero(self):
+        """The single-token write path's scale rule (kv_page_scale) equals
+        the whole-page codec's when the token IS the page content."""
+        from deepspeed_tpu.ops.quantizer import (
+            kv_page_scale,
+            quantize_kv_pages,
+            quantize_kv_token,
+        )
+
+        v = jnp.asarray(np.random.RandomState(2).randn(3, 16), jnp.float32)
+        s = kv_page_scale(v)
+        # a page holding only this token (rest zeros) has the same amax
+        page = jnp.zeros((3, 8, 16), jnp.float32).at[:, 0].set(v)
+        _, s_page = quantize_kv_pages(page)
+        np.testing.assert_allclose(np.asarray(s), np.asarray(s_page), rtol=1e-6)
+        codes = quantize_kv_token(v, s)
+        deq = np.asarray(codes, np.float32) * np.asarray(s)[:, None]
+        bound = np.abs(np.asarray(v)).max(axis=-1, keepdims=True) / 127.0 * 0.5 + 1e-7
+        assert np.all(np.abs(deq - np.asarray(v)) <= bound)
+
+    def test_remainder_blocks_roundtrip_without_padding(self):
+        """Satellite: a non-multiple trailing remainder quantizes as one
+        short block with its own scale — no padded copy, scales = ceil."""
+        from deepspeed_tpu.comm.compressed import (
+            dequantize_blocks,
+            quantize_blocks,
+            wire_bytes,
+        )
+
+        x = jnp.asarray(np.random.RandomState(3).randn(300), jnp.float32)
+        q, s = quantize_blocks(x, "int8", 128)
+        assert q.shape == (300,) and s.shape == (3,)  # 128+128+44
+        deq = np.asarray(dequantize_blocks(q, s, 128))
+        xn = np.asarray(x)
+        for lo, hi in ((0, 128), (128, 256), (256, 300)):
+            amax = np.abs(xn[lo:hi]).max()
+            assert np.abs(deq[lo:hi] - xn[lo:hi]).max() <= amax / 127.0 * 0.5 + 1e-7
+        assert wire_bytes(300, "int8", 128) == 300 + 3 * 4

@@ -139,6 +139,112 @@ class TestCompressedCollectives:
         assert rec["ratio"] >= 3.0  # acceptance: >= 3x under fp32
 
 
+class TestCompressedGatherAndAllToAll:
+    """ISSUE 12: the two remaining big transfers on the compressed wire —
+    the ZeRO-3 param all-gather and the (MoE) all-to-all. Pure data
+    movement: no error feedback, parity bounded by the block codec's
+    one-shot rounding, wire >= 3x under fp32."""
+
+    def _map(self, fn, mesh, n_in=1, n_out=1):
+        return jax.jit(shard_map(
+            fn, mesh=mesh, in_specs=tuple([P("dp")] * n_in),
+            out_specs=(P("dp") if n_out == 1 else tuple([P("dp")] * n_out)),
+            check_vma=False,
+        ))
+
+    def test_all_gather_rank_identical_and_bounded(self, mesh_dp8):
+        n = 192  # NOT a block multiple: exercises the remainder path
+        xs = np.random.RandomState(5).randn(WORLD, n).astype(np.float32)
+
+        def f(xb):
+            full = cco.compressed_all_gather(xb[0], "dp", WORLD, "int8", 64)
+            return full[None]
+
+        out = np.asarray(self._map(f, mesh_dp8)(jnp.asarray(xs)))
+        # out[r] is rank r's gathered copy: all ranks bit-identical
+        assert all(np.array_equal(out[0], out[r]) for r in range(WORLD))
+        flat = xs.reshape(-1)
+        amax = np.abs(flat).max()
+        assert np.abs(out[0] - flat).max() <= amax / 127.0 * 0.5 + 1e-7
+
+    def test_all_to_all_parity_and_wire_ratio(self, mesh_dp8):
+        cco.reset_records()
+        n = WORLD * 96
+        xs = np.random.RandomState(6).randn(WORLD, n).astype(np.float32)
+
+        def f_plain(xb):
+            from jax import lax
+
+            return lax.all_to_all(
+                xb[0].reshape(WORLD, n // WORLD), "dp",
+                split_axis=0, concat_axis=0, tiled=False,
+            ).reshape(1, n)
+
+        def f_comp(xb):
+            return cco.compressed_all_to_all(
+                xb[0].reshape(WORLD, n // WORLD), "dp", WORLD, "int8", 64
+            ).reshape(1, n)
+
+        ref = np.asarray(self._map(f_plain, mesh_dp8)(jnp.asarray(xs)))
+        got = np.asarray(self._map(f_comp, mesh_dp8)(jnp.asarray(xs)))
+        amax = np.abs(xs).max()
+        assert np.abs(got - ref).max() <= amax / 127.0 * 0.5 + 1e-7
+        rec = cco.records()[("all_to_all", "dp")]
+        assert rec["logical_bytes"] / rec["wire_bytes"] >= 3.0
+
+    def test_gather_full_compressed_tree(self, mesh_dp8):
+        """partitioning.gather_full_compressed: dp-sharded leaves gather on
+        the compressed wire, unsharded leaves replicate untouched (exact),
+        dtypes preserved."""
+        from jax.sharding import NamedSharding
+        from deepspeed_tpu.runtime.zero.partitioning import (
+            gather_full_compressed,
+        )
+
+        rs = np.random.RandomState(7)
+        sharded = jax.device_put(
+            jnp.asarray(rs.randn(WORLD * 16, 8), jnp.float32),
+            NamedSharding(mesh_dp8, P("dp")),
+        )
+        small = jax.device_put(
+            jnp.asarray(rs.randn(4), jnp.float32),
+            NamedSharding(mesh_dp8, P()),
+        )
+        tree = {"big": sharded, "small": small}
+        out = gather_full_compressed(tree, mesh_dp8, "dp", "int8", 64)
+        assert out["big"].sharding.is_fully_replicated
+        assert out["big"].dtype == jnp.float32
+        amax = float(jnp.max(jnp.abs(sharded)))
+        assert float(jnp.max(jnp.abs(out["big"] - sharded))) <= amax / 127.0 * 0.5 + 1e-6
+        np.testing.assert_array_equal(np.asarray(out["small"]), np.asarray(small))
+
+    def test_policy_gate_requires_stage3_and_axis(self, mesh_dp8):
+        from deepspeed_tpu.runtime.config import CommCompressionConfig
+        from deepspeed_tpu.runtime.zero.partitioning import (
+            ZeroShardingPolicy,
+            gather_full,
+        )
+
+        cc = CommCompressionConfig(enabled=True)
+        p3 = ZeroShardingPolicy(mesh_dp8, stage=3)
+        p2 = ZeroShardingPolicy(mesh_dp8, stage=2)
+        assert p3.supports_compressed_param_gather()
+        assert not p2.supports_compressed_param_gather()
+        # the ledger is the non-vacuous witness of which path ran: a
+        # compressed gather records ("all_gather", "dp"); the plain
+        # device_put path records nothing — and irrational values can't
+        # round-trip the int8 codec by luck, so bit-equality with
+        # gather_full proves the plain path bit-wise too
+        x = jnp.asarray(np.random.RandomState(0).randn(8), jnp.float32)
+        for policy, cfg in ((p2, cc), (p3, CommCompressionConfig(enabled=False))):
+            cco.reset_records()
+            out = policy.param_gather_fn(cfg)({"x": x})["x"]
+            np.testing.assert_array_equal(
+                np.asarray(out), np.asarray(gather_full({"x": x}, mesh_dp8)["x"])
+            )
+            assert ("all_gather", "dp") not in cco.records()
+
+
 # ---------------------------------------------------------------------------
 # error feedback on a toy quadratic
 # ---------------------------------------------------------------------------
@@ -496,9 +602,37 @@ class TestConfig:
                 compression={"enabled": True}, fp16={"enabled": True},
             )
 
-    def test_stage3_rejected(self, mesh_dp8):
-        with pytest.raises(ValueError, match="stage"):
-            _make_engine(mesh_dp8, stage=3, compression={"enabled": True})
+    def test_stage3_compresses_gather_not_grads(self, mesh_dp8):
+        """ISSUE 12: stage 3 + comm_compression no longer rejects — the grad
+        reduce stays uncompressed (params are dp-sharded inside the grad
+        region) and compression covers the explicit param all-gather."""
+        model = make_simple_model()
+        cfg_dict = base_config(stage=3, dp=WORLD)
+        # drop the persistence threshold so the tiny test params actually
+        # shard over dp (the production default keeps small params gathered)
+        cfg_dict["zero_optimization"] = {
+            "stage": 3, "stage3_param_persistence_threshold": 2,
+        }
+        cfg_dict["comm_compression"] = {"enabled": True}
+        cfg = DeepSpeedConfig.load(cfg_dict, dp_world_size=WORLD)
+        eng = DeepSpeedEngine(model, cfg, mesh=mesh_dp8, seed=1)
+        assert not eng._compress_grads
+        assert any(
+            not p.sharding.is_fully_replicated
+            for p in jax.tree.leaves(eng.state.params)
+        )
+        cco.reset_records()
+        gathered = eng.gather_params()
+        # every gathered leaf replicated and ≈ the sharded original
+        for g, p in zip(jax.tree.leaves(gathered), jax.tree.leaves(eng.state.params)):
+            assert g.sharding.is_fully_replicated
+            gn = np.asarray(g, np.float32)
+            pn = np.asarray(p, np.float32)
+            amax = np.abs(pn).max()
+            assert np.abs(gn - pn).max() <= amax / 127.0 * 0.5 + 1e-6
+        # the dp-sharded leaves went over the compressed wire
+        recs = cco.records_by_axis()
+        assert "dp" in recs and recs["dp"]["ratio"] >= 3.0
 
 
 def test_overlap_xla_flags_helper():
