@@ -1203,6 +1203,195 @@ def run_kv_quant_bench():
     return pr12
 
 
+def run_tp_serving_bench():
+    """BENCH_pr14.json (ISSUE 14): tensor-parallel + disaggregated serving.
+    Three measurements:
+
+    1. TP=1 vs TP=2 sweep on the 16-request mixed suite with every serving
+       feature ON (speculative k=3 + prefix cache + chunked prefill):
+       tokens/s, TTFT/TPOT p99, per-device pool bytes, and a token-parity
+       check (TP=2 must stream the exact tokens TP=1 does). On the CPU host
+       mesh the sharded programs pay shard_map/collective overhead with no
+       bandwidth to win back, so wall-clock honestly goes DOWN at TP=2 —
+       the headline is the capacity column, not the latency one.
+    2. Resident sessions at fixed PER-DEVICE HBM: the KV pool shards 1/tp
+       over the ``tp`` axis, so at the same per-device pool byte budget a
+       TP=2 placement holds ~2x the sessions (acceptance pin: >= 1.8x;
+       page 0 stays scratch on every device, hence not exactly 2x).
+    3. Disaggregation A/B: decode TPOT p99 for resident decoders while long
+       COLD prefills (chunking off, no shared prefix) keep arriving.
+       Colocated, each admission runs the full prefill program ahead of the
+       next decode step on the SAME devices — every cold arrival stalls all
+       resident decoders for a full prefill. Disaggregated, prefill runs on
+       its own placement and decode polls the handoff token without
+       blocking, so decode TPOT p99 must come out lower (the pin)."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.models import gpt2
+    from deepspeed_tpu.serving.kv_cache import pages_for
+
+    cfg = gpt2.get_config("gpt2-tiny", attn_impl="jnp")
+    params = jax.jit(lambda r: gpt2.init_params(cfg, r))(jax.random.PRNGKey(0))
+    eng = InferenceEngine(
+        gpt2.make_module(cfg), params=params, dtype=jnp.float32
+    )
+
+    base = {
+        "max_slots": 4, "page_size": 4, "num_pages": 64,
+        "max_prompt_len": 12, "max_new_tokens": 8,
+        "speculative": {"enabled": True, "k": 3},
+        "prefix_cache": {"enabled": True},
+        "prefill_chunk_tokens": 8,
+    }
+    rs = np.random.RandomState(7)
+    plens = [2, 5, 8, 12, 7, 3, 11, 4] * 2
+    suite = [
+        (rs.randint(0, cfg.vocab_size, (plens[i],)).astype(np.int32),
+         6 if i % 7 else (1, 3, 8)[i // 7])
+        for i in range(16)
+    ]
+
+    def _p99_ms(xs):
+        xs = sorted(xs)
+        if not xs:
+            return None
+        return round(xs[min(len(xs) - 1, int(len(xs) * 0.99))] * 1e3, 3)
+
+    # -- 1. TP=1 vs TP=2 mixed-suite sweep ------------------------------
+    sweep = {}
+    streams = {}
+    for tp in (1, 2):
+        c = dict(base)
+        if tp > 1:
+            c["placement"] = {"tp": tp}
+        srv = eng.serve(c)
+        warm = srv.submit(suite[0][0], max_new_tokens=2, seed=99)
+        srv.run()
+        srv.release_prefix_cache()  # the timed run starts cold
+        t0 = _time.monotonic()
+        reqs = [
+            srv.submit(p, max_new_tokens=n, seed=i)
+            for i, (p, n) in enumerate(suite)
+        ]
+        srv.run()
+        t_total = _time.monotonic() - t0
+        findings = srv.verify()
+        placement = srv.stats()["placement"]
+        streams[tp] = [list(r.tokens) for r in reqs]
+        srv.drain()
+        srv.release_prefix_cache()
+        srv.check_no_leaks()
+        sweep[f"tp{tp}"] = {
+            "tokens_per_sec": round(
+                sum(len(r.tokens) for r in reqs) / t_total, 1
+            ),
+            "ttft_p99_ms": _p99_ms(
+                [r.ttft_s for r in reqs if r.ttft_s is not None]
+            ),
+            "tpot_p99_ms": _p99_ms(
+                [r.tpot_s for r in reqs if r.tpot_s is not None]
+            ),
+            "per_device_pool_bytes": {
+                name: rec["per_device_pool_bytes"]
+                for name, rec in placement["placements"].items()
+            },
+            "verify_findings": len(findings),
+        }
+    parity_ok = streams[1] == streams[2]
+
+    # -- 2. resident sessions at fixed per-device HBM -------------------
+    page = base["page_size"]
+    per_page_dev = {
+        tp: 2 * cfg.n_layer * (cfg.n_head // tp) * page * cfg.head_dim * 4
+        for tp in (1, 2)
+    }
+    dev_budget = base["num_pages"] * per_page_dev[1]
+    pages_per_session = pages_for(
+        base["max_prompt_len"] + base["max_new_tokens"], page
+    )
+    sessions = {
+        f"tp{tp}": (dev_budget // pp - 1) // pages_per_session
+        for tp, pp in per_page_dev.items()  # page 0 stays scratch
+    }
+    resident = {
+        "per_device_hbm_budget_bytes": dev_budget,
+        "kv_bytes_per_page_per_device": per_page_dev,
+        "pages_per_session": pages_per_session,
+        "sessions": sessions,
+        "ratio": round(sessions["tp2"] / max(1, sessions["tp1"]), 3),
+    }
+    resident_pin_ok = resident["ratio"] >= 1.8
+
+    # -- 3. disaggregation A/B: decode TPOT under cold-prefill pressure -
+    ab_cfg = {
+        "max_slots": 6, "page_size": 4, "num_pages": 512,
+        "max_prompt_len": 96, "max_new_tokens": 32,
+    }
+    ab = {}
+    for mode, placement in (
+        ("colocated", None), ("disaggregated", {"disaggregate": True}),
+    ):
+        c = dict(ab_cfg)
+        if placement:
+            c["placement"] = placement
+        srv = eng.serve(c)
+        rs2 = np.random.RandomState(14)
+        mk = lambda n: rs2.randint(0, cfg.vocab_size, (n,)).astype(np.int32)
+        srv.submit(mk(96), max_new_tokens=2, seed=0)
+        srv.run()  # warm both prefill widths + decode (and the handoff pair)
+        decoders = [
+            srv.submit(mk(4), max_new_tokens=32, seed=i) for i in range(3)
+        ]
+        srv.step()  # decoders admitted + first tokens out
+        cold = [
+            srv.submit(mk(96), max_new_tokens=1, seed=10 + i)
+            for i in range(24)
+        ]
+        srv.run()
+        srv.check_no_leaks()
+        # TPOT p99 over the PER-TOKEN inter-emission gaps (not per-request
+        # means): colocated, the gaps that land behind a cold admission
+        # carry the whole prefill — that stall tail is the thing
+        # disaggregation exists to cut, and a per-request mean dilutes it
+        gaps = np.concatenate([
+            np.diff(r.t_emissions) for r in decoders if len(r.t_emissions) > 1
+        ])
+        ab[mode] = {
+            "decode_tpot_p99_ms": _p99_ms([float(g) for g in gaps]),
+            "decode_tpot_mean_ms": round(float(np.mean(gaps)) * 1e3, 3),
+            "cold_prefill_ttft_p99_ms": _p99_ms(
+                [r.ttft_s for r in cold if r.ttft_s is not None]
+            ),
+            "kv_handoffs": srv.stats().get("kv_handoffs", 0),
+        }
+    disagg_pin_ok = bool(
+        ab["disaggregated"]["decode_tpot_p99_ms"]
+        < ab["colocated"]["decode_tpot_p99_ms"]
+    )
+
+    pr14 = {
+        "schema": "bench_pr14_tp_serving_v1",
+        "model": "gpt2-tiny",
+        "backend": jax.default_backend(),
+        "devices": jax.device_count(),
+        "serving_config": base,
+        "tp_sweep": sweep,
+        "tp2_token_parity_ok": parity_ok,
+        "resident_sessions_at_fixed_device_hbm": resident,
+        "resident_pin_min_ratio": 1.8,
+        "resident_pin_ok": resident_pin_ok,
+        "disaggregation_ab": {"serving_config": ab_cfg, **ab},
+        "disagg_tpot_pin_ok": disagg_pin_ok,
+    }
+    with open(os.path.join(_BENCH_DIR, "BENCH_pr14.json"), "w") as fh:
+        json.dump(pr14, fh, indent=1)
+    return pr14
+
+
 def run_resilience_bench():
     """BENCH_pr7.json (ISSUE 7): save-overhead-per-step of the async
     integrity-checked checkpoint path, and recovery time through the
@@ -2109,6 +2298,16 @@ if __name__ == "__main__":
                 _flags + " --xla_force_host_platform_device_count=8"
             ).strip()
         print(json.dumps(run_kv_quant_bench()))
+    elif os.environ.get("BENCH_TP_SERVING_ONLY", "0") == "1":
+        # ISSUE 14: just the tensor-parallel + disaggregated serving bench
+        # (BENCH_pr14.json) — pins 8 host devices so the tp mesh and the
+        # split placements exist on a CPU-only host
+        _flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in _flags:
+            os.environ["XLA_FLAGS"] = (
+                _flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        print(json.dumps(run_tp_serving_bench()))
     elif os.environ.get("BENCH_RESILIENCE_ONLY", "0") == "1":
         print(json.dumps(run_resilience_bench()))
     elif os.environ.get("BENCH_DSAN_ONLY", "0") == "1":

@@ -1,6 +1,6 @@
 """Engine D: HLO collective-consistency verifier — SPMD ordering rules.
 
-A multichip program deadlocks the way ROADMAP item 4's hand-pipelined
+A multichip program deadlocks the way ROADMAP item 3's hand-pipelined
 ``ppermute`` chains will: two programs (or two branches of one) disagree
 about which collective happens next on a shared mesh axis, every chip waits
 for a partner that is executing a different collective, and the run hangs

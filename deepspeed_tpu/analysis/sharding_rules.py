@@ -1,7 +1,8 @@
 """Engine F: sharding-spec verification — regex spec tables vs real trees.
 
-The TP/disaggregated-serving refactor (ROADMAP item 3) will map checkpoints
-onto a sharded serving model through ``match_partition_rules``-style tables:
+The TP/disaggregated-serving refactor (ROADMAP item 2, landed: ISSUE 14)
+maps checkpoints onto a sharded serving model through
+``match_partition_rules``-style tables:
 an ordered list of ``(regex, partition_spec)`` pairs, first match wins, one
 spec per parameter path. Every production JAX codebase that uses this
 pattern hits the same three footguns, one checkpoint at a time:

@@ -246,6 +246,70 @@ def main() -> int:
     except Exception as e:
         print(f"request tracing ..... {RED_NO} ({type(e).__name__}: {e})")
     print("-" * 60)
+    print("Serving placement (ISSUE 14):")
+    try:
+        import json
+        import os
+
+        from deepspeed_tpu.runtime.config import ServingConfig
+        from deepspeed_tpu.serving.placement import (
+            GPT2_SERVING_RULES,
+            TP_AXIS,
+        )
+
+        pcfg = ServingConfig().placement
+        print(
+            f"tp mesh axis ........ '{TP_AXIS}' (serving.placement.tp — "
+            f"default {pcfg.tp}; {len(GPT2_SERVING_RULES)} committed "
+            "sharding rules for the gpt2 serving tree)"
+        )
+        print(
+            f"disaggregation ...... "
+            f"{'on' if pcfg.disaggregate else 'off'} by default "
+            "(serving.placement.disaggregate — prefill/chunk-prefill on "
+            "one placement, decode/verify on another, KV handoff over "
+            "the page machinery)"
+        )
+        # per-device pool bytes come from the committed bench artifact —
+        # env_report stays cheap (no compiles, no pool allocation here)
+        bench_path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "BENCH_pr14.json",
+        )
+        if os.path.exists(bench_path):
+            with open(bench_path, encoding="utf-8") as fh:
+                doc = json.load(fh)
+            for tp, rec in sorted((doc.get("tp_sweep") or {}).items()):
+                pools = ", ".join(
+                    f"{name}: {b / 1e6:.2f} MB/device"
+                    for name, b in (rec.get(
+                        "per_device_pool_bytes") or {}).items()
+                )
+                print(f"  {tp:<18} kv pool {pools}")
+            res = doc.get("resident_sessions_at_fixed_device_hbm") or {}
+            if res:
+                print(
+                    f"  resident sessions  "
+                    f"{res.get('sessions')} at fixed per-device HBM "
+                    f"(x{res.get('ratio')})"
+                )
+        else:
+            print("  pool bytes ......... unmeasured — run bench.py "
+                  "(BENCH_TP_SERVING_ONLY=1)")
+        print(
+            "program map ......... shared: all programs on one placement; "
+            "disaggregated: serving_prefill/_chunk_prefill → 'prefill', "
+            "serving_decode/_verify → 'decode', serving_kv_gather/"
+            "_scatter bridge the two"
+        )
+        print(
+            "verify .............. ServingEngine.verify() runs Engine F "
+            "(analysis.sharding.rules) PRE-compile, then Engines A/D/E "
+            "on every placement's executables"
+        )
+    except Exception as e:
+        print(f"serving placement ... {RED_NO} ({type(e).__name__}: {e})")
+    print("-" * 60)
     return 0
 
 

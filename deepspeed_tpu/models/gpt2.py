@@ -288,8 +288,14 @@ def _attention(cfg: GPT2Config, lp, h, train: bool, rng=None):
     return out
 
 
-def _mlp(cfg: GPT2Config, lp, h, train: bool, rng=None):
-    """Dense or MoE FFN; returns (out, aux_loss)."""
+def _mlp(cfg: GPT2Config, lp, h, train: bool, rng=None, tp_axis=None):
+    """Dense or MoE FFN; returns (out, aux_loss).
+
+    ``tp_axis`` (ISSUE 14): under the TP-sharded serving ``shard_map``, the
+    dense branch's weights arrive column-parallel (``c_fc``) / row-parallel
+    (``c_proj``) slices — the projection's partial product is psum-reduced
+    over the named axis BEFORE the replicated bias is added once. None (the
+    default, and every training caller) is the exact historical graph."""
     if cfg.is_moe:
         from ..moe.sharded_moe import MoEConfig, moe_mlp
 
@@ -309,7 +315,10 @@ def _mlp(cfg: GPT2Config, lp, h, train: bool, rng=None):
         return moe_mlp(lp, h, mcfg, rng=rng, train=train, mesh=cfg.mesh)
     x = h @ _deq(lp["c_fc_w"], h.dtype) + lp["c_fc_b"]
     x = jax.nn.gelu(x, approximate=True)
-    return x @ _deq(lp["c_proj_w"], x.dtype) + lp["c_proj_b"], jnp.float32(0.0)
+    out = x @ _deq(lp["c_proj_w"], x.dtype)
+    if tp_axis is not None:
+        out = jax.lax.psum(out, tp_axis)
+    return out + lp["c_proj_b"], jnp.float32(0.0)
 
 
 def _block(cfg: GPT2Config, layer_params, h, train: bool, rng=None):

@@ -5,12 +5,15 @@ from .replace_policy import (
     POLICY_REGISTRY,
     match_policy,
 )
+from .tp_shard import permute_qkv_for_tp, tp_shard_serving_params
 
 __all__ = [
     "DSPolicy",
     "HFGPT2LayerPolicy",
     "POLICY_REGISTRY",
     "match_policy",
+    "permute_qkv_for_tp",
     "replace_transformer_layer",
     "revert_transformer_layer",
+    "tp_shard_serving_params",
 ]

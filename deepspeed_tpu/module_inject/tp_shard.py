@@ -1,0 +1,79 @@
+"""Tensor-parallel weight mapping for the injected serving tree (ISSUE 14).
+
+The reference projects TP-slices torch weights at injection time
+(``ReplaceWithTensorSlicing.copy``, module_inject/replace_module.py): each
+rank keeps ``1/tp`` of every attention/MLP matrix, chosen so the per-rank
+slice is a complete set of heads. Here the whole tree stays materialized
+(JAX shards it with ``NamedSharding`` device_puts instead of per-rank
+copies), so the only real work is the **layout fix** the reference hides in
+its ``qkv`` copy path:
+
+``c_attn_w`` is ``[L, E, 3E]`` with output columns ``[Q | K | V]``. A plain
+``PartitionSpec(None, None, "tp")`` hands rank ``r`` the contiguous column
+block ``[3E/tp * r, 3E/tp * (r+1))`` — a slice that straddles the Q/K/V
+boundary and contains heads of *different roles*. For head-parallel
+attention each rank needs ``[Q_r | K_r | V_r]``: its own ``H/tp`` heads of
+each role. :func:`permute_qkv_for_tp` reorders the columns from role-major
+``(3, tp, Hl*D)`` to rank-major ``(tp, 3, Hl*D)`` so the naive contiguous
+slice IS the head-parallel slice; ``c_attn_b`` gets the same permutation.
+
+Row-parallel matrices (``attn/c_proj_w``, ``mlp/c_proj_w``) need no
+permutation: their *input* dim is heads-major (``[E, ...]`` with head ``h``
+owning rows ``[h*D, (h+1)*D)``), already contiguous per rank. MLP ``c_fc``
+column slices are role-free too. Everything else is replicated.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+PyTree = Any
+
+
+def permute_qkv_for_tp(w, b, tp: int):
+    """Reorder fused-QKV output columns from role-major to rank-major.
+
+    ``w``: ``[L, E, 3E]`` (or ``[E, 3E]``), ``b``: ``[L, 3E]`` (or
+    ``[3E]``). Columns regrouped ``(3, tp, Hl*D) -> (tp, 3, Hl*D)`` so the
+    contiguous ``tp``-slice ``r`` holds exactly ``[Q_r | K_r | V_r]``.
+    Identity at ``tp == 1``. Returns ``(w, b)``."""
+    tp = int(tp)
+    if tp <= 1:
+        return w, b
+    three_e = int(w.shape[-1])
+    if three_e % (3 * tp):
+        raise ValueError(
+            f"fused QKV width {three_e} not divisible by 3*tp={3 * tp}"
+        )
+    chunk = three_e // (3 * tp)  # Hl * D: one rank's heads of one role
+    lead_w = tuple(w.shape[:-1])
+    lead_b = tuple(b.shape[:-1])
+    nw = len(lead_w)
+    nb = len(lead_b)
+    w = w.reshape(lead_w + (3, tp, chunk))
+    w = jnp.swapaxes(w, nw, nw + 1).reshape(lead_w + (three_e,))
+    b = b.reshape(lead_b + (3, tp, chunk))
+    b = jnp.swapaxes(b, nb, nb + 1).reshape(lead_b + (three_e,))
+    return w, b
+
+
+def tp_shard_serving_params(params: PyTree, tp: int) -> PyTree:
+    """The injected gpt2 serving tree, QKV-permuted for a ``tp``-way mesh.
+
+    Pure layout transform — values identical up to column order, so the
+    TP=1 tree passes through untouched and checkpoint round-trips stay
+    byte-stable. The caller device_puts the result with the sharding
+    table (``serving.placement.GPT2_SERVING_RULES``)."""
+    if int(tp) <= 1:
+        return params
+    out = dict(params)
+    blocks = dict(out["blocks"])
+    attn = dict(blocks["attn"])
+    attn["c_attn_w"], attn["c_attn_b"] = permute_qkv_for_tp(
+        attn["c_attn_w"], attn["c_attn_b"], tp
+    )
+    blocks["attn"] = attn
+    out["blocks"] = blocks
+    return out

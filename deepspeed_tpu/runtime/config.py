@@ -599,7 +599,9 @@ class ShardingAnalysisConfig(DSConfigModel):
     (``spec-rank-mismatch``), and large leaves that resolve to fully
     replicated (``replicated-large-leaf``, floored at
     ``replicated_min_bytes``). Empty ``rules`` skips the engine — the
-    TP-serving refactor (ROADMAP item 3) commits its table here."""
+    TP-serving refactor (ROADMAP item 2, landed: ISSUE 14) commits its
+    table (``serving/placement.py:GPT2_SERVING_RULES``) here; an explicit
+    ``rules`` entry overrides it."""
 
     enabled: bool = True
     rules: List[List] = field(default_factory=list)
@@ -885,6 +887,47 @@ class SLOConfig(DSConfigModel):
 
 
 @dataclass
+class PlacementConfig(DSConfigModel):
+    """serving.placement section (ISSUE 14): tensor-parallel + disaggregated
+    program placement.
+
+    ``tp`` > 1 shards the paged KV pools (+ int8 scales), attention heads
+    and MLP over a ``tp`` mesh axis via the committed spec table
+    (``serving/placement.py:GPT2_SERVING_RULES``, overridable through
+    ``analysis.sharding.rules``): per-device KV bytes drop ``1/tp``, block
+    tables and the page allocator stay host-side and placement-agnostic,
+    and greedy streams stay token-identical to the single-device engine.
+
+    ``disaggregate`` splits prefill from decode onto separate core-sets:
+    decode/verify own the main pool on the first ``decode_tp`` devices;
+    prefill/chunk-prefill compile for the NEXT ``prefill_tp`` devices with
+    their own ``prefill_num_pages``-page pool, and finished prompt KV rides
+    a gather → device_put → scatter handoff into the decode pool. Decode
+    batches no longer share a core-set (or a dispatch queue) with long cold
+    prefills, so TPOT stays flat under prefill bursts. ``decode_tp`` /
+    ``prefill_tp`` default to ``tp``; ``prefill_num_pages`` defaults to the
+    prompt pages the prefill side actually needs (``max_slots`` concurrent
+    prompts + scratch)."""
+
+    tp: int = 1
+    disaggregate: bool = False
+    decode_tp: int = 0       # 0 = tp
+    prefill_tp: int = 0      # 0 = tp
+    prefill_num_pages: int = 0  # 0 = auto-size from max_slots * prompt pages
+
+    def __post_init__(self):
+        for key in ("tp", "decode_tp", "prefill_tp", "prefill_num_pages"):
+            if int(getattr(self, key)) < 0:
+                raise DeepSpeedConfigError(
+                    f"serving.placement.{key} must be >= 0"
+                )
+        if int(self.tp) < 1:
+            raise DeepSpeedConfigError(
+                f"serving.placement.tp must be >= 1, got {self.tp}"
+            )
+
+
+@dataclass
 class ServingConfig(DSConfigModel):
     """serving section (TPU-native; no reference analog — the reference serves
     one static batch per ``InferenceEngine.forward`` call). Drives the
@@ -955,6 +998,8 @@ class ServingConfig(DSConfigModel):
     prefill_chunk_tokens: int = 0
     # --- ISSUE 11: per-tenant SLO classes + goodput accounting -------------
     slo: SLOConfig = field(default_factory=SLOConfig)
+    # --- ISSUE 14: tensor-parallel sharding + prefill/decode disaggregation
+    placement: PlacementConfig = field(default_factory=PlacementConfig)
 
     def __post_init__(self):
         for key in ("max_slots", "page_size", "num_pages", "max_prompt_len",
@@ -971,6 +1016,8 @@ class ServingConfig(DSConfigModel):
             self.prefix_cache = PrefixCacheConfig.from_dict(self.prefix_cache)
         if isinstance(self.slo, dict):
             self.slo = SLOConfig.from_dict(self.slo)
+        if isinstance(self.placement, dict):
+            self.placement = PlacementConfig.from_dict(self.placement)
         if int(self.prefill_chunk_tokens) < 0:
             raise DeepSpeedConfigError(
                 "serving.prefill_chunk_tokens must be >= 0, got "

@@ -2020,8 +2020,9 @@ class DeepSpeedEngine:
         # compiled text — channel uniqueness, start/done pairing/FIFO; the
         # cross-program divergence check is vacuous for the single-step
         # program set but runs through the same entry point so a future
-        # multi-program engine (pipelined collectives, ROADMAP item 4)
-        # inherits it for free
+        # multi-program engine (pipelined collectives, ROADMAP item 3)
+        # inherits it for free — and the TP-sharded serving program set
+        # (ISSUE 14) already exercises it in ServingEngine.verify()
         findings.extend(dsa.verify_program_set({"train_step": txt}))
         # Engine E (ISSUE 9): static HBM liveness over the same text — the
         # peak-vs-budget gate plus donation/scratch/padding byte rules;

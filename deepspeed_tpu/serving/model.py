@@ -108,6 +108,19 @@ def _write_pool_token(pool, scales, l, pidx, poff, vals, sidx):
     return pool, scales
 
 
+def _proj(o, w, b, dtype, tp_axis=None):
+    """Output projection shared by every attention variant. Under the TP
+    ``shard_map`` (ISSUE 14) ``w`` is the row-parallel slice — the partial
+    product is psum-reduced over ``tp_axis`` BEFORE the replicated bias is
+    added once (adding per-rank biases would count ``b`` tp times). With
+    ``tp_axis=None`` this is the exact historical ``o @ w + b`` graph, so
+    the TP=1 program set stays byte-identical."""
+    out = o @ _deq(w, dtype)
+    if tp_axis is not None:
+        out = lax.psum(out, tp_axis)
+    return out + b
+
+
 def _gather_dense(k_pool_l, v_pool_l, block_tables, scales_l=None):
     """Gather each slot's pages into the dense ``[B, n, page, KV, D]`` view
     the jnp attention branches consume, dequantizing int8 pools through
@@ -132,7 +145,7 @@ def _layer_params(params: PyTree, l: int) -> PyTree:
 
 
 def _attention_prefill_paged(cfg, lp, h, k_pool, v_pool, page_ids, l,
-                             scales=None):
+                             scales=None, tp_axis=None):
     """Causal self-attention over the prompt chunk; K/V written to layer
     ``l``'s pages of the FULL pool (quantized at write when ``scales`` is
     given — the attention then reads the DEQUANTIZED chunk back, so the
@@ -180,9 +193,11 @@ def _attention_prefill_paged(cfg, lp, h, k_pool, v_pool, page_ids, l,
     scores = jnp.where(mask[None, None, :, :], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(v_c.dtype)
     o = jnp.einsum("bhst,bthd->bshd", probs, v_c)
-    o = o.reshape(B, Sp, E).astype(h.dtype)
+    # H*D == E at TP=1; under the TP shard_map H is the per-rank head count
+    # and the row-parallel projection restores the full embed dim
+    o = o.reshape(B, Sp, H * D).astype(h.dtype)
     return (
-        o @ _deq(lp["c_proj_w"], h.dtype) + lp["c_proj_b"],
+        _proj(o, lp["c_proj_w"], lp["c_proj_b"], h.dtype, tp_axis),
         k_pool, v_pool, scales,
     )
 
@@ -200,6 +215,7 @@ def paged_prefill(
     top_k: int = 0,
     top_p: float = 1.0,
     scales: jnp.ndarray = None,  # [L, P, KV, 2] when the pool is int8
+    tp_axis: str = None,  # named mesh axis under the TP shard_map (ISSUE 14)
 ):
     """→ (k_pool, v_pool, first_token [1]), with ``scales`` threaded between
     the pools and the token when the pool is quantized (ISSUE 12)."""
@@ -213,13 +229,13 @@ def paged_prefill(
         a, k_pool, v_pool, scales = _attention_prefill_paged(
             cfg, lp["attn"],
             _layer_norm(h, lp["ln_1"]["scale"], lp["ln_1"]["bias"], eps),
-            k_pool, v_pool, page_ids, l, scales,
+            k_pool, v_pool, page_ids, l, scales, tp_axis,
         )
         h = h + a
         m, _aux = _mlp(
             cfg, lp["mlp"],
             _layer_norm(h, lp["ln_2"]["scale"], lp["ln_2"]["bias"], eps),
-            False, None,
+            False, None, tp_axis=tp_axis,
         )
         h = h + m
 
@@ -279,7 +295,7 @@ def _attend_decode_shaped(cfg, q, k_pool_l, v_pool_l, block_tables, pos,
 
 
 def _attention_decode_paged(cfg, lp, h, k_pool, v_pool, block_tables,
-                            pos, pidx, poff, l, scales=None):
+                            pos, pidx, poff, l, scales=None, tp_axis=None):
     """One-token attention per slot against its paged cache (layer ``l`` of
     the FULL pool).
 
@@ -306,7 +322,7 @@ def _attention_decode_paged(cfg, lp, h, k_pool, v_pool, block_tables,
         scales[l] if scales is not None else None,
     )
     return (
-        o @ _deq(lp["c_proj_w"], h.dtype) + lp["c_proj_b"],
+        _proj(o, lp["c_proj_w"], lp["c_proj_b"], h.dtype, tp_axis),
         k_pool, v_pool, scales,
     )
 
@@ -324,6 +340,7 @@ def paged_decode_step(
     top_k: int = 0,
     top_p: float = 1.0,
     scales: jnp.ndarray = None,  # [L, P, KV, 2] when the pool is int8
+    tp_axis: str = None,  # named mesh axis under the TP shard_map (ISSUE 14)
 ):
     """→ (k_pool, v_pool, next_tokens [B]); ``scales`` threaded through and
     returned before the tokens when the pool is quantized."""
@@ -342,12 +359,13 @@ def paged_decode_step(
             cfg, lp["attn"],
             _layer_norm(h, lp["ln_1"]["scale"], lp["ln_1"]["bias"], eps),
             k_pool, v_pool, block_tables, seq_lens, pidx, poff, l, scales,
+            tp_axis,
         )
         h = h + a
         m, _aux = _mlp(
             cfg, lp["mlp"],
             _layer_norm(h, lp["ln_2"]["scale"], lp["ln_2"]["bias"], eps),
-            False, None,
+            False, None, tp_axis=tp_axis,
         )
         h = h + m
 
@@ -411,7 +429,7 @@ def _attend_multitoken_paged(cfg, h, q, k_pool_l, v_pool_l,
             q, k_pool_l, v_pool_l, block_tables, base,
             impl=cfg.attn_impl, sm_scale=scale, scales=scales_l,
         )
-        return o.reshape(B, T, E).astype(h.dtype)
+        return o.reshape(B, T, H * D).astype(h.dtype)
 
     # jnp impl: dense gather + the exact einsum/cast structure of
     # _attention_decode_paged's jnp branch, extended to T query rows (see
@@ -429,11 +447,12 @@ def _attend_multitoken_paged(cfg, h, q, k_pool_l, v_pool_l,
     scores = jnp.where(mask[:, None, :, :], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(vd.dtype)
     o = jnp.einsum("bhst,bthd->bshd", probs, vd)
-    return o.reshape(B, T, E).astype(h.dtype)
+    # H*D == E at TP=1; the per-rank head slice under the TP shard_map
+    return o.reshape(B, T, H * D).astype(h.dtype)
 
 
 def _attention_verify_paged(cfg, lp, h, k_pool, v_pool, block_tables,
-                            base, pidx, poff, l, scales=None):
+                            base, pidx, poff, l, scales=None, tp_axis=None):
     """T-token attention per slot: scatter every token's K/V to layer ``l``
     at (``pidx[b,t]``, ``poff[b,t]``), then attend query t at position
     ``base + t`` through the block table. Out-of-budget positions arrive
@@ -488,7 +507,7 @@ def _attention_verify_paged(cfg, lp, h, k_pool, v_pool, block_tables,
         axis=1,
     )
     return (
-        o @ _deq(lp["c_proj_w"], h.dtype) + lp["c_proj_b"],
+        _proj(o, lp["c_proj_w"], lp["c_proj_b"], h.dtype, tp_axis),
         k_pool, v_pool, scales,
     )
 
@@ -519,6 +538,7 @@ def paged_verify_step(
     v_pool: jnp.ndarray,
     block_tables: jnp.ndarray,  # [B, W] i32
     scales: jnp.ndarray = None,  # [L, P, KV, 2] when the pool is int8
+    tp_axis: str = None,  # named mesh axis under the TP shard_map (ISSUE 14)
 ):
     """Self-speculative verify (ISSUE 10): score T = k+1 tokens per slot in
     one forward pass → (k_pool, v_pool, greedy [B, T]); ``scales`` threaded
@@ -550,12 +570,13 @@ def paged_verify_step(
             cfg, lp["attn"],
             _layer_norm(h, lp["ln_1"]["scale"], lp["ln_1"]["bias"], eps),
             k_pool, v_pool, block_tables, seq_lens, pidx, poff, l, scales,
+            tp_axis,
         )
         h = h + a
         m, _aux = _mlp(
             cfg, lp["mlp"],
             _layer_norm(h, lp["ln_2"]["scale"], lp["ln_2"]["bias"], eps),
-            False, None,
+            False, None, tp_axis=tp_axis,
         )
         h = h + m
 
@@ -582,6 +603,7 @@ def paged_chunk_prefill(
     top_k: int = 0,
     top_p: float = 1.0,
     scales: jnp.ndarray = None,  # [L, P, KV, 2] when the pool is int8
+    tp_axis: str = None,  # named mesh axis under the TP shard_map (ISSUE 14)
 ):
     """One chunk of an incremental prefill (ISSUE 10) → (k_pool, v_pool,
     token [1]); ``scales`` threaded and returned before the token when the
@@ -631,12 +653,13 @@ def paged_chunk_prefill(
             cfg, hn, q, k_pool[l], v_pool[l], block_tables, base,
             scales[l] if scales is not None else None,
         )
-        a = o @ _deq(lp["attn"]["c_proj_w"], hn.dtype) + lp["attn"]["c_proj_b"]
+        a = _proj(o, lp["attn"]["c_proj_w"], lp["attn"]["c_proj_b"],
+                  hn.dtype, tp_axis)
         h = h + a
         m, _aux = _mlp(
             cfg, lp["mlp"],
             _layer_norm(h, lp["ln_2"]["scale"], lp["ln_2"]["bias"], eps),
-            False, None,
+            False, None, tp_axis=tp_axis,
         )
         h = h + m
 

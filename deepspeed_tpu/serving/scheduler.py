@@ -75,14 +75,13 @@ from ..telemetry.request_trace import LATENCY_BUCKETS, RequestTracer
 from ..utils.logging import log_dist
 from . import model as smodel
 from .kv_cache import (
-    PageAllocator,
     PrefixCache,
     SlotTable,
-    init_pools,
     pages_for,
     pool_bytes,
     scales_bytes,
 )
+from .placement import Placement, ProgramSet
 from .request import Request, RequestStatus
 
 # TTFT/TPOT/queue-wait histogram buckets (seconds): sub-ms CPU-sim steps
@@ -135,6 +134,14 @@ class _Slot:
     prefill_pos: int = 0               # prompt tokens prefilled so far
     row: Optional[np.ndarray] = None   # [1, pages_per_slot] real block table
     shared_pages: int = 0              # leading row entries mapped from the index
+    # -- ISSUE 14: disaggregated placements ----------------------------
+    # prompt pages on the PREFILL placement's pool (shared + private);
+    # freed right after the gather→scatter handoff into ``pages``
+    prefill_pages: List[int] = field(default_factory=list)
+    # the in-flight first-token device array of a dispatched prefill —
+    # the decode placement polls ``.is_ready()`` instead of blocking, so
+    # decode batches never wait on another core-set's prefill compute
+    pending_tok: Optional[Any] = None
 
 
 class ServingEngine:
@@ -205,28 +212,88 @@ class ServingEngine:
                 f"exceeds the model's n_positions={mcfg.n_positions}"
             )
         self.pages_per_slot = pages_for(self.max_total_len, page)
-        self.allocator = PageAllocator(int(config.num_pages))
-        if self.pages_per_slot > self.allocator.capacity:
-            raise ValueError(
-                f"serving.num_pages={config.num_pages} cannot hold even one "
-                f"max-size request ({self.pages_per_slot} pages of {page} "
-                "tokens; page 0 is scratch)"
-            )
 
         self.cache_dtype = (
             jnp.dtype(config.kv_cache_dtype).type if config.kv_cache_dtype
             else engine.dtype
         )
         self.max_slots = int(config.max_slots)
+
+        # -- ISSUE 14: placements + program sets ---------------------------
+        # Every program compiles FOR a placement (mesh slice + spec table);
+        # each placement owns its pools, allocator and placed params as one
+        # ProgramSet. Default: one shared single-device placement — the
+        # pre-ISSUE-14 engine, byte-for-byte.
+        plc = getattr(config, "placement", None)
+        tp = int(getattr(plc, "tp", 1) or 1) if plc is not None else 1
+        self.disaggregated = bool(getattr(plc, "disaggregate", False)) if plc is not None else False
+        decode_tp = (int(getattr(plc, "decode_tp", 0) or 0) or tp) if plc is not None else tp
+        prefill_tp = (int(getattr(plc, "prefill_tp", 0) or 0) or tp) if plc is not None else tp
+        if not self.disaggregated:
+            decode_tp = prefill_tp = tp
+        self.tp = tp
+        if max(decode_tp, prefill_tp) > 1 and getattr(engine, "quantized", False):
+            raise ValueError(
+                "serving.placement.tp > 1 requires unquantized weights (the "
+                "rank-major QKV permute operates on the plain injected tree); "
+                "int8 KV pages (serving.kv_cache_dtype) shard fine"
+            )
+        devices = jax.devices()
+        n_dev = decode_tp + (prefill_tp if self.disaggregated else 0)
+        if n_dev > len(devices):
+            raise ValueError(
+                f"serving.placement needs {n_dev} devices "
+                f"(decode_tp={decode_tp}"
+                + (f" + prefill_tp={prefill_tp}" if self.disaggregated else "")
+                + f"), only {len(devices)} visible"
+            )
+        self.decode_placement = Placement(
+            "decode" if self.disaggregated else "shared",
+            devices[:decode_tp], decode_tp,
+        )
+        self.decode_placement.local_model_config(mcfg)  # fail fast on divisibility
         # int8 KV pages (ISSUE 12): pools store codes, kv_scales carries the
         # per-(layer, page, kv-head) block scales beside them — every page-id
         # mechanism (refcounted sharing, COW fork, prefix eviction) moves the
-        # scale with the page for free
-        self.k_pool, self.v_pool, self.kv_scales = init_pools(
-            mcfg.n_layer, int(config.num_pages), mcfg.n_head, page,
-            mcfg.head_dim, dtype=self.cache_dtype,
+        # scale with the page for free. At tp > 1 the pools (and scales)
+        # shard 1/tp over the KV-head axis; page ids stay global.
+        self.decode_set = ProgramSet(
+            self.decode_placement, mcfg, int(config.num_pages), page,
+            self.cache_dtype, engine.params,
         )
-        self.quantized = self.kv_scales is not None
+        if self.disaggregated:
+            # the prefill pool only ever holds PROMPT pages (decode-side
+            # reservations are always private copies): auto-size it to
+            # max_slots concurrent prompts + prefix-index headroom + scratch
+            pnp = int(getattr(plc, "prefill_num_pages", 0) or 0)
+            if pnp <= 0:
+                pnp = min(
+                    int(config.num_pages),
+                    2 * self.max_slots * self.prefill_pages + 1,
+                )
+            self.prefill_placement = Placement(
+                "prefill", devices[decode_tp:decode_tp + prefill_tp], prefill_tp,
+            )
+            self.prefill_placement.local_model_config(mcfg)
+            self.prefill_set = ProgramSet(
+                self.prefill_placement, mcfg, pnp, page,
+                self.cache_dtype, engine.params,
+            )
+        else:
+            self.prefill_placement = self.decode_placement
+            self.prefill_set = self.decode_set
+        self.quantized = self.decode_set.quantized
+        if self.pages_per_slot > self.decode_set.allocator.capacity:
+            raise ValueError(
+                f"serving.num_pages={config.num_pages} cannot hold even one "
+                f"max-size request ({self.pages_per_slot} pages of {page} "
+                "tokens; page 0 is scratch)"
+            )
+        if self.disaggregated and self.prefill_pages > self.prefill_set.allocator.capacity:
+            raise ValueError(
+                f"serving.placement.prefill_num_pages={self.prefill_set.num_pages} "
+                f"cannot hold one max-size prompt ({self.prefill_pages} pages)"
+            )
         self.table = SlotTable(self.max_slots, self.pages_per_slot)
         self.slots: List[_Slot] = [_Slot() for _ in range(self.max_slots)]
         self.queue: Deque[Request] = deque()
@@ -259,8 +326,12 @@ class ServingEngine:
             )
         pcfg = getattr(config, "prefix_cache", None)
         self.prefix_enabled = bool(pcfg and pcfg.enabled)
+        # the prefix index lives beside the pool prefill WRITES: under
+        # disaggregation that is the prefill placement's pool — the chunk
+        # program attends shared pages there, and decode-side pages are
+        # always private copies (COW never triggers on the decode pool)
         self.prefix_cache: Optional[PrefixCache] = (
-            PrefixCache(self.allocator, page,
+            PrefixCache(self.prefill_set.allocator, page,
                         max_pages=int(pcfg.max_pages) if pcfg else 0)
             if self.prefix_enabled else None
         )
@@ -397,6 +468,28 @@ class ServingEngine:
             "serving_tenant_tokens_total", "generated tokens by tenant",
             labelnames=("tenant",),
         )
+        # -- ISSUE 14: TP sharding + disaggregation instruments ------------
+        self._g_tp_coll = m.gauge(
+            "serving_tp_collective_bytes",
+            "per-invocation all-reduce payload of a TP-sharded serving "
+            "program (2 psums/layer over the [batch, width, n_embd] partial "
+            "products; 0 = program not TP-sharded)",
+            labelnames=("program",),
+        )
+        self._c_handoffs = m.counter(
+            "serving_kv_handoffs_total",
+            "prefill→decode KV page handoffs (disaggregated placements)",
+        )
+        self._c_handoff_bytes = m.counter(
+            "serving_kv_handoff_bytes_total",
+            "logical KV bytes moved prefill→decode by page handoffs",
+        )
+        self._h_handoff = m.histogram(
+            "serving_kv_handoff_seconds",
+            "one gather → device_put → scatter KV handoff, dispatch to "
+            "installed",
+            buckets=LATENCY_BUCKETS,
+        )
         # anomaly watchdog (ISSUE 5): shared with the owning engine's
         # telemetry when present — straggler trips land in the same trace
         self.watchdog = (
@@ -410,7 +503,12 @@ class ServingEngine:
         self._decode_exec = None
         self._verify_exec = None
         self._chunk_exec = None
+        self._gather_exec = None
+        self._scatter_exec = None
         self.executables: List[Any] = []
+        # program name -> {"exe", "pset", "kind"} (built by _ensure_compiled;
+        # verify() derives per-program local shapes and aliasing from it)
+        self._program_info: dict = {}
         log_dist(
             f"ServingEngine: slots={self.max_slots} page={page} "
             f"pages={config.num_pages} (pool "
@@ -421,17 +519,46 @@ class ServingEngine:
             )
             + f") prefill_width={self.prefill_width} dtype={np.dtype(self.cache_dtype).name} "
             f"spec_k={self.spec_k if self.spec_enabled else 0} "
-            f"prefix_cache={self.prefix_enabled} chunk={self.chunk_width}"
+            f"prefix_cache={self.prefix_enabled} chunk={self.chunk_width} "
+            f"tp={self.tp}"
+            + (
+                f" disaggregated(prefill={self.prefill_placement!r}, "
+                f"decode={self.decode_placement!r}, "
+                f"prefill_pages={self.prefill_set.num_pages})"
+                if self.disaggregated else ""
+            )
         )
+
+    # -- back-compat pool/allocator views (the decode placement owns the
+    # main pool; pre-ISSUE-14 callers and tests read these directly) -------
+    @property
+    def k_pool(self):
+        return self.decode_set.k_pool
+
+    @property
+    def v_pool(self):
+        return self.decode_set.v_pool
+
+    @property
+    def kv_scales(self):
+        return self.decode_set.kv_scales
+
+    @property
+    def allocator(self):
+        return self.decode_set.allocator
 
     @property
     def expected_executables(self) -> int:
         """The static-shapes contract (Engine A ``exact`` budget): one
         prefill program, ONE decode-shaped program (the speculative verify
-        step REPLACES the plain decode step when enabled — never both), and
-        the chunk-prefill program when chunking or the prefix cache needs
-        it."""
-        return 2 + (1 if self.chunk_width > 0 else 0)
+        step REPLACES the plain decode step when enabled — never both), the
+        chunk-prefill program when chunking or the prefix cache needs it,
+        and — under disaggregated placements (ISSUE 14) — the KV-handoff
+        gather + scatter pair."""
+        return (
+            2 + (1 if self.chunk_width > 0 else 0)
+            + (2 if self.disaggregated else 0)
+        )
 
     # ------------------------------------------------------------------
     # compilation: a fixed feature-derived program set, ahead-of-time
@@ -439,104 +566,239 @@ class ServingEngine:
     def _ensure_compiled(self) -> None:
         if self._prefill_exec is not None:
             return
-        cfg = self.model_config
         sc = self.config
-        temp, tk, tp = float(sc.temperature), int(sc.top_k), float(sc.top_p)
+        temp, tk, top_p = float(sc.temperature), int(sc.top_k), float(sc.top_p)
         quant = self.quantized
+        S = jax.ShapeDtypeStruct
+        i32, u32 = jnp.int32, jnp.uint32
+        donate = (1, 2, 3) if quant else (1, 2)
 
         # int8 pools (ISSUE 12) thread the scales pool as one more donated
         # operand through every program; the wrappers keep the operand order
         # (params, k_pool, v_pool[, scales], ...static tables...) so the
-        # step loop below stays mode-agnostic apart from the scales slot
-        def prefill_fn(params, k_pool, v_pool, *rest):
-            scales, (ids, plen, page_ids, key) = _split_scales(rest, quant)
-            return smodel.paged_prefill(
-                cfg, params, ids, plen, k_pool, v_pool, page_ids, key,
-                temperature=temp, top_k=tk, top_p=tp, scales=scales,
-            )
+        # step loop below stays mode-agnostic apart from the scales slot.
+        # Each program is built FOR a placement (ISSUE 14): it traces with
+        # that placement's LOCAL model config (n_embd/n_head divided by tp)
+        # and psums its row-parallel partials over the tp axis.
+        def make_fns(cfg, tp_axis):
+            def prefill_fn(params, k_pool, v_pool, *rest):
+                scales, (ids, plen, page_ids, key) = _split_scales(rest, quant)
+                return smodel.paged_prefill(
+                    cfg, params, ids, plen, k_pool, v_pool, page_ids, key,
+                    temperature=temp, top_k=tk, top_p=top_p, scales=scales,
+                    tp_axis=tp_axis,
+                )
 
-        def decode_fn(params, k_pool, v_pool, *rest):
-            scales, (tokens, seq_lens, bt, keys) = _split_scales(rest, quant)
-            return smodel.paged_decode_step(
-                cfg, params, tokens, seq_lens, k_pool, v_pool, bt, keys,
-                temperature=temp, top_k=tk, top_p=tp, scales=scales,
-            )
+            def decode_fn(params, k_pool, v_pool, *rest):
+                scales, (tokens, seq_lens, bt, keys) = _split_scales(rest, quant)
+                return smodel.paged_decode_step(
+                    cfg, params, tokens, seq_lens, k_pool, v_pool, bt, keys,
+                    temperature=temp, top_k=tk, top_p=top_p, scales=scales,
+                    tp_axis=tp_axis,
+                )
 
-        def verify_fn(params, k_pool, v_pool, *rest):
-            scales, (tokens, seq_lens, bt) = _split_scales(rest, quant)
-            return smodel.paged_verify_step(
-                cfg, params, tokens, seq_lens, k_pool, v_pool, bt,
-                scales=scales,
-            )
+            def verify_fn(params, k_pool, v_pool, *rest):
+                scales, (tokens, seq_lens, bt) = _split_scales(rest, quant)
+                return smodel.paged_verify_step(
+                    cfg, params, tokens, seq_lens, k_pool, v_pool, bt,
+                    scales=scales, tp_axis=tp_axis,
+                )
 
-        def chunk_fn(params, k_pool, v_pool, *rest):
-            scales, (ids, start, plen, page_ids, bt_row, key) = _split_scales(
-                rest, quant
-            )
-            return smodel.paged_chunk_prefill(
-                cfg, params, ids, start, plen, k_pool, v_pool, page_ids,
-                bt_row, key, temperature=temp, top_k=tk, top_p=tp,
-                scales=scales,
-            )
+            def chunk_fn(params, k_pool, v_pool, *rest):
+                scales, (ids, start, plen, page_ids, bt_row, key) = _split_scales(
+                    rest, quant
+                )
+                return smodel.paged_chunk_prefill(
+                    cfg, params, ids, start, plen, k_pool, v_pool, page_ids,
+                    bt_row, key, temperature=temp, top_k=tk, top_p=top_p,
+                    scales=scales, tp_axis=tp_axis,
+                )
 
-        S = jax.ShapeDtypeStruct
-        i32, u32 = jnp.int32, jnp.uint32
-        donate = (1, 2, 3) if quant else (1, 2)
-        pools = (self.k_pool, self.v_pool) + (
-            (self.kv_scales,) if quant else ()
-        )
+            return prefill_fn, decode_fn, verify_fn, chunk_fn
+
         # AOT: lower + compile ONCE with the config-derived static shapes;
         # the compiled objects reject any other shape, enforcing the
         # executable-count contract structurally (pools — and the scales
-        # pool under int8 — are donated: the cache never exists twice). The
-        # verify step REPLACES the decode step when speculation is on:
-        # exactly one decode-shaped program ever advances the batch.
-        self._prefill_exec = jax.jit(prefill_fn, donate_argnums=donate).lower(
-            self.engine.params, *pools,
+        # pool under int8 — are donated: the cache never exists twice,
+        # per device). At tp > 1 the function body runs under shard_map:
+        # pools/params enter with their placement specs, host operands
+        # replicate, and donation threads through the outer jit so XLA
+        # aliases the per-device pool shards.
+        def compile_for(pset, fn, host_sds, donate_pools=True):
+            plc = pset.placement
+            pools = pset.pool_args()
+            args = (pset.params,) + pools + tuple(host_sds)
+            dn = donate if donate_pools else ()
+            if plc.mesh is None:
+                return plc.aot(fn, args, (), (), dn)
+            in_specs = (
+                (pset.param_specs,)
+                + tuple(plc.pool_spec(p.ndim) for p in pools)
+                + tuple(plc.rep_spec() for _ in host_sds)
+            )
+            out_specs = (
+                tuple(plc.pool_spec(p.ndim) for p in pools)
+                + (plc.rep_spec(),)
+            )
+            return plc.aot(fn, args, in_specs, out_specs, dn)
+
+        d_cfg = self.decode_placement.local_model_config(self.model_config)
+        p_cfg = self.prefill_placement.local_model_config(self.model_config)
+        p_fns = make_fns(p_cfg, self.prefill_placement.tp_axis)
+        d_fns = (
+            p_fns if self.prefill_placement is self.decode_placement
+            else make_fns(d_cfg, self.decode_placement.tp_axis)
+        )
+        sfx = "_int8" if quant else ""
+        info: dict = {}
+
+        self._prefill_exec = compile_for(self.prefill_set, p_fns[0], (
             S((1, self.prefill_width), i32), S((), i32),
             S((self.prefill_pages,), i32), S((2,), u32),
-        ).compile()
+        ))
+        info[f"serving_prefill{sfx}{self.prefill_placement.suffix()}"] = {
+            "exe": self._prefill_exec, "pset": self.prefill_set,
+            "kind": "prefill",
+        }
         self.executables = [self._prefill_exec]
+        # the verify step REPLACES the decode step when speculation is on:
+        # exactly one decode-shaped program ever advances the batch
         if self.spec_enabled:
-            self._verify_exec = jax.jit(verify_fn, donate_argnums=donate).lower(
-                self.engine.params, *pools,
+            self._verify_exec = compile_for(self.decode_set, d_fns[2], (
                 S((self.max_slots, self.spec_k + 1), i32),
                 S((self.max_slots,), i32),
                 S((self.max_slots, self.pages_per_slot), i32),
-            ).compile()
+            ))
+            info[f"serving_verify{sfx}{self.decode_placement.suffix()}"] = {
+                "exe": self._verify_exec, "pset": self.decode_set,
+                "kind": "verify",
+            }
             self.executables.append(self._verify_exec)
         else:
-            self._decode_exec = jax.jit(decode_fn, donate_argnums=donate).lower(
-                self.engine.params, *pools,
+            self._decode_exec = compile_for(self.decode_set, d_fns[1], (
                 S((self.max_slots,), i32), S((self.max_slots,), i32),
                 S((self.max_slots, self.pages_per_slot), i32),
                 S((self.max_slots, 2), u32),
-            ).compile()
+            ))
+            info[f"serving_decode{sfx}{self.decode_placement.suffix()}"] = {
+                "exe": self._decode_exec, "pset": self.decode_set,
+                "kind": "decode",
+            }
             self.executables.append(self._decode_exec)
         if self.chunk_width > 0:
-            self._chunk_exec = jax.jit(chunk_fn, donate_argnums=donate).lower(
-                self.engine.params, *pools,
+            self._chunk_exec = compile_for(self.prefill_set, p_fns[3], (
                 S((1, self.chunk_width), i32), S((), i32), S((), i32),
                 S((self.chunk_width // self.page_size,), i32),
                 S((1, self.pages_per_slot), i32), S((2,), u32),
-            ).compile()
+            ))
+            info[f"serving_chunk_prefill{sfx}{self.prefill_placement.suffix()}"] = {
+                "exe": self._chunk_exec, "pset": self.prefill_set,
+                "kind": "chunk",
+            }
             self.executables.append(self._chunk_exec)
 
-    def _pool_args(self) -> tuple:
-        """The donated pool operands in program order (scales ride along
-        under int8)."""
-        if self.quantized:
-            return (self.k_pool, self.v_pool, self.kv_scales)
-        return (self.k_pool, self.v_pool)
+        if self.disaggregated:
+            self._compile_handoff(info, quant, S, i32)
 
-    def _take_pools(self, out: tuple):
-        """Re-home a program's donated outputs; → the program's result
-        (sampled tokens / greedy batch)."""
-        if self.quantized:
-            self.k_pool, self.v_pool, self.kv_scales = out[0], out[1], out[2]
-            return out[3]
-        self.k_pool, self.v_pool = out[0], out[1]
-        return out[2]
+        self._program_info = info
+        self._set_collective_gauges()
+
+    def _compile_handoff(self, info: dict, quant: bool, S, i32) -> None:
+        """The disaggregated KV handoff pair (ISSUE 14): ``gather`` packs a
+        finished prompt's pages out of the prefill pool ([L, n, KV, page, D]
+        per pool, scales ride along under int8); the packed buffers cross
+        placements via ``jax.device_put``; ``scatter`` writes them into the
+        decode pool's pages (pools donated — the decode cache never exists
+        twice). Page-id lists are scratch-padded to the static
+        ``prefill_pages`` width, so the pair compiles once; duplicate pad
+        indices all target scratch page 0, which no active slot reads."""
+        n_hp = self.prefill_pages
+
+        def gather_fn(k_pool, v_pool, *rest):
+            scales, (src,) = _split_scales(rest, quant)
+            out = (k_pool[:, src], v_pool[:, src])
+            if scales is not None:
+                out = out + (scales[:, src],)
+            return out
+
+        def scatter_fn(k_pool, v_pool, *rest):
+            scales, packed = _split_scales(rest, quant)
+            if quant:
+                pk, pv, ps, dst = packed
+            else:
+                pk, pv, dst = packed
+            k_pool = k_pool.at[:, dst].set(pk)
+            v_pool = v_pool.at[:, dst].set(pv)
+            if quant:
+                return k_pool, v_pool, scales.at[:, dst].set(ps)
+            return k_pool, v_pool
+
+        sfx = "_int8" if quant else ""
+        pp, dp = self.prefill_placement, self.decode_placement
+        pset, dset = self.prefill_set, self.decode_set
+        src_sds = S((n_hp,), i32)
+
+        # gather: prefill pools are READ, not donated — the prompt pages
+        # stay live for the prefix index until the host frees them
+        g_pools = pset.pool_args()
+        g_args = g_pools + (src_sds,)
+        if pp.mesh is None:
+            self._gather_exec = pp.aot(gather_fn, g_args, (), (), ())
+        else:
+            self._gather_exec = pp.aot(
+                gather_fn, g_args,
+                tuple(pp.pool_spec(p.ndim) for p in g_pools) + (pp.rep_spec(),),
+                tuple(pp.pool_spec(p.ndim) for p in g_pools), (),
+            )
+        info[f"serving_kv_gather{sfx}{pp.suffix()}"] = {
+            "exe": self._gather_exec, "pset": pset, "kind": "gather",
+        }
+        self.executables.append(self._gather_exec)
+
+        # scatter: decode pools donated (args 0..n_pool-1 — no params slot)
+        d_pools = dset.pool_args()
+        packed_sds = tuple(
+            S((p.shape[0], n_hp) + tuple(p.shape[2:]), p.dtype)
+            for p in d_pools
+        )
+        s_args = d_pools + packed_sds + (src_sds,)
+        s_donate = tuple(range(len(d_pools)))
+        if dp.mesh is None:
+            self._scatter_exec = dp.aot(scatter_fn, s_args, (), (), s_donate)
+        else:
+            pool_specs = tuple(dp.pool_spec(p.ndim) for p in d_pools)
+            self._scatter_exec = dp.aot(
+                scatter_fn, s_args,
+                pool_specs + pool_specs + (dp.rep_spec(),),
+                pool_specs, s_donate,
+            )
+        info[f"serving_kv_scatter{sfx}{dp.suffix()}"] = {
+            "exe": self._scatter_exec, "pset": dset, "kind": "scatter",
+        }
+        self.executables.append(self._scatter_exec)
+
+    def _set_collective_gauges(self) -> None:
+        """Static per-invocation all-reduce payload of each TP program: the
+        head-parallel design psums the [B, width, n_embd] partial product
+        twice per layer (attention out-proj + MLP down-proj), identically
+        in every program — the analytical truth Engine D's order check
+        verifies structurally."""
+        mc = self.model_config
+        it = np.dtype(self.engine.dtype).itemsize
+        widths = {
+            "prefill": (1, self.prefill_width),
+            "decode": (self.max_slots, 1),
+            "verify": (self.max_slots, self.spec_k + 1),
+            "chunk": (1, self.chunk_width),
+        }
+        for name, rec in self._program_info.items():
+            bs = widths.get(rec["kind"])
+            tp_n = rec["pset"].placement.tp
+            nbytes = (
+                2 * mc.n_layer * bs[0] * bs[1] * mc.n_embd * it
+                if bs is not None and tp_n > 1 else 0
+            )
+            self._g_tp_coll.set(nbytes, program=name)
 
     # ------------------------------------------------------------------
     # admission control
@@ -697,16 +959,30 @@ class ServingEngine:
             if idx is None:
                 break
             req = self.queue[idx]
+            # under disaggregation BOTH placements gate admission: the
+            # decode pool must hold the full private reservation, the
+            # prefill pool the prompt pages net of prefix hits. The index
+            # holds prefill-side pages, so eviction only relieves that side.
             need = self._pages_needed(req)
-            if need > self.allocator.free_pages:
+            p_alloc = self.prefill_set.allocator
+            p_need = (
+                self._prefill_pages_needed(req) if self.disaggregated else need
+            )
+            if need > self.allocator.free_pages or (
+                self.disaggregated and p_need > p_alloc.free_pages
+            ):
                 if self.prefix_cache is not None and len(self.prefix_cache):
-                    self.prefix_cache.evict(need_free=need)
+                    self.prefix_cache.evict(need_free=p_need)
                     self._g_index_pages.set(len(self.prefix_cache))
                     # eviction may have dropped the very pages the probe
                     # counted as mappable — recompute, or _admit could
                     # allocate past the pool
                     need = self._pages_needed(req)
-                if need > self.allocator.free_pages:
+                    if self.disaggregated:
+                        p_need = self._prefill_pages_needed(req)
+                if need > self.allocator.free_pages or (
+                    self.disaggregated and p_need > p_alloc.free_pages
+                ):
                     if self.tracer is not None:
                         self.tracer.note_wait(req, "page_budget")
                     break
@@ -716,10 +992,38 @@ class ServingEngine:
         # 2b. chunked prefill (ISSUE 10): every PREFILLING slot advances ONE
         # chunk, then the decode batch below still runs — a long prompt pays
         # out its prefill across steps instead of stalling co-resident
-        # decodes for its whole width
+        # decodes for its whole width. A slot whose first token is already
+        # in flight (pending_tok) is past its last chunk — it waits on the
+        # handoff phase below, not on more chunks.
         for i, slot in enumerate(self.slots):
-            if slot.request is not None and slot.prefilling:
+            if (
+                slot.request is not None and slot.prefilling
+                and slot.pending_tok is None
+            ):
                 self._advance_chunk(i)
+
+        # 2c. disaggregated handoff completion (ISSUE 14): a slot whose
+        # prefill placement has sampled the first token moves its prompt KV
+        # into the decode pool and joins the decode batch. Readiness is
+        # polled (is_ready) so a long prefill never stalls the decode
+        # batch below — UNLESS nothing is decoding, in which case blocking
+        # is free and avoids spinning run()'s step budget dry.
+        if self.disaggregated:
+            pend = [
+                i for i, s in enumerate(self.slots)
+                if s.request is not None and s.pending_tok is not None
+            ]
+            if pend:
+                force = not any(
+                    s.request is not None and not s.prefilling
+                    for s in self.slots
+                )
+                for i in pend:
+                    arr = self.slots[i].pending_tok
+                    ready = getattr(arr, "is_ready", None)
+                    if force or ready is None or ready():
+                        self._complete_handoff(i)
+                        force = False  # a decode-active slot now exists
 
         # 3. one batched decode (or speculative verify) step for every slot
         # that is past prefill
@@ -741,15 +1045,17 @@ class ServingEngine:
                     d = self._draft(self.slots[i].request)
                     drafts[i] = d
                     vt[i, 1:] = d
-                out = self._take_pools(self._verify_exec(
-                    self.engine.params, *self._pool_args(),
+                dset = self.decode_set
+                out = dset.take_pools(self._verify_exec(
+                    dset.params, *dset.pool_args(),
                     vt, self.table.seq_lens, self.table.block_tables,
                 ))
                 self._c_spec_steps.inc()
                 self._c_spec_drafted.inc(self.spec_k * len(active))
             else:
-                out = self._take_pools(self._decode_exec(
-                    self.engine.params, *self._pool_args(),
+                dset = self.decode_set
+                out = dset.take_pools(self._decode_exec(
+                    dset.params, *dset.pool_args(),
                     self.table.tokens, self.table.seq_lens,
                     self.table.block_tables, self.table.keys,
                 ))
@@ -855,14 +1161,25 @@ class ServingEngine:
         return n_active
 
     def _pages_needed(self, req: Request) -> int:
-        """Net new pages an admission must allocate: the request's full
-        reservation minus pages the prefix index can map (non-counting
-        probe — the admission gate runs this every step while a request
-        heads the queue)."""
+        """Net new DECODE-pool pages an admission must allocate: the
+        request's full reservation minus pages the prefix index can map
+        (non-counting probe — the admission gate runs this every step while
+        a request heads the queue). Under disaggregation the decode
+        reservation is ALL private (shared prompt KV is scattered into it
+        by the handoff), so nothing nets out."""
         total = pages_for(req.prompt_len + req.max_new_tokens, self.page_size)
-        if self.prefix_cache is None:
+        if self.prefix_cache is None or self.disaggregated:
             return total
         return total - self.prefix_cache.probe(req.prompt)
+
+    def _prefill_pages_needed(self, req: Request) -> int:
+        """Prefill-pool pages a disaggregated admission must allocate: the
+        PROMPT's pages net of prefix-index hits (the index lives on the
+        prefill placement — that is where admissions compute)."""
+        pp = pages_for(req.prompt_len, self.page_size)
+        if self.prefix_cache is None:
+            return pp
+        return pp - self.prefix_cache.probe(req.prompt)
 
     def _draft(self, req: Request) -> np.ndarray:
         """Host-side prompt-lookup draft (ISSUE 10): the continuation of the
@@ -960,16 +1277,32 @@ class ServingEngine:
                     (pc.hits_full + pc.hits_partial) / lookups
                 )
             if shared:
-                self.allocator.retain(shared)
+                # refcounts live with the pool that holds the pages: the
+                # prefill allocator under disaggregation (aliases the
+                # decode allocator in shared mode)
+                self.prefill_set.allocator.retain(shared)
                 self._c_pages_reused.inc(len(shared))
             if cow_page is not None:
-                self.allocator.cow_forks_total += 1
+                self.prefill_set.allocator.cow_forks_total += 1
                 self._c_cow.inc()
-        priv = self.allocator.alloc(total - len(shared))
-        pages = shared + priv
+        if self.disaggregated:
+            # two reservations: prompt pages on the prefill placement
+            # (shared + private — the handoff reads and then frees the
+            # private ones), the FULL reservation as private pages on the
+            # decode placement (the handoff scatters the prompt KV in)
+            p_priv = self.prefill_set.allocator.alloc(
+                pages_for(req.prompt_len, page) - len(shared)
+            )
+            prefill_pages = shared + p_priv
+            pages = self.allocator.alloc(total)
+        else:
+            prefill_pages = []
+            pages = shared + self.allocator.alloc(total - len(shared))
         slot = self.slots[slot_i]
         slot.request = req
         slot.pages = pages
+        slot.prefill_pages = prefill_pages
+        slot.pending_tok = None
         slot.pos = 0
         slot.step = 0
         slot.keys = None
@@ -999,24 +1332,52 @@ class ServingEngine:
             # chunked tail prefill: the real block table lives on the slot;
             # the main table row stays scratch so the batched decode's
             # rides-along write for this slot cannot touch real (possibly
-            # shared) pages mid-prefill
+            # shared) pages mid-prefill. Under disaggregation the chunk
+            # program runs on the PREFILL placement, so the row addresses
+            # the prefill pool's pages.
             row = np.full((1, self.pages_per_slot), 0, np.int32)
-            row[0, : len(pages)] = pages
+            src = prefill_pages if self.disaggregated else pages
+            row[0, : len(src)] = src
             slot.row = row
             slot.prefilling = True
             slot.prefill_pos = shared_tokens
             req.status = RequestStatus.RUNNING
             return
 
-        self.table.assign(slot_i, pages)
         ids = np.zeros((1, self.prefill_width), np.int32)
         ids[0, : req.prompt_len] = req.prompt
-        page_ids = self.table.block_tables[slot_i, : self.prefill_pages]
         # host-built key + plain numpy operands: the compiled prefill does
         # its own device_put, so admission dispatches exactly one program
         key0 = _host_prng_key(req.seed)
-        first = self._take_pools(self._prefill_exec(
-            self.engine.params, *self._pool_args(),
+        pset = self.prefill_set
+        if self.disaggregated:
+            # whole prefill on the PREFILL placement: page ids address the
+            # prefill pool, and the sampled first token stays ON DEVICE
+            # (slot.pending_tok) — admission never blocks the decode batch;
+            # step phase 2c syncs it and completes the handoff
+            page_ids = np.zeros((self.prefill_pages,), np.int32)
+            page_ids[: len(prefill_pages)] = prefill_pages
+            first = pset.take_pools(self._prefill_exec(
+                pset.params, *pset.pool_args(),
+                ids, np.asarray(req.prompt_len, np.int32), page_ids, key0,
+            ))
+            self._c_prefills.inc()
+            slot.pending_tok = first
+            slot.prefilling = True
+            slot.prefill_pos = req.prompt_len
+            req.status = RequestStatus.RUNNING
+            if self.tracer is not None:
+                self.tracer.event(
+                    req, "prefill", self.clock(), step=self._step_count,
+                    slot=slot_i, width=self.prefill_width,
+                    prompt_len=req.prompt_len,
+                )
+            return
+
+        self.table.assign(slot_i, pages)
+        page_ids = self.table.block_tables[slot_i, : self.prefill_pages]
+        first = pset.take_pools(self._prefill_exec(
+            pset.params, *pset.pool_args(),
             ids, np.asarray(req.prompt_len, np.int32), page_ids, key0,
         ))
         self._c_prefills.inc()
@@ -1049,8 +1410,9 @@ class ServingEngine:
         avail = slot.row[0, p0: p0 + n_cp]
         page_ids[: len(avail)] = avail
         key0 = _host_prng_key(req.seed)
-        tok = self._take_pools(self._chunk_exec(
-            self.engine.params, *self._pool_args(),
+        pset = self.prefill_set
+        tok = pset.take_pools(self._chunk_exec(
+            pset.params, *pset.pool_args(),
             ids, np.asarray(start, np.int32),
             np.asarray(req.prompt_len, np.int32), page_ids, slot.row, key0,
         ))
@@ -1065,9 +1427,80 @@ class ServingEngine:
         if slot.prefill_pos < req.prompt_len:
             return  # more chunks; the decode batch advances meanwhile
         self._c_prefills.inc()
+        if self.disaggregated:
+            # the final chunk's sample stays on device; step phase 2c syncs
+            # it and hands the prompt KV off to the decode placement
+            slot.pending_tok = tok
+            return
         # deliberate sync, as in _admit: the final chunk's sample is the
         # request's first token
         tok0 = int(jax.device_get(tok)[0])  # dslint: disable=host-sync-in-step
+        self._start_decoding(slot_i, tok0)
+
+    def _complete_handoff(self, slot_i: int) -> None:
+        """Finish a disaggregated prefill (ISSUE 14): read the pending first
+        token, move the prompt KV from the prefill placement's pool into
+        the slot's private decode-pool reservation (gather on the prefill
+        mesh → ``device_put`` across placements → scatter donating the
+        decode pools), register the prompt in the prefix index (PREFILL-side
+        pages — the index serves admissions, which compute there), free the
+        prefill-side private pages, and join the decode batch.
+
+        Prefill-terminal requests (``max_new_tokens == 1`` or EOS on the
+        first token) skip the copy entirely — they finish without ever
+        decoding, so their KV has no business on the decode placement."""
+        slot = self.slots[slot_i]
+        req = slot.request
+        # phase 2c only calls here once the array is ready (or nothing is
+        # decoding, so blocking costs no batch progress)
+        tok0 = int(jax.device_get(slot.pending_tok)[0])  # dslint: disable=host-sync-in-step
+        slot.pending_tok = None
+        if req.max_new_tokens == 1 or (
+            req.eos_token_id is not None and tok0 == req.eos_token_id
+        ):
+            # prefill-terminal request: the first token is also the last,
+            # so the decode placement never needs this prompt's KV — skip
+            # the cross-placement copy; index + free stay prefill-side
+            if self.prefix_cache is not None:
+                self.prefix_cache.insert(req.prompt, slot.prefill_pages)
+                self._g_index_pages.set(len(self.prefix_cache))
+            self.prefill_set.allocator.free(slot.prefill_pages)
+            slot.prefill_pages = []
+            self._start_decoding(slot_i, tok0)
+            return
+        t0 = self.clock()
+        n = len(slot.prefill_pages)
+        # scratch-pad both id lists to the compiled static width; duplicate
+        # pad entries all hit scratch page 0, which no live slot reads
+        src = np.zeros((self.prefill_pages,), np.int32)
+        src[:n] = slot.prefill_pages
+        dst = np.zeros((self.prefill_pages,), np.int32)
+        dst[:n] = slot.pages[:n]
+        pset, dset = self.prefill_set, self.decode_set
+        packed = self._gather_exec(*pset.pool_args(), src)
+        moved = tuple(dset.placement.pull_pool(x) for x in packed)
+        out = self._scatter_exec(*dset.pool_args(), *moved, dst)
+        dset.set_pools(out)
+        # sync for latency truth: the handoff gauge must cover the actual
+        # copy, not its async dispatch
+        jax.block_until_ready(out)  # dslint: disable=host-sync-in-step
+        now = self.clock()
+        nbytes = sum(int(x.nbytes) for x in packed)
+        self._c_handoffs.inc()
+        self._c_handoff_bytes.inc(nbytes)
+        self._h_handoff.observe(now - t0)
+        if self.tracer is not None:
+            self.tracer.event(
+                req, "kv_handoff", now, step=self._step_count, slot=slot_i,
+                pages=n, bytes=nbytes, latency_s=now - t0,
+            )
+        # prefix insert BEFORE freeing: insert retains the prompt's full
+        # pages, so the private non-full tail is the only thing released
+        if self.prefix_cache is not None:
+            self.prefix_cache.insert(req.prompt, slot.prefill_pages)
+            self._g_index_pages.set(len(self.prefix_cache))
+        pset.allocator.free(slot.prefill_pages)
+        slot.prefill_pages = []
         self._start_decoding(slot_i, tok0)
 
     def _start_decoding(self, slot_i: int, tok0: int) -> None:
@@ -1078,7 +1511,13 @@ class ServingEngine:
         slot = self.slots[slot_i]
         req = slot.request
         now = self.clock()
-        if slot.row is not None:
+        if self.disaggregated:
+            # the slot decodes against its private decode-pool reservation;
+            # whatever row the prefill used addressed the OTHER pool
+            self.table.assign(slot_i, slot.pages)
+            slot.prefilling = False
+            slot.row = None
+        elif slot.row is not None:
             self.table.block_tables[slot_i, :] = slot.row[0]
             slot.prefilling = False
             slot.row = None
@@ -1098,7 +1537,9 @@ class ServingEngine:
         slot.pos = req.prompt_len
         self.table.seq_lens[slot_i] = slot.pos
         self.table.tokens[slot_i] = tok0
-        if self.prefix_cache is not None:
+        if self.prefix_cache is not None and not self.disaggregated:
+            # disaggregated: _complete_handoff already indexed the
+            # PREFILL-side pages — slot.pages here are decode-pool ids
             self.prefix_cache.insert(req.prompt, slot.pages)
             self._g_index_pages.set(len(self.prefix_cache))
         if self._sampling and req.max_new_tokens > 1:
@@ -1149,6 +1590,10 @@ class ServingEngine:
         self._c_requests.inc(status=status)
         self._c_tokens.inc(len(req.tokens))
         self.allocator.free(slot.pages)
+        if slot.prefill_pages:
+            # evicted mid-prefill (timeout / preempt) before the handoff
+            # could free the prefill-side reservation
+            self.prefill_set.allocator.free(slot.prefill_pages)
         self.table.clear(slot_i)
         self.slots[slot_i] = _Slot()
         self._req_terminal(req, now)
@@ -1212,6 +1657,8 @@ class ServingEngine:
         slot = self.slots[slot_i]
         req = slot.request
         self.allocator.free(slot.pages)
+        if slot.prefill_pages:
+            self.prefill_set.allocator.free(slot.prefill_pages)
         self.table.clear(slot_i)
         self.slots[slot_i] = _Slot()
         retry_max = int(getattr(self.config, "retry_max", 0))
@@ -1352,30 +1799,29 @@ class ServingEngine:
         reports; int8 pools suffix them ``_int8`` so the quantized programs
         carry their OWN (lower) budget pins — the halved pool is the point,
         and sharing the full-precision pins would let a lost quantization
-        regress silently inside the old headroom."""
+        regress silently inside the old headroom. TP placements suffix
+        further (``_tp2``): a sharded program's per-device peak is a
+        different artifact, with its own pin (ISSUE 14)."""
         self._ensure_compiled()
-        sfx = "_int8" if self.quantized else ""
-        out = [(f"serving_prefill{sfx}", self._prefill_exec)]
-        if self.spec_enabled:
-            out.append((f"serving_verify{sfx}", self._verify_exec))
-        else:
-            out.append((f"serving_decode{sfx}", self._decode_exec))
-        if self._chunk_exec is not None:
-            out.append((f"serving_chunk_prefill{sfx}", self._chunk_exec))
-        return out
+        return [(name, rec["exe"]) for name, rec in self._program_info.items()]
 
     def verify(self, analysis_config=None) -> list:
-        """Engine A (dslint) verification of the serving program set.
+        """Full analysis-plane verification of the serving program set.
 
-        The serving contract, checked against the compiled artifacts
-        themselves: EXACTLY ``analysis.max_serving_programs`` executables
-        (``static-shapes``; 0 = auto — :attr:`expected_executables`, the
-        enabled feature set's count), both KV pools donated AND actually
-        aliased input→output in each program (``donation-honored`` — a
-        copied pool silently doubles the dominant HBM consumer), and no
-        fp32 upcasts when the cache dtype says bf16/fp16
-        (``no-fp32-upcast``). Returns findings; empty = clean. Compiles the
-        programs if the engine has not run yet."""
+        Engine F FIRST and PRE-compile (ISSUE 14): each placement's
+        sharding-spec table is checked against the real param tree and the
+        placement's mesh axes — a broken table (dead regex, rank mismatch,
+        large replicated leaf) returns findings before any ``shard_map``
+        traces with it. Then Engine A per program: EXACTLY
+        ``analysis.max_serving_programs`` executables (``static-shapes``;
+        0 = auto — :attr:`expected_executables`), the KV pools donated AND
+        actually aliased input→output with their per-DEVICE shapes
+        (``donation-honored`` — at tp>1 the HLO is the local program), no
+        fp32 upcasts (``no-fp32-upcast``); the handoff gather is the one
+        deliberate exception (its source pool must stay live for the
+        prefix index). Engine D checks the cross-program collective order;
+        Engine E the per-device HBM peaks against the ledger. Returns
+        findings; empty = clean."""
         from ..runtime.config import AnalysisConfig
         from .. import analysis as dsa
 
@@ -1384,64 +1830,106 @@ class ServingEngine:
             acfg = AnalysisConfig.from_dict(acfg)
         if not acfg.enabled:
             return []
+
+        # Engine F (ISSUE 14 satellite): pre-compile sharding-spec gate.
+        # An explicit analysis.sharding.rules table overrides the committed
+        # GPT2_SERVING_RULES for the check; tp=1 placements with no
+        # explicit table carry no mesh to shard and are skipped (the
+        # committed table is inert there, exactly as before ISSUE 14).
+        findings: list = []
+        scfg = getattr(acfg, "sharding", None)
+        if scfg is not None and getattr(scfg, "enabled", True):
+            from ..analysis import sharding_rules as dsspec
+
+            cfg_rules = dsspec.rules_from_config(scfg)
+            placements = [self.decode_placement]
+            if self.prefill_placement is not self.decode_placement:
+                placements.append(self.prefill_placement)
+            for plc in placements:
+                if plc.tp == 1 and not cfg_rules:
+                    continue
+                fctx = dsspec.ShardingRuleContext(
+                    program=f"serving_params_{plc.name}{plc.suffix()}",
+                    mesh_axes=plc.mesh_axes,
+                    replicated_min_bytes=scfg.replicated_min_bytes,
+                )
+                findings.extend(dsspec.verify_spec_table(
+                    cfg_rules if cfg_rules else plc.rules,
+                    self.engine.params, fctx,
+                ))
+            if findings:
+                # fail BEFORE compile: shard_map must never trace a table
+                # Engine F rejects
+                return findings
+
         self._ensure_compiled()
         pool_dt = dsa.hlo_dtype(np.dtype(self.cache_dtype))
-        pool_dims = ",".join(str(d) for d in self.k_pool.shape)
         expected_dtype = pool_dt if pool_dt in ("bf16", "f16") else None
-        # both pools share one shape: demand two aliased params; int8 pools
-        # additionally demand the donated scales pool aliased (a copied
-        # scales buffer is small, but an unaliased donation means XLA
-        # round-trips it every step)
-        expect_aliased = [(pool_dt, pool_dims)] * 2
-        if self.quantized:
-            expect_aliased.append(
-                ("f32", ",".join(str(d) for d in self.kv_scales.shape))
-            )
         ctx = dsa.RuleContext(program="serving")
         budget = int(getattr(acfg, "max_serving_programs", 0) or 0)
-        findings = dsa.check_program_budget(
+        findings.extend(dsa.check_program_budget(
             len(self.executables), budget or self.expected_executables,
             ctx, exact=True,
-        )
+        ))
         texts = {}
-        for name, exe in self.executable_names():
-            texts[name] = exe.as_text()
+        for name, rec in self._program_info.items():
+            pset, kind = rec["pset"], rec["kind"]
+            texts[name] = rec["exe"].as_text()
+            if kind == "gather":
+                # gather READS the prefill pool (pages stay live for the
+                # prefix index) — demanding aliasing here would be wrong
+                expect_aliased = []
+            else:
+                # both pools share one per-device shape: demand two aliased
+                # params; int8 pools additionally demand the donated scales
+                # pool aliased (a copied scales buffer is small, but an
+                # unaliased donation means XLA round-trips it every step)
+                expect_aliased = [(pool_dt, pset.local_pool_dims())] * 2
+                if self.quantized:
+                    expect_aliased.append(("f32", pset.local_scales_dims()))
             pctx = dsa.RuleContext(
                 program=name,
-                expect_aliased_shapes=list(expect_aliased),
+                expect_aliased_shapes=expect_aliased,
                 expected_dtype=expected_dtype,
                 upcast_allow=acfg.upcast_allow,
                 allgather_min_bytes=acfg.allgather_min_bytes,
             )
             findings.extend(dsa.verify_hlo_text(texts[name], pctx))
-        # Engine D (ISSUE 8): both executables run on one engine — channel
-        # uniqueness + start/done pairing per program, and (under a future
-        # TP-sharded serving mesh, ROADMAP item 3) the prefill/decode pair
-        # must agree on per-group collective order or concurrent slots
-        # desync
+        # Engine D (ISSUE 8): every executable runs on one engine — channel
+        # uniqueness + start/done pairing per program, and (ROADMAP item 2,
+        # landed: ISSUE 14) the TP-sharded prefill/decode pair must agree
+        # on per-group collective order or concurrent slots desync
         findings.extend(dsa.verify_program_set(texts))
         # Engine E (ISSUE 9): static HBM liveness per executable against
         # the committed budgets — the KV page pool is the dominant
         # consumer, so a doubled pool or a lost donation fails the gate
-        # here before it OOMs under load. check_donation=False: serving
-        # weights are shared across every call by design (only the pools
-        # are donated, and those are already aliased).
+        # here before it OOMs under load. At tp>1 the dims fed to the
+        # categorizer are the per-DEVICE pool/packed shapes — the peaks
+        # (and their ``_tp2`` ledger pins) are per-device quantities.
+        # check_donation=False: serving weights are shared across every
+        # call by design (only the pools are donated, already aliased).
         mcfg = getattr(acfg, "memory", None)
         if mcfg is not None and getattr(mcfg, "enabled", True):
             from ..analysis import memory_rules as dsmem
 
             self._memory_analyses = {}
             self._memory_cfg = mcfg
-            for name in texts:
+            for name, rec in self._program_info.items():
+                pset, kind = rec["pset"], rec["kind"]
+                kv_dims = [pset.local_pool_dims()]
+                scl = (pset.local_scales_dims(),) if self.quantized else ()
+                if kind in ("gather", "scatter"):
+                    kv_dims.append(pset.packed_dims(self.prefill_pages))
+                    if self.quantized:
+                        scl = scl + (
+                            pset.packed_scales_dims(self.prefill_pages),
+                        )
                 ectx = dsmem.context_from_config(
                     mcfg, name,
                     check_donation=False,
-                    kv_pool_dims=(pool_dims,),
+                    kv_pool_dims=tuple(kv_dims),
                     metadata_dims=self._metadata_dims(),
-                    scales_dims=(
-                        (",".join(str(d) for d in self.kv_scales.shape),)
-                        if self.quantized else ()
-                    ),
+                    scales_dims=scl,
                 )
                 mem_findings, ana = dsmem.verify_memory_text(
                     texts[name], ectx
@@ -1586,6 +2074,32 @@ class ServingEngine:
             scales_bytes(mc.n_layer, int(self.config.num_pages), mc.n_head)
             if self.quantized else 0
         )
+        # ISSUE 14: where the programs run and what each device holds —
+        # per-device pool bytes drop 1/tp, the whole point of the axis
+        psets = {self.decode_set.placement.name: self.decode_set}
+        psets[self.prefill_set.placement.name] = self.prefill_set
+        out["placement"] = {
+            "tp": self.tp,
+            "disaggregated": self.disaggregated,
+            "placements": {
+                name: {
+                    "tp": ps.placement.tp,
+                    "devices": [
+                        str(getattr(d, "id", d)) for d in ps.placement.devices
+                    ],
+                    "num_pages": ps.num_pages,
+                    "pages_in_use": ps.allocator.pages_in_use,
+                    "per_device_pool_bytes": ps.local_pool_bytes(),
+                    "per_device_scales_bytes": ps.local_scales_bytes(),
+                }
+                for name, ps in psets.items()
+            },
+        }
+        if self.disaggregated:
+            out["kv_handoffs"] = int(self._c_handoffs.value())
+            out["kv_handoff_bytes"] = int(self._c_handoff_bytes.value())
+            total, n = self._h_handoff.stats()
+            out["kv_handoff_latency_mean_s"] = (total / n) if n else None
         out["chunk_prefills"] = int(self._c_chunks.value())
         if self.prefix_cache is not None:
             pc = self.prefix_cache
@@ -1620,9 +2134,17 @@ class ServingEngine:
     def check_no_leaks(self) -> None:
         """Drain invariant: every page either back on the free list or held
         by EXACTLY the prefix index (refcount 1), every slot empty, every
-        block-table entry pointing at scratch."""
+        block-table entry pointing at scratch. Under disaggregation the
+        index lives on the PREFILL allocator; the decode pool must drain
+        completely — a page left there means a handoff leaked its
+        reservation."""
         held = self.prefix_cache.held_pages if self.prefix_cache else None
-        self.allocator.check_no_leaks(allowed=held)
+        if self.disaggregated:
+            self.prefill_set.allocator.check_no_leaks(allowed=held)
+            self.decode_set.allocator.check_no_leaks(allowed=None)
+        else:
+            self.allocator.check_no_leaks(allowed=held)
         assert all(s.request is None for s in self.slots)
+        assert all(not s.prefill_pages for s in self.slots)
         assert (self.table.block_tables == 0).all()
         assert (self.table.seq_lens == 0).all()

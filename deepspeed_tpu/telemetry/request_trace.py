@@ -26,6 +26,10 @@ TTFT blew its SLO". The :class:`RequestTracer` records the full timeline:
 - ``retry`` — a transient failure evicted the slot and re-queued the
   request (deadline timeouts and drain preemptions emit no event; they
   land as the terminal record's ``status``),
+- ``kv_handoff`` — disaggregated serving (ISSUE 14): the prompt KV copied
+  from the prefill placement's pool into the decode placement's, with
+  pages/bytes moved and the copy latency (timed to completion;
+  prefill-terminal requests skip the copy and the event),
 - one terminal record per request: the event list plus derived summaries
   (queue wait, TTFT, per-emission timestamps → streaming-client inter-token
   gaps) and the SLO verdict against the request's class targets.
