@@ -1730,6 +1730,136 @@ def run_dslint_bench():
     return pr6
 
 
+def run_dsproto_bench():
+    """BENCH_pr15.json (ISSUE 15): the serving-protocol plane as a diffable
+    artifact — Engine G's ownership-lint per-rule counts over the package,
+    the bounded model checker's exploration stats for both protocol modes
+    (states / transitions / wall time, zero violations expected), the
+    mutation matrix (every seeded protocol defect must produce a minimal
+    counterexample), and the replay self-check: the drop-drain-free
+    counterexample driven through a real gpt2-tiny serving engine goes red
+    mutated / green clean, and the skip-cow-fork mutation trips the step
+    monitor's shared-page write check. BENCH_DSPROTO_ONLY=1 runs it
+    standalone; the standalone exit code mirrors the self-check."""
+    import time as _time
+
+    from deepspeed_tpu.analysis import protocol_model as dsproto
+    from deepspeed_tpu.tools import dslint as _dsl
+
+    pkg = os.path.join(_BENCH_DIR, "deepspeed_tpu")
+    baseline = _dsl._find_baseline([pkg])
+    rep = _dsl.collect([pkg], baseline_path=baseline, engines=frozenset("g"))
+    lint = {
+        "findings_total": rep["findings_total"],
+        "new": len(rep["new"]),
+        "suppressed": rep["suppressed"],
+        "per_rule": {r: n for r, n in sorted(rep["per_rule"].items())},
+        "files_scanned": rep["files_scanned"],
+    }
+
+    model = {}
+    for mode, mcfg in dsproto.default_model_configs().items():
+        t0 = _time.perf_counter()
+        r = dsproto.explore(mcfg)
+        model[mode] = {
+            "states": r.states,
+            "transitions": r.transitions,
+            "complete": r.complete,
+            "wall_s": round(_time.perf_counter() - t0, 3),
+            "violations": len(r.violations),
+        }
+
+    mutation_matrix = {}
+    for name in sorted(dsproto.MUTATIONS):
+        disagg = name == "drop-handoff-free"
+        r = dsproto.explore(dsproto.ProtoModelConfig(
+            disaggregated=disagg, mutations=frozenset({name})))
+        mutation_matrix[name] = {
+            "mode": "disaggregated" if disagg else "shared",
+            "rules": sorted({v.rule for v in r.violations}),
+            "counterexample_len": min(
+                (len(v.trace) for v in r.violations), default=None),
+        }
+
+    # -- replay self-check on the real engine --------------------------
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.models import gpt2
+
+    cfg = gpt2.get_config("gpt2-tiny", attn_impl="jnp")
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+    eng = InferenceEngine(
+        gpt2.make_module(cfg), params=params, dtype=jnp.float32
+    )
+    scfg = {
+        "max_slots": 2, "page_size": 4, "num_pages": 32,
+        "max_prompt_len": 8, "max_new_tokens": 4,
+        "prefix_cache": {"enabled": True}, "prefill_chunk_tokens": 4,
+    }
+    rs = np.random.RandomState(0)
+    prompt = rs.randint(0, cfg.vocab_size, (8,)).astype(np.int32)
+    prompts = [prompt, prompt.copy()]
+
+    trace = next(
+        v.trace for v in dsproto.explore(dsproto.ProtoModelConfig(
+            mutations=frozenset({"drop-drain-free"}))).violations
+        if v.rule == "proto-page-leak"
+    )
+    clean = dsproto.replay_trace(
+        eng.serve(scfg), list(trace), prompts, max_new_tokens=2
+    )
+    mutations_red = []
+    srv = eng.serve(scfg)
+    undo = dsproto.apply_engine_mutation(srv, "drop-drain-free")
+    try:
+        red = dsproto.replay_trace(
+            srv, list(trace), prompts, max_new_tokens=2
+        )
+    finally:
+        undo()
+    if not red["ok"]:
+        mutations_red.append("drop-drain-free")
+
+    srv2 = eng.serve(scfg)
+    undo2 = dsproto.apply_engine_mutation(srv2, "skip-cow-fork")
+    mon = dsproto.ProtocolMonitor(srv2)
+    try:
+        for seed, p in enumerate(prompts, start=1):
+            h = srv2.submit(p, max_new_tokens=2, seed=seed)
+            for _ in range(20):
+                srv2.step()
+                mon.check_step()
+                if h.status not in ("queued", "running"):
+                    break
+    finally:
+        undo2()
+        mon.uninstall()
+    if any("proto-write-shared-page" in v for v in mon.violations):
+        mutations_red.append("skip-cow-fork")
+
+    replay = {
+        "ok": bool(clean["ok"])
+        and mutations_red == ["drop-drain-free", "skip-cow-fork"],
+        "clean_replay_ok": bool(clean["ok"]),
+        "mutations_red": mutations_red,
+        "counterexample": list(trace),
+    }
+
+    pr15 = {
+        "schema": "bench_pr15_dsproto_v1",
+        "lint": lint,
+        "model": model,
+        "mutation_matrix": mutation_matrix,
+        "replay_self_check": replay,
+    }
+    with open(os.path.join(_BENCH_DIR, "BENCH_pr15.json"), "w") as fh:
+        json.dump(pr15, fh, indent=1)
+        fh.write("\n")
+    return pr15
+
+
 def main():
     ok, platform, attempts = _await_backend()
     if not ok:
@@ -2257,6 +2387,20 @@ def main():
                 pr9["sanitizer_overhead_disabled_pct"]
         except Exception as e:
             result["pr9_error"] = f"{type(e).__name__}: {e}"
+    # --- BENCH_pr15.json (ISSUE 15): serving-protocol plane — Engine G
+    # lint counts, model-checker exploration stats, the mutation matrix,
+    # and the counterexample replay self-check on a real tiny engine.
+    # BENCH_DSPROTO=0 opts out (it compiles a tiny serving engine).
+    if os.environ.get("BENCH_DSPROTO", "1") == "1":
+        try:
+            pr15 = run_dsproto_bench()
+            result["pr15_artifact"] = "BENCH_pr15.json"
+            result["dsproto_model_states"] = {
+                m: rec["states"] for m, rec in pr15["model"].items()
+            }
+            result["dsproto_replay_ok"] = pr15["replay_self_check"]["ok"]
+        except Exception as e:
+            result["pr15_error"] = f"{type(e).__name__}: {e}"
     # --- BENCH_pr7.json (ISSUE 7): fault-tolerance plane — async-save
     # overhead per step + corrupt-tag recovery time. BENCH_RESILIENCE=0
     # opts out (it compiles a second tiny engine on CPU runs).
@@ -2308,6 +2452,12 @@ if __name__ == "__main__":
                 _flags + " --xla_force_host_platform_device_count=8"
             ).strip()
         print(json.dumps(run_tp_serving_bench()))
+    elif os.environ.get("BENCH_DSPROTO_ONLY", "0") == "1":
+        # ISSUE 15: just the serving-protocol plane (BENCH_pr15.json) —
+        # the exit code mirrors the replay self-check so CI can gate on it
+        _pr15 = run_dsproto_bench()
+        print(json.dumps(_pr15))
+        raise SystemExit(0 if _pr15["replay_self_check"]["ok"] else 1)
     elif os.environ.get("BENCH_RESILIENCE_ONLY", "0") == "1":
         print(json.dumps(run_resilience_bench()))
     elif os.environ.get("BENCH_DSAN_ONLY", "0") == "1":

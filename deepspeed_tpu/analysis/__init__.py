@@ -29,12 +29,21 @@ Four engines over one findings/severity/suppression model:
   verifier — ``match_partition_rules``-style regex tables checked against
   real ``jax.eval_shape`` param trees and the mesh: dead rules, rank/axis
   mismatches, silently replicated large leaves.
+- **Engine G** (``protocol_rules`` + ``protocol_model``, ISSUE 15): the
+  serving-protocol plane. An AST ownership-dataflow lint tracks every
+  ``PageAllocator.alloc/retain/free`` through branches, early returns and
+  exception paths (page-leak-on-path, double-free, use-after-free,
+  refcount-escape, dual-reserve-unbalanced), and a bounded explicit-state
+  model checker explores the scheduler's event interleavings against
+  refcount-conservation / leak / use-after-free / wedge / dual-reserve
+  invariants, emitting minimal counterexample traces that
+  ``protocol_model.replay_trace`` confirms on the real ``ServingEngine``.
 
 Front ends: the ``python -m deepspeed_tpu.tools.dslint`` CLI (with the
-committed-baseline CI gate and ``--engines a,b,c,d,e,f`` selection), the
-``lint``/``dsan``/``dsmem``-marked tier-1 tests, and ``bench.py``'s
-finding counters. Engine F has no file form — it runs where live param
-trees exist (``engine.verify_program()``, the dsmem tests). See
+committed-baseline CI gate, ``--engines a..g`` selection, and ``--sarif``
+export), the ``lint``/``dsan``/``dsmem``-marked tier-1 tests, and
+``bench.py``'s finding counters. Engine F has no file form — it runs where
+live param trees exist (``engine.verify_program()``, the dsmem tests). See
 ``docs/ANALYSIS.md`` for the rule catalog and the suppression / baseline
 workflow.
 """
@@ -95,6 +104,21 @@ from .sharding_rules import (  # noqa: F401
     verify_tree_shardings,
 )
 from .sharding_rules import RULES as SHARDING_RULES  # noqa: F401
+from .protocol_model import (  # noqa: F401
+    ProtoModelConfig,
+    ProtocolMonitor,
+    apply_engine_mutation,
+    default_model_configs,
+    explore,
+    model_findings,
+    replay_trace,
+)
+from .protocol_model import MODEL_RULES as PROTOCOL_MODEL_RULES  # noqa: F401
+from .protocol_rules import (  # noqa: F401
+    check_file as check_protocol_file,
+    check_source as check_protocol_source,
+)
+from .protocol_rules import RULES as PROTOCOL_RULES  # noqa: F401
 
 # engine letter → rule catalog (the CLI's --engines selector)
 ENGINE_RULES = {
@@ -104,6 +128,7 @@ ENGINE_RULES = {
     "d": COLLECTIVE_RULES,
     "e": MEMORY_RULES,
     "f": SHARDING_RULES,
+    "g": {**PROTOCOL_RULES, **PROTOCOL_MODEL_RULES},
 }
 ALL_ENGINES = frozenset(ENGINE_RULES)
 
@@ -177,6 +202,18 @@ def lint_paths(paths, hot_patterns=None, donate_patterns=None, engines=None):
             got, waived = check_file(f)
             findings.extend(got)
             suppressed += waived
+        if "g" in engines:
+            got, waived = check_protocol_file(f)
+            findings.extend(got)
+            suppressed += waived
+    if "g" in engines and any(
+        os.path.basename(os.path.dirname(os.path.abspath(f))) == "serving"
+        for f in py_files
+    ):
+        # the model checker has no per-file form: it verifies the serving
+        # protocol itself, so it joins any scan that covers serving/
+        for cfg in default_model_configs().values():
+            findings.extend(model_findings(explore(cfg)))
     hlo_texts = {}
     for f in hlo_files:
         with open(f, encoding="utf-8") as fh:

@@ -1,0 +1,682 @@
+"""Engine G (static half, ISSUE 15): the page-ownership dataflow lint.
+
+The serving scheduler is protocol code: every KV page moves through an
+acquire (``PageAllocator.alloc`` / ``retain``) → hold → release (``free``)
+lifecycle, with the prefix index (``PrefixCache``) holding long-lived
+references and disaggregated admission reserving on TWO allocators at once.
+Example-based tests pin the happy paths; this lint walks the OWNERSHIP of
+those pages through every branch, early return, and exception edge of each
+function and fires when a path can drop, double-release, or alias a page
+the protocol says it must not.
+
+Analysis model (intraprocedural, path-sensitive with state merging):
+
+- An *acquisition* is the result list of an ``<...allocator>.alloc(n)``
+  call or the argument of ``<...allocator>.retain(pages)``. The resource is
+  tracked by the set of local names aliased to it (assignments whose RHS
+  mentions an owned name extend the alias set — ``pages = shared + priv``
+  makes ``pages`` an alias of both).
+- A resource is *discharged* by a ``free`` whose argument mentions an
+  alias, or by *escaping*: stored into an attribute/subscript (the slot,
+  the table, the index) or returned — ownership transfers to a longer-lived
+  holder that the drain invariant (``check_no_leaks``) audits instead.
+- ``alloc``/``retain``/``free`` can raise ``PageAllocatorError`` (pool
+  exhausted, foreign page) — each such call is an *exception edge*. Holding
+  an undischarged resource across one is a leak unless an enclosing
+  ``try``'s handler (or ``finally``) visibly frees an alias of it. The ops
+  themselves validate-then-mutate (atomic), so a handler's rollback is
+  exact.
+
+Rules (all ``severity=error``, engine ``protocol``):
+
+- ``page-leak-on-path`` — an acquiring path reaches a terminal edge (fall
+  off the end, ``return``, ``raise``, or an uncovered exception edge)
+  without releasing or escaping the pages; also fires when a slot is reset
+  (``self.slots[i] = ...``) in a function that never frees ``.pages``.
+- ``double-free`` — one path frees the same expression twice with no
+  rebinding in between.
+- ``use-after-free`` — a freed expression is re-installed (``.assign``,
+  ``.insert``, ``retain``, or a subscript store) after its owning free.
+- ``refcount-escape`` — the COW page of a full prefix hit (the third
+  element of ``PrefixCache.lookup``'s result) flows into a writable page
+  set (``.pages`` / ``.prefill_pages`` / ``.row`` stores, block-table
+  writes, ``table.assign``) without an alloc-backed fork: decode/chunk
+  writes would mutate a page other holders read.
+- ``dual-reserve-unbalanced`` — a function that retires a slot releases
+  only one of the two reservations disaggregated admission took (frees
+  ``.pages`` but not ``.prefill_pages``, or vice versa).
+
+Same front end as Engines B/C: :func:`check_source` / :func:`check_file`
+→ ``(findings, suppressed)`` through the shared Finding / suppression /
+baseline machinery (``# dslint: disable=<rule>`` waives with a visible
+count). ``tools/dslint.py --engines g`` selects it; the dynamic half —
+the bounded model checker over the same protocol — lives in
+``protocol_model.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from .findings import (
+    SEVERITY_ERROR,
+    Finding,
+    SuppressionIndex,
+    apply_suppressions,
+)
+
+RULES = {
+    "page-leak-on-path": (
+        "an acquiring path (alloc/retain) reaches a terminal or exception "
+        "edge without freeing or storing the pages"
+    ),
+    "double-free": (
+        "one path frees the same page expression twice without an "
+        "intervening rebind"
+    ),
+    "use-after-free": (
+        "a freed page expression is re-installed (table assign / index "
+        "insert / retain) after its owning free"
+    ),
+    "refcount-escape": (
+        "the COW page of a full prefix hit flows into a writable page set "
+        "without an alloc-backed fork"
+    ),
+    "dual-reserve-unbalanced": (
+        "slot teardown releases only one of the two reservations "
+        "disaggregated admission took (.pages vs .prefill_pages)"
+    ),
+}
+
+_PROTO_OPS = ("alloc", "retain", "free")
+# attribute names whose stores mean "this is now a writable page set"
+_PAGE_ATTRS = ("pages", "prefill_pages", "row")
+# per-function path-state cap: states merge aggressively (most statements
+# do not touch protocol state), so this only bounds pathological inputs
+_MAX_STATES = 128
+
+
+def _chain(node: ast.AST) -> Optional[str]:
+    """Dotted chain for a Name/Attribute expression (``self.a.b`` →
+    ``"self.a.b"``), else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _names(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _proto_call(node: ast.Call) -> Optional[Tuple[str, str]]:
+    """→ (op, receiver chain) when ``node`` is an allocator protocol call
+    (``<chain ending in an allocator-ish name>.alloc/retain/free(...)``)."""
+    if not isinstance(node.func, ast.Attribute):
+        return None
+    op = node.func.attr
+    if op not in _PROTO_OPS or not node.args:
+        return None
+    recv = _chain(node.func.value)
+    if recv is None:
+        return None
+    if "alloc" not in recv.split(".")[-1]:
+        return None
+    return op, recv
+
+
+def _free_keys(call: ast.Call) -> Set[str]:
+    """Expression keys a ``free`` discharges: dotted chains of the args
+    plus names inside list-literal args (``free([pid])``)."""
+    keys: Set[str] = set()
+    for a in call.args:
+        k = _chain(a)
+        if k is not None:
+            keys.add(k)
+        elif isinstance(a, (ast.List, ast.Tuple)):
+            keys.update(_names(a))
+        else:
+            keys.update(_names(a))
+    return keys
+
+
+# a tracked resource: (acquire line, op, receiver chain, alias names)
+_Own = Tuple[int, str, str, FrozenSet[str]]
+# path state: (live resources, freed expression keys)
+_State = Tuple[FrozenSet[_Own], FrozenSet[str]]
+
+
+class _FunctionCheck:
+    """Path-sensitive ownership walk over one function body."""
+
+    def __init__(self, linter: "_Linter", qualname: str):
+        self.linter = linter
+        self.qualname = qualname
+        # stack of frozensets: names an enclosing try's handlers/finally
+        # visibly free (covers exception edges inside that try's body)
+        self.covers: List[FrozenSet[str]] = []
+
+    # -- reporting -----------------------------------------------------
+
+    def _emit(self, rule: str, line: int, message: str) -> None:
+        self.linter.emit(rule, line, message, self.qualname)
+
+    def _leak(self, own: _Own, line: int, how: str) -> None:
+        names = "/".join(sorted(own[3])) or f"{own[2]}.{own[1]}(...)"
+        self._emit(
+            "page-leak-on-path", own[0],
+            f"pages acquired by {own[2]}.{own[1]}() (held as '{names}') "
+            f"are dropped when this path {how} at line {line} — free them "
+            "or store them on an audited holder first",
+        )
+
+    # -- state transitions ---------------------------------------------
+
+    def _exception_edge(
+        self, st: _State, line: int, releasing: FrozenSet[str]
+    ) -> None:
+        """alloc/retain/free at ``line`` may raise PageAllocatorError —
+        every held resource not being released by this very call must be
+        covered by an enclosing handler's rollback."""
+        cover: Set[str] = set()
+        for c in self.covers:
+            cover |= c
+        for own in st[0]:
+            if own[3] & releasing:
+                continue  # this call IS the release
+            if own[3] & cover:
+                continue  # an enclosing handler frees an alias
+            self._leak(own, line, "raises PageAllocatorError")
+
+    def _terminal(self, st: _State, line: int, how: str) -> None:
+        for own in st[0]:
+            self._leak(own, line, how)
+
+    def _use_after_free(
+        self, st: _State, node: ast.AST, line: int, context: str
+    ) -> None:
+        for sub in ast.walk(node):
+            key = _chain(sub)
+            if key is not None and key in st[1]:
+                self._emit(
+                    "use-after-free", line,
+                    f"'{key}' was freed earlier on this path but is "
+                    f"re-installed via {context} — the pages may already "
+                    "belong to another request",
+                )
+
+    # -- statement dispatch --------------------------------------------
+
+    def block(self, stmts: List[ast.stmt], states: Set[_State]) -> Set[_State]:
+        for s in stmts:
+            if not states:
+                break
+            states = self.stmt(s, states)
+            if len(states) > _MAX_STATES:
+                states = set(list(states)[:_MAX_STATES])
+        return states
+
+    def stmt(self, s: ast.stmt, states: Set[_State]) -> Set[_State]:
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return states  # nested defs are analyzed as their own functions
+        if isinstance(s, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            out: Set[_State] = set()
+            for st in states:
+                out.add(self.assign(s, st))
+            return out
+        if isinstance(s, ast.Expr):
+            out = set()
+            for st in states:
+                out.add(self.expr_stmt(s, st))
+            return out
+        if isinstance(s, ast.Return):
+            for st in states:
+                live = st[0]
+                if s.value is not None:
+                    rn = _names(s.value)
+                    live = frozenset(o for o in live if not (o[3] & rn))
+                self._terminal((live, st[1]), s.lineno, "returns")
+            return set()
+        if isinstance(s, ast.Raise):
+            for st in states:
+                self._exception_edge(st, s.lineno, frozenset())
+            return set()
+        if isinstance(s, ast.If):
+            # guard-empty idiom: on the false branch of ``if pages:`` the
+            # guarded name is provably empty, so owns it aliases are vacuous
+            else_states = set(states)
+            if isinstance(s.test, ast.Name):
+                g = s.test.id
+                else_states = {
+                    (
+                        frozenset(o for o in st[0] if g not in o[3]),
+                        st[1],
+                    )
+                    for st in states
+                }
+            return (
+                self.block(s.body, set(states))
+                | self.block(s.orelse, else_states)
+            )
+        if isinstance(s, (ast.For, ast.AsyncFor, ast.While)):
+            once = self.block(s.body, set(states))
+            skip = self.block(s.orelse, set(states)) if s.orelse else states
+            return once | skip | states
+        if isinstance(s, ast.Try):
+            self.covers.append(self._handler_cover(s))
+            body_states = self.block(s.body, set(states))
+            self.covers.pop()
+            if s.orelse:
+                body_states = self.block(s.orelse, body_states)
+            handler_states: Set[_State] = set()
+            for h in s.handlers:
+                # handlers also run standalone from the try-entry state so
+                # rollback code gets its own double-free/UAF audit
+                handler_states |= self.block(h.body, set(states))
+            after = body_states | handler_states
+            if s.finalbody:
+                after = self.block(s.finalbody, after)
+            return after
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            return self.block(s.body, states)
+        if isinstance(s, ast.Delete):
+            dead = set()
+            for t in s.targets:
+                if isinstance(t, ast.Name):
+                    dead.add(t.id)
+            if dead:
+                return {self._kill_names(st, dead) for st in states}
+            return states
+        return states
+
+    def _handler_cover(self, t: ast.Try) -> FrozenSet[str]:
+        names: Set[str] = set()
+        # simple name flows inside the handler count: the common rollback
+        # idiom is ``both = a + b; allocator.free(both)`` — freeing ``both``
+        # covers ``a`` and ``b``
+        flows: dict = {}
+        for body in [h.body for h in t.handlers] + [t.finalbody]:
+            for node in body:
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Assign):
+                        for tgt in sub.targets:
+                            if isinstance(tgt, ast.Name):
+                                flows.setdefault(tgt.id, set()).update(
+                                    _names(sub.value)
+                                )
+                    elif isinstance(sub, ast.Call):
+                        m = _proto_call(sub)
+                        if m is not None and m[0] == "free":
+                            for a in sub.args:
+                                names |= _names(a)
+        for _ in range(4):  # transitive closure, tiny bound
+            extra = set()
+            for n in names:
+                extra |= flows.get(n, set())
+            if extra <= names:
+                break
+            names |= extra
+        return frozenset(names)
+
+    @staticmethod
+    def _kill_names(st: _State, dead: Set[str]) -> _State:
+        owns = frozenset(
+            (o[0], o[1], o[2], o[3] - frozenset(dead)) for o in st[0]
+        )
+        freed = frozenset(
+            k for k in st[1]
+            if k not in dead and k.split(".")[0] not in dead
+        )
+        return owns, freed
+
+    # -- expressions ----------------------------------------------------
+
+    def _process_calls(self, node: ast.AST, st: _State) -> _State:
+        """Apply every protocol call inside ``node`` (in source order) to
+        the state; acquisitions from ``alloc`` are left pending for the
+        enclosing assignment to bind (an unbound alloc is itself a leak —
+        handled by the caller)."""
+        owns, freed = set(st[0]), set(st[1])
+        for call in [
+            c for c in ast.walk(node) if isinstance(c, ast.Call)
+        ]:
+            m = _proto_call(call)
+            if m is None:
+                # non-protocol call: the re-install half of use-after-free
+                if (
+                    isinstance(call.func, ast.Attribute)
+                    and call.func.attr in ("assign", "insert")
+                ):
+                    for a in call.args:
+                        self._use_after_free(
+                            (frozenset(owns), frozenset(freed)),
+                            a, call.lineno, f".{call.func.attr}()",
+                        )
+                continue
+            op, recv = m
+            arg_names = frozenset().union(
+                *[_names(a) for a in call.args]
+            ) if call.args else frozenset()
+            self._exception_edge(
+                (frozenset(owns), frozenset(freed)), call.lineno,
+                arg_names if op == "free" else frozenset(),
+            )
+            if op == "retain":
+                self._use_after_free(
+                    (frozenset(owns), frozenset(freed)),
+                    call, call.lineno, "retain()",
+                )
+                if arg_names:
+                    owns.add((call.lineno, "retain", recv, arg_names))
+            elif op == "free":
+                owns = {o for o in owns if not (o[3] & arg_names)}
+                for key in _free_keys(call):
+                    if key in freed:
+                        self._emit(
+                            "double-free", call.lineno,
+                            f"'{key}' is freed twice on this path — the "
+                            "second free throws or releases another "
+                            "request's pages",
+                        )
+                    else:
+                        freed.add(key)
+        return frozenset(owns), frozenset(freed)
+
+    def expr_stmt(self, s: ast.Expr, st: _State) -> _State:
+        st = self._process_calls(s.value, st)
+        # a bare alloc whose result is discarded leaks immediately
+        if isinstance(s.value, ast.Call):
+            m = _proto_call(s.value)
+            if m is not None and m[0] == "alloc":
+                self._emit(
+                    "page-leak-on-path", s.lineno,
+                    f"{m[1]}.alloc() result is discarded — the pages can "
+                    "never be freed",
+                )
+        return st
+
+    def assign(self, s: ast.stmt, st: _State) -> _State:
+        value = s.value
+        if value is None:  # bare annotation
+            return st
+        targets = (
+            s.targets if isinstance(s, ast.Assign) else [s.target]
+        )
+        st = self._process_calls(value, st)
+        owns, freed = set(st[0]), set(st[1])
+
+        target_names = {t.id for t in targets if isinstance(t, ast.Name)}
+        stored = any(
+            isinstance(t, (ast.Attribute, ast.Subscript)) for t in targets
+        )
+        value_names = _names(value)
+
+        # freed-key UAF: a freed expression flowing into an attr/subscript
+        # store is a re-install
+        if stored:
+            self._use_after_free(
+                (frozenset(owns), frozenset(freed)), value, s.lineno,
+                "an attribute/subscript store",
+            )
+
+        # alias extension / escape / rebind-kill for existing resources
+        next_owns: Set[_Own] = set()
+        for own in owns:
+            aliases = own[3]
+            if aliases & value_names:
+                if stored:
+                    continue  # escaped to a longer-lived holder
+                aliases = aliases | frozenset(target_names)
+            else:
+                rebound = aliases & target_names
+                if rebound:
+                    aliases = aliases - rebound
+                    if not aliases:
+                        self._emit(
+                            "page-leak-on-path", s.lineno,
+                            f"the last name holding pages from "
+                            f"{own[2]}.{own[1]}() (line {own[0]}) is "
+                            "rebound here — the pages can never be freed",
+                        )
+                        continue
+            next_owns.add((own[0], own[1], own[2], aliases))
+
+        # bind fresh alloc acquisitions from this RHS (after the rebind
+        # pass: the acquisition's own target must not kill it)
+        for call in ast.walk(value):
+            if isinstance(call, ast.Call):
+                m = _proto_call(call)
+                if m is not None and m[0] == "alloc":
+                    if stored and not target_names:
+                        continue  # stored directly: escaped on arrival
+                    if not target_names:
+                        self._emit(
+                            "page-leak-on-path", call.lineno,
+                            f"{m[1]}.alloc() result is never bound to a "
+                            "releasable name",
+                        )
+                        continue
+                    next_owns.add((
+                        call.lineno, "alloc", m[1], frozenset(target_names)
+                    ))
+
+        # rebinding an expression key ends its freed-ness
+        killed = set(target_names)
+        for t in targets:
+            k = _chain(t)
+            if k is not None:
+                killed.add(k)
+        freed = {
+            k for k in freed
+            if k not in killed
+            and not any(k.startswith(dead + ".") for dead in killed)
+        }
+        return frozenset(next_owns), frozenset(freed)
+
+
+class _Linter:
+    """Per-module driver: function discovery, per-function path walk,
+    whole-function obligations (slot teardown + COW taint)."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.lines = source.splitlines()
+        self.findings: List[Finding] = []
+        self._seen: Set[Tuple[str, int, str]] = set()
+
+    def emit(self, rule: str, line: int, message: str, symbol: str) -> None:
+        key = (rule, line, symbol)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        snippet = (
+            self.lines[line - 1].strip()
+            if 0 < line <= len(self.lines) else ""
+        )
+        self.findings.append(Finding(
+            rule=rule, severity=SEVERITY_ERROR, message=message,
+            path=self.path, line=line, symbol=symbol, snippet=snippet,
+            engine="protocol",
+        ))
+
+    def run(self, tree: ast.Module) -> List[Finding]:
+        for qualname, fn in self._functions(tree):
+            # fall-through states: every resource still live leaks
+            chk = _FunctionCheck(self, qualname)
+            final = chk.block(fn.body, {(frozenset(), frozenset())})
+            for st in final:
+                chk._terminal(
+                    st, getattr(fn, "end_lineno", fn.lineno) or fn.lineno,
+                    "falls off the end of the function",
+                )
+            self._teardown_obligations(qualname, fn)
+            self._cow_taint(qualname, fn)
+        return self.findings
+
+    @staticmethod
+    def _functions(tree: ast.Module):
+        out = []
+
+        def walk(node, prefix):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    q = f"{prefix}{child.name}"
+                    out.append((q, child))
+                    walk(child, q + ".")
+                elif isinstance(child, ast.ClassDef):
+                    walk(child, f"{prefix}{child.name}.")
+
+        walk(tree, "")
+        return out
+
+    # -- whole-function obligations ------------------------------------
+
+    def _teardown_obligations(self, qualname: str, fn: ast.AST) -> None:
+        """A function that resets a slot (``self.slots[i] = ...``) retires
+        both reservations: some ``free`` must mention ``.pages`` and —
+        when the function handles prefill-side state at all — some
+        ``free`` must mention ``.prefill_pages``."""
+        reset_line = None
+        frees: Set[str] = set()
+        reads_prefill = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if (
+                        isinstance(t, ast.Subscript)
+                        and _chain(t.value) is not None
+                        and _chain(t.value).endswith("slots")
+                    ):
+                        reset_line = reset_line or node.lineno
+            if isinstance(node, ast.Attribute):
+                if node.attr == "prefill_pages":
+                    reads_prefill = True
+            if isinstance(node, ast.Call):
+                m = _proto_call(node)
+                if m is not None and m[0] == "free":
+                    for a in node.args:
+                        for sub in ast.walk(a):
+                            if isinstance(sub, ast.Attribute) and (
+                                sub.attr in ("pages", "prefill_pages")
+                            ):
+                                frees.add(sub.attr)
+                            elif isinstance(sub, ast.Name) and (
+                                sub.id in ("pages", "prefill_pages")
+                            ):
+                                frees.add(sub.id)
+        if reset_line is None:
+            return
+        if "pages" not in frees:
+            rule = (
+                "dual-reserve-unbalanced" if "prefill_pages" in frees
+                else "page-leak-on-path"
+            )
+            detail = (
+                "frees the prefill-side reservation but not the slot's "
+                "decode pages" if rule == "dual-reserve-unbalanced"
+                else "never frees the slot's pages"
+            )
+            self.emit(
+                rule, reset_line,
+                f"slot reset {detail} — the reservation outlives the slot",
+                qualname,
+            )
+        elif reads_prefill and "prefill_pages" not in frees:
+            self.emit(
+                "dual-reserve-unbalanced", reset_line,
+                "slot reset frees .pages but not .prefill_pages — under "
+                "disaggregation the prefill-side reservation leaks",
+                qualname,
+            )
+
+    def _cow_taint(self, qualname: str, fn: ast.AST) -> None:
+        """Flow-insensitive taint from ``lookup()``'s COW page into any
+        writable page set: the COW page is SHARED (the index and possibly
+        other slots hold it) — decode/chunk writes must target an
+        alloc-backed fork instead."""
+        tainted: Set[str] = set()
+        assigns: List[ast.Assign] = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            assigns.append(node)
+            v = node.value
+            if (
+                isinstance(v, ast.Call)
+                and isinstance(v.func, ast.Attribute)
+                and v.func.attr == "lookup"
+            ):
+                for t in node.targets:
+                    if isinstance(t, ast.Tuple) and len(t.elts) == 3 and (
+                        isinstance(t.elts[2], ast.Name)
+                    ):
+                        tainted.add(t.elts[2].id)
+        if not tainted:
+            return
+        changed = True
+        while changed:
+            changed = False
+            for a in assigns:
+                if _names(a.value) & tainted:
+                    for t in a.targets:
+                        if isinstance(t, ast.Name) and t.id not in tainted:
+                            tainted.add(t.id)
+                            changed = True
+
+        def flag(line: int, where: str) -> None:
+            self.emit(
+                "refcount-escape", line,
+                f"the COW page of a full prefix hit reaches {where} "
+                "without an alloc-backed fork — writes would mutate a "
+                "page other holders read (fork by recomputing into a "
+                "private page instead)",
+                qualname,
+            )
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                if not (_names(node.value) & tainted):
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and (
+                        t.attr in _PAGE_ATTRS
+                    ):
+                        flag(node.lineno, f"a .{t.attr} store")
+                    elif isinstance(t, ast.Subscript):
+                        base = _chain(t.value) or ""
+                        leaf = base.split(".")[-1]
+                        if leaf in ("row", "block_tables"):
+                            flag(node.lineno, f"a {leaf} write")
+            elif isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute
+            ):
+                if node.func.attr == "assign" and any(
+                    _names(a) & tainted for a in node.args
+                ):
+                    flag(node.lineno, "table.assign()")
+
+
+def check_source(
+    source: str, path: str = "<source>"
+) -> Tuple[List[Finding], int]:
+    """Engine G static pass over one module → (findings, suppressed)."""
+    if not any(
+        tok in source for tok in (".alloc(", ".retain(", ".free(")
+    ):
+        return [], 0
+    tree = ast.parse(source, filename=path)
+    findings = _Linter(path, source).run(tree)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return apply_suppressions(
+        findings, SuppressionIndex.from_source(source)
+    )
+
+
+def check_file(path: str) -> Tuple[List[Finding], int]:
+    with open(path, encoding="utf-8") as fh:
+        return check_source(fh.read(), path=path)

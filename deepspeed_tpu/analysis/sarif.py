@@ -1,0 +1,141 @@
+"""SARIF 2.1.0 export for dslint findings (ISSUE 15 satellite).
+
+One ``run`` per engine letter so CI viewers group annotations by plane
+(A:HLO, B:AST, C:concurrency, D:collective, E:memory, F:sharding,
+G:protocol).  Fingerprints ride along in ``partialFingerprints`` under the
+``dslintFingerprint`` key, and findings already accepted by the committed
+baseline are marked ``baselineState: "unchanged"`` (new ones ``"new"``) so
+an annotating CI can highlight only the regressions.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+# Finding.engine tag → CLI engine letter (dsan reports through Engine C's
+# run: same catalog, dynamic half)
+ENGINE_LETTERS: Dict[str, str] = {
+    "hlo": "a",
+    "ast": "b",
+    "concurrency": "c",
+    "dsan": "c",
+    "collective": "d",
+    "mem": "e",
+    "spec": "f",
+    "protocol": "g",
+}
+
+_ENGINE_TITLES: Dict[str, str] = {
+    "a": "HLO program verifier",
+    "b": "AST JAX-footgun lint",
+    "c": "concurrency sanitizer",
+    "d": "collective-consistency verifier",
+    "e": "static HBM liveness",
+    "f": "sharding-spec verifier",
+    "g": "serving-protocol checker",
+}
+
+
+def _level(severity: str) -> str:
+    return "error" if severity == "error" else "warning"
+
+
+def _uri(path: str) -> str:
+    # hlo://<program> and model://<scope> pseudo-paths are already URIs;
+    # real paths become relative file URIs
+    if "://" in path:
+        return path
+    return path.replace("\\", "/")
+
+
+def _result(finding, known: bool) -> dict:
+    res = {
+        "ruleId": finding.rule,
+        "level": _level(finding.severity),
+        "message": {"text": finding.message},
+        "locations": [
+            {
+                "physicalLocation": {
+                    "artifactLocation": {"uri": _uri(finding.path)},
+                    "region": {"startLine": max(1, int(finding.line or 1))},
+                }
+            }
+        ],
+        "partialFingerprints": {"dslintFingerprint": finding.fingerprint()},
+        "baselineState": "unchanged" if known else "new",
+    }
+    if finding.snippet:
+        res["locations"][0]["physicalLocation"]["region"]["snippet"] = {
+            "text": finding.snippet
+        }
+    return res
+
+
+def sarif_report(
+    findings: Iterable,
+    known_fingerprints: Iterable[str] = (),
+    engines: Optional[Iterable[str]] = None,
+) -> dict:
+    """Build a SARIF 2.1.0 document — one run per engine letter.
+
+    ``engines`` forces a run object for every selected letter even when it
+    produced no findings, so a CI consumer can distinguish "engine ran
+    clean" from "engine not selected".
+    """
+    from . import ENGINE_RULES
+
+    known = set(known_fingerprints)
+    by_letter: Dict[str, List] = {
+        letter: [] for letter in sorted(engines or ())
+    }
+    for f in findings:
+        letter = ENGINE_LETTERS.get(f.engine)
+        if letter is None:  # unknown plane: keep it visible under its tag
+            letter = f.engine
+        by_letter.setdefault(letter, []).append(f)
+
+    runs = []
+    for letter in sorted(by_letter):
+        catalog = ENGINE_RULES.get(letter, {})
+        runs.append(
+            {
+                "tool": {
+                    "driver": {
+                        "name": f"dslint-{letter}",
+                        "informationUri": "https://example.invalid/dslint",
+                        "semanticVersion": "1.0.0",
+                        "shortDescription": {
+                            "text": _ENGINE_TITLES.get(
+                                letter, f"engine {letter}"
+                            )
+                        },
+                        "rules": [
+                            {
+                                "id": rule,
+                                "shortDescription": {"text": desc},
+                            }
+                            for rule, desc in sorted(catalog.items())
+                        ],
+                    }
+                },
+                "columnKind": "utf16CodeUnits",
+                "results": [
+                    _result(f, f.fingerprint() in known)
+                    for f in sorted(
+                        by_letter[letter],
+                        key=lambda f: (f.path, f.line, f.rule),
+                    )
+                ],
+            }
+        )
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": runs,
+    }

@@ -110,7 +110,12 @@ def main() -> int:
         from deepspeed_tpu.analysis import runtime_sanitizer as _dsan
         from deepspeed_tpu.tools.dslint import _find_baseline
 
-        from deepspeed_tpu.analysis import MEMORY_RULES, SHARDING_RULES
+        from deepspeed_tpu.analysis import (
+            MEMORY_RULES,
+            PROTOCOL_MODEL_RULES,
+            PROTOCOL_RULES,
+            SHARDING_RULES,
+        )
 
         print(
             f"engines ............. {GREEN_OK} "
@@ -118,7 +123,9 @@ def main() -> int:
             f"C:concurrency ({len(CONCURRENCY_RULES)}) + "
             f"D:collective ({len(COLLECTIVE_RULES)}) + "
             f"E:memory ({len(MEMORY_RULES)}) + "
-            f"F:sharding ({len(SHARDING_RULES)}) rules"
+            f"F:sharding ({len(SHARDING_RULES)}) + "
+            f"G:protocol ({len(PROTOCOL_RULES) + len(PROTOCOL_MODEL_RULES)}) "
+            "rules"
         )
         san = _dsan.active()
         print(
@@ -309,6 +316,65 @@ def main() -> int:
         )
     except Exception as e:
         print(f"serving placement ... {RED_NO} ({type(e).__name__}: {e})")
+    print("-" * 60)
+    print("Protocol (dsproto, ISSUE 15):")
+    try:
+        import json
+        import os
+
+        from deepspeed_tpu.analysis import (
+            PROTOCOL_MODEL_RULES,
+            PROTOCOL_RULES,
+        )
+        from deepspeed_tpu.runtime.config import AnalysisConfig
+
+        pcfg = AnalysisConfig().protocol
+        print(
+            f"engine G rules ...... {GREEN_OK} "
+            f"{len(PROTOCOL_RULES)} ownership-lint (page-leak-on-path, "
+            f"refcount-escape, ...) + {len(PROTOCOL_MODEL_RULES)} model "
+            "invariants (proto-page-leak, proto-request-wedged, ...)"
+        )
+        print(
+            f"model bounds ........ requests={pcfg.requests} "
+            f"prompt_pages={pcfg.prompt_pages} new_tokens={pcfg.new_tokens} "
+            f"retry_max={pcfg.retry_max} max_states={pcfg.max_states} "
+            "(analysis.protocol)"
+        )
+        # exploration stats come from the committed bench artifact —
+        # env_report stays cheap (no state-space walk here)
+        bench_path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "BENCH_pr15.json",
+        )
+        if os.path.exists(bench_path):
+            with open(bench_path, encoding="utf-8") as fh:
+                doc = json.load(fh)
+            for mode, rec in sorted((doc.get("model") or {}).items()):
+                print(
+                    f"  {mode:<18} {rec.get('states')} states / "
+                    f"{rec.get('transitions')} transitions in "
+                    f"{rec.get('wall_s')}s, "
+                    f"{rec.get('violations', 0)} violation(s)"
+                )
+            replay = doc.get("replay_self_check")
+            if replay is not None:
+                print(
+                    f"  replay self-check  "
+                    f"{GREEN_OK if replay.get('ok') else RED_NO} "
+                    f"(mutations red: "
+                    f"{', '.join(replay.get('mutations_red', []))})"
+                )
+        else:
+            print("  exploration ........ unmeasured — run bench.py "
+                  "(BENCH_DSPROTO_ONLY=1)")
+        print(
+            "run checker ......... python -m deepspeed_tpu.tools.dslint "
+            "deepspeed_tpu/serving/ --engines g (model counterexamples "
+            "replay via analysis.protocol_model.replay_trace)"
+        )
+    except Exception as e:
+        print(f"protocol ............ {RED_NO} ({type(e).__name__}: {e})")
     print("-" * 60)
     return 0
 

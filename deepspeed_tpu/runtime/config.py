@@ -626,6 +626,44 @@ class ShardingAnalysisConfig(DSConfigModel):
 
 
 @dataclass
+class ProtocolAnalysisConfig(DSConfigModel):
+    """analysis.protocol section (ISSUE 15 tentpole): Engine G, the
+    serving-protocol plane (``analysis/protocol_rules.py`` +
+    ``analysis/protocol_model.py``). ``lint`` runs the AST page-ownership
+    dataflow lint over the serving sources (page-leak-on-path, double-free,
+    use-after-free, refcount-escape, dual-reserve-unbalanced); ``model``
+    runs the bounded explicit-state model checker over the abstract
+    scheduler (refcount conservation, quiescence leaks, use-after-free,
+    wedges, the disagg dual-reserve invariant) with minimal counterexample
+    traces replayable on the real engine. ``requests`` / ``prompt_pages``
+    / ``new_tokens`` / ``retry_max`` bound the abstract state space;
+    ``max_states`` caps the search (a truncated search reports
+    ``complete=False`` rather than firing)."""
+
+    enabled: bool = True
+    lint: bool = True
+    model: bool = True
+    requests: int = 2
+    prompt_pages: int = 2
+    new_tokens: int = 2
+    retry_max: int = 1
+    max_states: int = 200_000
+
+    def __post_init__(self):
+        for name in ("requests", "prompt_pages", "new_tokens", "max_states"):
+            if int(getattr(self, name)) < 1:
+                raise DeepSpeedConfigError(
+                    f"analysis.protocol.{name} must be >= 1, got "
+                    f"{getattr(self, name)}"
+                )
+        if self.retry_max < 0:
+            raise DeepSpeedConfigError(
+                "analysis.protocol.retry_max must be >= 0, got "
+                f"{self.retry_max}"
+            )
+
+
+@dataclass
 class AnalysisConfig(DSConfigModel):
     """analysis section (ISSUE 6 tentpole): dslint, the graph & sharding
     static-analysis plane (``deepspeed_tpu/analysis/``). Engine A verifies
@@ -667,6 +705,10 @@ class AnalysisConfig(DSConfigModel):
     )
     sharding: ShardingAnalysisConfig = field(
         default_factory=ShardingAnalysisConfig
+    )
+    # ISSUE 15: Engine G (serving-protocol ownership lint + model checker)
+    protocol: ProtocolAnalysisConfig = field(
+        default_factory=ProtocolAnalysisConfig
     )
 
     def __post_init__(self):

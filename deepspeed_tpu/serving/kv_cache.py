@@ -113,10 +113,44 @@ class PageAllocator:
                 del self._refs[p]
                 self._free.append(p)
 
+    def check_consistent(self) -> Optional[str]:
+        """Validate the allocator's internal accounting (Engine G monitor).
+
+        Returns ``None`` when healthy, else a one-line description of the
+        corruption.  Unlike :meth:`check_no_leaks` this holds at ANY point
+        in the protocol, not just at quiescence: the free list and the
+        refcount table must partition the pool exactly."""
+        fset = set(self._free)
+        if len(fset) != len(self._free):
+            dups = sorted(p for p in fset if self._free.count(p) > 1)
+            return f"free list has duplicate pages: {dups[:4]}"
+        if SCRATCH_PAGE in fset or SCRATCH_PAGE in self._refs:
+            return "scratch page entered the pool"
+        overlap = fset & set(self._refs)
+        if overlap:
+            return f"pages both free and in use: {sorted(overlap)[:4]}"
+        bad = sorted(p for p, c in self._refs.items() if c < 1)
+        if bad:
+            return f"pages with non-positive refcounts: {bad[:4]}"
+        if len(fset) + len(self._refs) != self.capacity:
+            return (
+                f"page conservation violated: {len(fset)} free + "
+                f"{len(self._refs)} in use != capacity {self.capacity}"
+            )
+        oob = sorted(
+            p for p in fset | set(self._refs) if not 1 <= p < self.num_pages
+        )
+        if oob:
+            return f"page ids out of range: {oob[:4]}"
+        return None
+
     def check_no_leaks(self, allowed: Optional[Sequence[int]] = None) -> None:
         """Raise unless every in-use page is in ``allowed`` (default: none) —
         and every allowed page holds EXACTLY one reference (the holder that
         declared it, e.g. the prefix index after all slots drained)."""
+        err = self.check_consistent()
+        if err:
+            raise PageAllocatorError(f"allocator state corrupt: {err}")
         allowed_set = {int(p) for p in (allowed or ())}
         leaked = sorted(p for p in self._refs if p not in allowed_set)
         if leaked:

@@ -75,6 +75,7 @@ from ..telemetry.request_trace import LATENCY_BUCKETS, RequestTracer
 from ..utils.logging import log_dist
 from . import model as smodel
 from .kv_cache import (
+    PageAllocatorError,
     PrefixCache,
     SlotTable,
     pages_for,
@@ -1285,19 +1286,33 @@ class ServingEngine:
             if cow_page is not None:
                 self.prefill_set.allocator.cow_forks_total += 1
                 self._c_cow.inc()
-        if self.disaggregated:
-            # two reservations: prompt pages on the prefill placement
-            # (shared + private — the handoff reads and then frees the
-            # private ones), the FULL reservation as private pages on the
-            # decode placement (the handoff scatters the prompt KV in)
-            p_priv = self.prefill_set.allocator.alloc(
-                pages_for(req.prompt_len, page) - len(shared)
+        p_priv: List[int] = []
+        try:
+            if self.disaggregated:
+                # two reservations: prompt pages on the prefill placement
+                # (shared + private — the handoff reads and then frees the
+                # private ones), the FULL reservation as private pages on the
+                # decode placement (the handoff scatters the prompt KV in)
+                p_priv = self.prefill_set.allocator.alloc(
+                    pages_for(req.prompt_len, page) - len(shared)
+                )
+                prefill_pages = shared + p_priv
+                pages = self.allocator.alloc(total)
+            else:
+                prefill_pages = []
+                pages = shared + self.allocator.alloc(total - len(shared))
+        except PageAllocatorError as e:
+            # dual-reserve rollback: a raising alloc must not strand the
+            # prefix retains or the other pool's reservation — the admission
+            # either holds everything it needs or holds nothing (one free
+            # call so the rollback itself has no partial-release edge)
+            rollback = p_priv + shared
+            if rollback:
+                self.prefill_set.allocator.free(rollback)
+            self._retry_or_fail(
+                req, f"admission reservation failed: {e}", self.clock()
             )
-            prefill_pages = shared + p_priv
-            pages = self.allocator.alloc(total)
-        else:
-            prefill_pages = []
-            pages = shared + self.allocator.alloc(total - len(shared))
+            return
         slot = self.slots[slot_i]
         slot.request = req
         slot.pages = pages
@@ -1661,6 +1676,13 @@ class ServingEngine:
             self.prefill_set.allocator.free(slot.prefill_pages)
         self.table.clear(slot_i)
         self.slots[slot_i] = _Slot()
+        self._retry_or_fail(req, why, now)
+
+    def _retry_or_fail(self, req: Request, why: str, now: float) -> None:
+        """Requeue-with-backoff or terminal-FAIL a request whose pages and
+        slot (if any) are already reclaimed. Shared by transient slot
+        failures and admission-reservation failures; deliberately performs
+        no allocator operations."""
         retry_max = int(getattr(self.config, "retry_max", 0))
         if not self._draining and req.retries < retry_max:
             req.retries += 1
@@ -1820,8 +1842,11 @@ class ServingEngine:
         fp32 upcasts (``no-fp32-upcast``); the handoff gather is the one
         deliberate exception (its source pool must stay live for the
         prefix index). Engine D checks the cross-program collective order;
-        Engine E the per-device HBM peaks against the ledger. Returns
-        findings; empty = clean."""
+        Engine E the per-device HBM peaks against the ledger. Engine G
+        (ISSUE 15) closes the pass: the page-ownership dataflow lint over
+        the serving sources plus the bounded protocol model checker in this
+        engine's mode (shared vs disaggregated), whose violations carry
+        minimal counterexample traces. Returns findings; empty = clean."""
         from ..runtime.config import AnalysisConfig
         from .. import analysis as dsa
 
@@ -1936,6 +1961,42 @@ class ServingEngine:
                 )
                 findings.extend(mem_findings)
                 self._memory_analyses[name] = ana
+        # Engine G (ISSUE 15): the serving-protocol plane. The ownership
+        # lint re-audits the serving sources this engine is running, and
+        # the bounded model checker explores the abstract protocol in THIS
+        # engine's mode (shared vs disaggregated page pools) — a violation
+        # carries a minimal counterexample trace replayable via
+        # analysis.protocol_model.replay_trace.
+        pcfg = getattr(acfg, "protocol", None)
+        if pcfg is not None and getattr(pcfg, "enabled", True):
+            import os as _os
+
+            from ..analysis import protocol_model as dsproto
+            from ..analysis import protocol_rules as dsprot
+
+            if getattr(pcfg, "lint", True):
+                serving_dir = _os.path.dirname(_os.path.abspath(__file__))
+                for fname in sorted(_os.listdir(serving_dir)):
+                    if fname.endswith(".py"):
+                        got, _w = dsprot.check_file(
+                            _os.path.join(serving_dir, fname)
+                        )
+                        findings.extend(got)
+            if getattr(pcfg, "model", True):
+                mcfg = dsproto.ProtoModelConfig(
+                    requests=int(getattr(pcfg, "requests", 2)),
+                    slots=min(self.max_slots,
+                              int(getattr(pcfg, "requests", 2))),
+                    prompt_pages=int(getattr(pcfg, "prompt_pages", 2)),
+                    new_tokens=int(getattr(pcfg, "new_tokens", 2)),
+                    disaggregated=self.disaggregated,
+                    prefix_cache=self.prefix_cache is not None,
+                    retry_max=int(getattr(pcfg, "retry_max", 1)),
+                    max_states=int(getattr(pcfg, "max_states", 200_000)),
+                )
+                findings.extend(
+                    dsproto.model_findings(dsproto.explore(mcfg))
+                )
         return findings
 
     def _metadata_dims(self) -> tuple:

@@ -15,9 +15,14 @@ the committed baseline (``.dslint-baseline.json``): findings already in the
 baseline are reported but do not fail; NEW findings exit 1.
 ``--update-baseline`` rewrites the ledger from the current findings —
 entries whose finding disappeared expire, so the debt only shrinks.
-``--engines a,b,c,d,e,f`` selects engines (default: all six; Engine F
-needs a live param tree — it runs via ``engine.verify_program()`` and the
-dsmem tests, the CLI only lists its catalog).
+``--engines a..g`` selects engines (default: all seven; Engine F needs a
+live param tree — it runs via ``engine.verify_program()`` and the dsmem
+tests, the CLI only lists its catalog). Engine G (ISSUE 15) adds the
+serving-protocol plane: the page-ownership dataflow lint runs over every
+``*.py`` scanned, and a scan covering ``serving/`` also runs the bounded
+protocol model checker (violations carry ``model://`` pseudo-paths with
+minimal counterexample traces). ``--sarif OUT.sarif`` additionally writes
+a SARIF 2.1.0 document — one run per engine — for CI inline annotations.
 
 ``--changed`` lints just the files git reports as modified/staged/untracked
 — the cheap per-PR gate; the committed baseline makes the full run
@@ -173,7 +178,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                    "c (AST concurrency sanitizer), d (HLO collective "
                    "consistency), e (static HBM liveness + budgets over "
                    "*.hlo dumps), f (sharding-spec tables — live trees "
-                   "only, catalog via --list-rules). Default: all")
+                   "only, catalog via --list-rules), g (serving-protocol "
+                   "ownership lint + bounded model checker). Default: all")
     p.add_argument("--baseline", default=None,
                    help=f"baseline file (default: nearest {DEFAULT_BASELINE_NAME})")
     p.add_argument("--config", default=None,
@@ -186,6 +192,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--no-baseline", action="store_true",
                    help="ignore any baseline: every finding fails")
     p.add_argument("--json", action="store_true", help="machine-readable report")
+    p.add_argument("--sarif", default=None, metavar="OUT",
+                   help="also write a SARIF 2.1.0 report (one run per "
+                   "engine) to OUT for CI inline annotations")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalog and exit")
     args = p.parse_args(argv)
@@ -269,6 +278,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     baseline: Baseline = report.pop("_baseline")
     findings = report.pop("_findings")
     scanned = report.pop("_scanned")
+
+    if args.sarif:
+        from ..analysis.sarif import sarif_report
+
+        known_fps = {f.fingerprint() for f in report["known"]}
+        doc = sarif_report(findings, known_fingerprints=known_fps,
+                           engines=engines)
+        try:
+            with open(args.sarif, "w", encoding="utf-8") as fh:
+                json.dump(doc, fh, indent=1)
+                fh.write("\n")
+        except OSError as e:
+            print(f"dslint: cannot write --sarif {args.sarif!r}: {e}",
+                  file=sys.stderr)
+            return EXIT_USAGE
+        print(f"dslint: SARIF report ({len(doc['runs'])} runs) -> {args.sarif}")
 
     if args.update_baseline:
         if engines != ALL_ENGINES:
