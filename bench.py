@@ -1005,6 +1005,249 @@ def run_replay_bench():
     return pr11
 
 
+def run_kv_heat_bench():
+    """BENCH_pr16.json (ISSUE 16): the page-lifetime / session-heat
+    measurement plane.
+
+    Two measurement modes over the PR-11 seeded workload (diurnal + bursty
+    + hot-tenant prefix skew, 0.5/1/2x estimated capacity):
+
+    - DETERMINISTIC curves: each load level replayed on a virtual
+      ReplayClock (step_dt = the probed per-step time) with the heat
+      tracer on — the committed cold-fraction-vs-time curve per level plus
+      the end-of-trace occupancy split, and the what-if spill evaluator's
+      policy comparison on the 1x trace. Same seed → byte-identical trace
+      → identical curves.
+    - OVERHEAD pin: realtime replays with every ledger hook wrapped in a
+      perf_counter accumulator; the pinned number is hook-seconds over the
+      traced serving span (the PR-11 methodology — the ratio of two
+      in-process timers is VM-steal-immune), ≤ 2%.
+
+    Every level's ledger must reconcile bit-exact against the live
+    allocator at drain. A CLI self-check (report + self-diff + what-if,
+    all exit 0) proves the gate wiring. BENCH_KVHEAT_ONLY=1 standalone."""
+    import contextlib
+    import io
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.models import gpt2
+    from deepspeed_tpu.serving import (
+        ReplayClock,
+        WorkloadSpec,
+        generate_workload,
+        replay,
+    )
+    from deepspeed_tpu.telemetry.kv_heat import (
+        IDLE_THRESHOLDS_S,
+        KVHeatTracer,
+        cold_fraction_curve,
+        evaluate_spill_policies,
+        load_heat_records,
+    )
+    from deepspeed_tpu.tools import kv_heat as kh_cli
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    model_name = os.environ.get(
+        "BENCH_SERVING_MODEL", "gpt2" if on_tpu else "gpt2-tiny"
+    )
+    cfg = gpt2.get_config(model_name)
+    params = jax.jit(lambda r: gpt2.init_params(cfg, r))(jax.random.PRNGKey(0))
+    eng = InferenceEngine(
+        gpt2.make_module(cfg), params=params,
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+    )
+    n_new = 64
+    scfg = {
+        "max_slots": int(os.environ.get("BENCH_SERVING_SLOTS", "8" if on_tpu else "4")),
+        "page_size": 16 if on_tpu else 4,
+        "num_pages": 2048 if on_tpu else 128,
+        "max_prompt_len": 128 if on_tpu else 12,
+        "max_new_tokens": n_new,
+        "max_queue_depth": 256,
+        # prefix sharing ON: the heat plane's prefix/shared occupancy
+        # categories and the prefix-aware spill policy need real hits
+        "prefix_cache": {"enabled": True},
+    }
+    n_req = int(os.environ.get("BENCH_KVHEAT_REQUESTS", "48"))
+    repeats = int(os.environ.get("BENCH_KVHEAT_REPEATS", "3"))
+
+    # capacity probe, saturated (run_replay_bench's rationale)
+    srv0 = eng.serve(scfg)
+    rs = np.random.RandomState(0)
+    warm = rs.randint(0, cfg.vocab_size, (scfg["max_prompt_len"],)).astype(np.int32)
+    srv0.submit(warm, max_new_tokens=n_new)
+    srv0.run()
+    t0 = _time.monotonic()
+    for _ in range(2 * scfg["max_slots"]):
+        srv0.submit(warm, max_new_tokens=n_new)
+    srv0.run()
+    sat_wall = max(_time.monotonic() - t0, 1e-9)
+    cap_rps = 2 * scfg["max_slots"] / sat_wall
+    step_s = max(scfg["max_slots"] / (cap_rps * n_new), 1e-5)
+
+    def mk_workload(load):
+        return generate_workload(WorkloadSpec(
+            n_requests=n_req, seed=int(load * 100), vocab_size=cfg.vocab_size,
+            max_prompt_len=scfg["max_prompt_len"], max_new_tokens=n_new,
+            base_interarrival_s=1.0 / (cap_rps * load),
+            diurnal_amplitude=0.6, diurnal_period_s=n_req / (2 * cap_rps * load),
+            burst_factor=3.0, burst_duty=0.2,
+            prompt_len_median=scfg["max_prompt_len"] / 3,
+            prompt_len_sigma=0.6, n_tenants=4, prefix_fraction=0.5,
+        ))
+
+    workloads = {load: mk_workload(load) for load in (0.5, 1.0, 2.0)}
+
+    trace_dir = os.path.join(_BENCH_DIR, ".bench_kvheat")
+    shutil.rmtree(trace_dir, ignore_errors=True)
+    os.makedirs(trace_dir, exist_ok=True)
+
+    # --- deterministic mode: virtual-clock replays, one per load level ---
+    # idle thresholds scaled into the VIRTUAL timebase (step_dt per decode
+    # step): 50/200/1000 steps of idleness — the wall-clock defaults
+    # (1/5/30s) never trip inside a sub-second virtual span
+    v_thresholds = tuple(round(k * step_s, 6) for k in (50, 200, 1000))
+    curve_th = v_thresholds[1]
+    cold, reconcile_ok, trace_1x = {}, True, None
+    for load, items in workloads.items():
+        clk = ReplayClock()
+        tr = KVHeatTracer(
+            os.path.join(trace_dir, f"heat.{load}.jsonl"),
+            flush_interval=64, clock=clk, idle_thresholds_s=v_thresholds,
+        )
+        srv = eng.serve(dict(scfg), clock=clk, heat_tracer=tr)
+        res = replay(srv, items, step_dt=step_s)
+        pool = srv.decode_placement.name
+        led = tr.ledgers[pool]
+        err = led.reconcile(srv.allocator, srv.prefix_cache)
+        reconcile_ok = reconcile_ok and err is None
+        end_occ = led.occupancy(clk(), v_thresholds)
+        srv.release_prefix_cache()
+        srv.check_no_leaks()
+        tr.flush()
+        tr.close()
+        records = load_heat_records(tr.file_path)
+        curve = cold_fraction_curve(records, pool, curve_th, bins=10)
+        cold[f"load_{load}"] = {
+            "offered_load": load,
+            "steps": res["steps"],
+            "virtual_span_s": round(clk(), 3),
+            "end": end_occ["cold_fraction"],
+            "pages": end_occ["pages"],
+            "fragmentation": end_occ["fragmentation"],
+            "curve_threshold_s": curve_th,
+            "curve": [
+                {
+                    "t": round(pt["t"], 3),
+                    "cold_fraction": (
+                        round(pt["cold_fraction"], 4)
+                        if pt["cold_fraction"] is not None else None
+                    ),
+                    "pages_in_use": pt["pages_in_use"],
+                }
+                for pt in curve
+            ],
+            "reconcile": err or "ok",
+        }
+        if load == 1.0:
+            trace_1x = (tr.file_path, pool)
+
+    # the what-if spill evaluator on the 1x trace: the recorded stream
+    # against a half-capacity resident set under each candidate policy
+    resident_fraction = float(os.environ.get("BENCH_KVHEAT_RESIDENT", "0.5"))
+    spill = evaluate_spill_policies(
+        load_heat_records(trace_1x[0]), trace_1x[1],
+        resident_fraction=resident_fraction,
+    )
+
+    # --- overhead pin: realtime replays, ledger hooks perf_counter-wrapped ---
+    hook_s = [0.0]
+
+    def _timed(fn):
+        def w(*a, **k):
+            t0 = _time.perf_counter()
+            try:
+                return fn(*a, **k)
+            finally:
+                hook_s[0] += _time.perf_counter() - t0
+        return w
+
+    def _instrument(led):
+        for name in ("alloc", "retain", "free", "register", "hit", "evict",
+                     "session_start", "session_end", "touch_step"):
+            setattr(led, name, _timed(getattr(led, name)))
+        return led
+
+    srv_on = eng.serve(dict(scfg))
+    srv_on.submit(warm, max_new_tokens=n_new)   # compile outside the window
+    srv_on.run()
+    traced_span_s = 0.0
+    for rep in range(repeats):
+        tr = KVHeatTracer(
+            os.path.join(trace_dir, f"heat_ov.{rep}.jsonl"), flush_interval=64,
+        )
+        srv_on.attach_heat(tr)
+        for led in tr.ledgers.values():
+            _instrument(led)
+        for load, items in workloads.items():
+            res = replay(srv_on, items)
+            traced_span_s += res["duration_s"]
+            srv_on.check_no_leaks()
+        srv_on.detach_heat()
+        tr.close()
+    overhead_pct = (
+        round(hook_s[0] / traced_span_s * 100.0, 3) if traced_span_s else None
+    )
+
+    # CLI self-check: report + self-diff + what-if all exit 0
+    sink = io.StringIO()
+    with contextlib.redirect_stdout(sink):
+        rc_report = kh_cli.main([trace_1x[0], "--heatmap", "--bins", "8"])
+        rc_diff = kh_cli.main([trace_1x[0], "--diff", trace_1x[0]])
+        rc_whatif = kh_cli.main([trace_1x[0], "--what-if"])
+
+    pr16 = {
+        "schema": "bench_pr16_kvheat_v1",
+        "model": model_name,
+        "backend": jax.default_backend(),
+        "serving_config": scfg,
+        "capacity_rps_estimate": round(cap_rps, 3),
+        "requests_per_level": n_req,
+        "step_dt_s": round(step_s, 6),
+        "idle_thresholds_s": list(IDLE_THRESHOLDS_S),
+        "virtual_idle_thresholds_s": list(v_thresholds),
+        "virtual_idle_thresholds_steps": [50, 200, 1000],
+        "cold_fraction": cold,
+        "spill_policies": {
+            "resident_fraction": spill["resident_fraction"],
+            "resident_cap": spill["resident_cap"],
+            "capacity": spill["capacity"],
+            "page_bytes": spill["page_bytes"],
+            "policies": spill["policies"],
+        },
+        "reconcile_ok": reconcile_ok,
+        "overhead": {
+            "heat_overhead_pct": overhead_pct,
+            "heat_overhead_ok": overhead_pct is not None and overhead_pct <= 2.0,
+            "heat_hook_s": round(hook_s[0], 4),
+            "traced_span_s": round(traced_span_s, 3),
+            "repeats": repeats,
+        },
+        "cli_selfcheck": {
+            "report_exit": rc_report, "self_diff_exit": rc_diff,
+            "what_if_exit": rc_whatif,
+            "ok": rc_report == 0 and rc_diff == 0 and rc_whatif == 0,
+        },
+    }
+    with open(os.path.join(_BENCH_DIR, "BENCH_pr16.json"), "w") as fh:
+        json.dump(pr16, fh, indent=1)
+    return pr16
+
+
 def run_kv_quant_bench():
     """BENCH_pr12.json (ISSUE 12): quantized KV pages + quantized remaining
     wire. Four measurements:
@@ -2290,6 +2533,19 @@ def main():
             result["replay_slo_by_class"] = pr11["slo_by_class_at_capacity"]
         except Exception as e:
             result["pr11_error"] = f"{type(e).__name__}: {e}"
+    # --- BENCH_pr16.json (ISSUE 16): page-lifetime / session-heat plane —
+    # cold-fraction curves per load level, the what-if spill-policy
+    # comparison and the ledger-hook overhead pin
+    if os.environ.get("BENCH_SERVING", "1") == "1":
+        try:
+            pr16 = run_kv_heat_bench()
+            result["pr16_artifact"] = "BENCH_pr16.json"
+            result["kv_heat_overhead_pct"] = (
+                pr16["overhead"]["heat_overhead_pct"]
+            )
+            result["kv_heat_reconcile_ok"] = pr16["reconcile_ok"]
+        except Exception as e:
+            result["pr16_error"] = f"{type(e).__name__}: {e}"
     # --- BENCH_pr12.json (ISSUE 12): int8 KV pages + quantized remaining
     # wire — Engine E kv-pool bf16-vs-int8, resident sessions at fixed HBM,
     # decode latency at the 151MB-equivalent pool, and the two new
@@ -2432,6 +2688,9 @@ if __name__ == "__main__":
     elif os.environ.get("BENCH_REPLAY_ONLY", "0") == "1":
         # ISSUE 11: just the trace-replay harness (BENCH_pr11.json)
         print(json.dumps(run_replay_bench()))
+    elif os.environ.get("BENCH_KVHEAT_ONLY", "0") == "1":
+        # ISSUE 16: just the page-heat measurement plane (BENCH_pr16.json)
+        print(json.dumps(run_kv_heat_bench()))
     elif os.environ.get("BENCH_KVQUANT_ONLY", "0") == "1":
         # ISSUE 12: just the KV-quantization + compressed-wire bench
         # (BENCH_pr12.json) — pins 8 host devices so the collective paths

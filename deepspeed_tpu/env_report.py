@@ -376,6 +376,65 @@ def main() -> int:
     except Exception as e:
         print(f"protocol ............ {RED_NO} ({type(e).__name__}: {e})")
     print("-" * 60)
+    print("KV heat (ISSUE 16):")
+    try:
+        import json
+        import os
+
+        from deepspeed_tpu.runtime.config import KVHeatConfig
+        from deepspeed_tpu.telemetry.kv_heat import SCHEMA as HEAT_SCHEMA
+
+        hcfg = KVHeatConfig()
+        print(
+            f"page-heat tracing ... {GREEN_OK} schema {HEAT_SCHEMA} "
+            "(telemetry.kv_heat — per-page lifecycle events + per-step "
+            "touch columns, host-side mirror reconciles bit-exact against "
+            "PageAllocator)"
+        )
+        print(
+            f"idle thresholds ..... {list(hcfg.idle_thresholds_s)} s "
+            f"(cold-fraction gauges; segment_events={hcfg.segment_events}, "
+            f"flush_interval={hcfg.flush_interval})"
+        )
+        # headline curves come from the committed bench artifact —
+        # env_report stays cheap (no serving replay here)
+        bench_path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "BENCH_pr16.json",
+        )
+        if os.path.exists(bench_path):
+            with open(bench_path, encoding="utf-8") as fh:
+                doc = json.load(fh)
+            ov = (doc.get("overhead") or {}).get("heat_overhead_pct")
+            if ov is not None:
+                print(f"  hook overhead ...... {ov}% of traced span "
+                      "(pin: <= 2%)")
+            for name, rec in sorted((doc.get("cold_fraction") or {}).items()):
+                end = rec.get("end") or {}
+                cf = ", ".join(
+                    f">{th}s: {100.0 * f:.0f}%" if f is not None else f">{th}s: -"
+                    for th, f in sorted(end.items(), key=lambda kv: float(kv[0]))
+                )
+                print(f"  {name:<18} {cf}")
+            pol = (doc.get("spill_policies") or {}).get("policies") or {}
+            if pol:
+                best = min(
+                    pol.items(),
+                    key=lambda kv: (kv[1].get("restore_stalls", 0),
+                                    kv[1].get("spills", 0), kv[0]),
+                )[0]
+                print(f"  spill what-if ...... fewest restore stalls: {best}")
+        else:
+            print("  curves ............. unmeasured — run bench.py "
+                  "(BENCH_KVHEAT_ONLY=1)")
+        print(
+            "report CLI .......... python -m deepspeed_tpu.tools.kv_heat "
+            "kv_heat.jsonl [--heatmap] [--page N] [--what-if] "
+            "[--min-cold-fraction PCT]"
+        )
+    except Exception as e:
+        print(f"kv heat ............. {RED_NO} ({type(e).__name__}: {e})")
+    print("-" * 60)
     return 0
 
 

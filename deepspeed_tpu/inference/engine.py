@@ -339,7 +339,8 @@ class InferenceEngine:
             bucket = 1 << max(0, S - 1).bit_length()
         return max(S, min(bucket, cap))
 
-    def serve(self, serving_config=None, clock=None, tracer=None):
+    def serve(self, serving_config=None, clock=None, tracer=None,
+              heat_tracer=None):
         """Continuous-batching server over this engine (serving/scheduler.py):
         a paged KV pool + slot-based decode loop over a fixed set of AOT
         executables (prefill + decode, plus speculative verify / chunked
@@ -354,7 +355,7 @@ class InferenceEngine:
         cfg = serving_config if serving_config is not None else self._serving_config
         return ServingEngine(
             self, cfg, clock=clock if clock is not None else _time.monotonic,
-            tracer=tracer,
+            tracer=tracer, heat_tracer=heat_tracer,
         )
 
     def _telemetry_generate(self, duration_s: float, batch: int, prompt_len: int, new_tokens: int, cached: Optional[bool]) -> None:

@@ -483,6 +483,60 @@ class RequestTraceConfig(DSConfigModel):
 
 
 @dataclass
+class KVHeatConfig(DSConfigModel):
+    """telemetry.kv_heat section (ISSUE 16 tentpole): the page-lifetime /
+    session-heat tracing plane (``telemetry/kv_heat.py``) — the memory
+    measurement plane KV tiering (ROADMAP item 2) ships against. When
+    enabled, a :class:`~deepspeed_tpu.telemetry.kv_heat.KVHeatTracer`
+    records per-pool page lifecycle events (allocator alloc/retain/free,
+    prefix-index register/hit/evict, session start/end) plus a columnar
+    per-decode-step touch series, and emits schema-versioned
+    (``dstpu-kvheat-v1``) segment records through the StepTracer machinery
+    — buffered appends, size-capped atomic rotation (``max_mb`` →
+    ``<file>.1``), background JSON encode. All recording is host-side list
+    appends off the engine's injectable clock: no device syncs, no
+    wall-clock fields (seeded replays are byte-deterministic), bench pins
+    hook overhead ≤ 2% of the traced serving span. ``path`` "" puts
+    ``kv_heat.jsonl`` under ``telemetry.trace_path``. ``segment_events``
+    bounds one segment record's event count (the seal threshold).
+    ``idle_thresholds_s`` are the cold-page-fraction gauge thresholds
+    (ascending seconds). Consumed by ``ServingEngine`` (the scheduler
+    attaches ledgers per placement pool), ``tools/kv_heat.py`` (report /
+    timeline / heatmap / what-if spill CLI) and bench.py's
+    ``run_kv_heat_bench``."""
+
+    enabled: bool = False
+    path: str = ""  # "" = <telemetry.trace_path>/kv_heat.jsonl
+    flush_interval: int = 20
+    max_mb: int = 64  # 0 = unbounded
+    segment_events: int = 256
+    idle_thresholds_s: tuple = (1.0, 5.0, 30.0)
+
+    def __post_init__(self):
+        if int(self.flush_interval) < 1:
+            raise DeepSpeedConfigError(
+                "telemetry.kv_heat.flush_interval must be >= 1, got "
+                f"{self.flush_interval}"
+            )
+        if int(self.segment_events) < 1:
+            raise DeepSpeedConfigError(
+                "telemetry.kv_heat.segment_events must be >= 1, got "
+                f"{self.segment_events}"
+            )
+        ths = tuple(float(t) for t in self.idle_thresholds_s)
+        if not ths:
+            raise DeepSpeedConfigError(
+                "telemetry.kv_heat.idle_thresholds_s must be non-empty"
+            )
+        if any(t <= 0.0 for t in ths) or list(ths) != sorted(ths):
+            raise DeepSpeedConfigError(
+                "telemetry.kv_heat.idle_thresholds_s must be positive and "
+                f"ascending, got {self.idle_thresholds_s}"
+            )
+        self.idle_thresholds_s = ths
+
+
+@dataclass
 class TelemetryConfig(DSConfigModel):
     """telemetry section (TPU-native; no reference analog — subsumes the
     reference's scattered observability: timer log lines, flops-profiler
@@ -511,6 +565,8 @@ class TelemetryConfig(DSConfigModel):
     watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
     # ISSUE 11: request-lifecycle tracing (serving) — see RequestTraceConfig
     request_trace: RequestTraceConfig = field(default_factory=RequestTraceConfig)
+    # ISSUE 16: page-lifetime / session-heat tracing (serving) — see KVHeatConfig
+    kv_heat: KVHeatConfig = field(default_factory=KVHeatConfig)
 
 
 @dataclass

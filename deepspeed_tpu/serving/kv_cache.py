@@ -51,6 +51,9 @@ class PageAllocator:
         self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
         self._refs: Dict[int, int] = {}  # page -> refcount (in-use pages only)
         self.cow_forks_total = 0  # bumped by the scheduler's COW path
+        # ISSUE 16: optional KVHeatLedger — hooks fire AFTER each mutation
+        # (one None check when heat tracing is off)
+        self.heat = None
 
     @property
     def capacity(self) -> int:
@@ -73,6 +76,11 @@ class PageAllocator:
     def refcount(self, page: int) -> int:
         return self._refs.get(int(page), 0)
 
+    def refcounts(self) -> Dict[int, int]:
+        """Snapshot of the full page → refcount table (heat-ledger seeding
+        and the lockstep reconcile read it; callers get a copy)."""
+        return dict(self._refs)
+
     def alloc(self, n: int) -> List[int]:
         if n < 0:
             raise ValueError(f"alloc({n})")
@@ -84,6 +92,8 @@ class PageAllocator:
         pages = [self._free.pop() for _ in range(n)]
         for p in pages:
             self._refs[p] = 1
+        if self.heat is not None:
+            self.heat.alloc(pages)
         return pages
 
     def retain(self, pages: Sequence[int]) -> None:
@@ -96,6 +106,8 @@ class PageAllocator:
                 raise PageAllocatorError(f"retain of free/foreign page {p}")
         for p in pages:
             self._refs[int(p)] += 1
+        if self.heat is not None:
+            self.heat.retain(pages)
 
     def free(self, pages: Sequence[int]) -> None:
         """Drop one reference per page; a page returns to the free list only
@@ -112,6 +124,8 @@ class PageAllocator:
             if self._refs[p] == 0:
                 del self._refs[p]
                 self._free.append(p)
+        if self.heat is not None:
+            self.heat.free(pages)
 
     def check_consistent(self) -> Optional[str]:
         """Validate the allocator's internal accounting (Engine G monitor).
@@ -293,6 +307,8 @@ class PrefixCache:
         self.hits_partial = 0
         self.misses = 0
         self.evictions = 0
+        # ISSUE 16: optional KVHeatLedger (register/hit/evict hooks)
+        self.heat = None
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -344,6 +360,9 @@ class PrefixCache:
             self.hits_partial += 1
         else:
             self.misses += 1
+        if self.heat is not None and (pages or cow_page is not None):
+            hit_pages = pages + ([cow_page] if cow_page is not None else [])
+            self.heat.hit(hit_pages, "full" if cow_page is not None else "partial")
         return pages, len(pages) * page, cow_page
 
     def probe(self, prompt: np.ndarray) -> int:
@@ -374,6 +393,7 @@ class PrefixCache:
         n_full = min(plen // page, len(pages))
         parent: Optional[Tuple] = None
         added = 0
+        new_pages: List[int] = []
         for j in range(n_full):
             key = self._key(parent, prompt[j * page:(j + 1) * page])
             if key in self._entries:
@@ -387,7 +407,10 @@ class PrefixCache:
                 if parent is not None:
                     self._children[parent] += 1
                 added += 1
+                new_pages.append(pid)
             parent = key
+        if self.heat is not None and new_pages:
+            self.heat.register(new_pages)
         if self.max_pages > 0:
             self.evict(keep=self.max_pages)
         return added
@@ -402,6 +425,8 @@ class PrefixCache:
                 if parent is not None and parent in self._children:
                     self._children[parent] -= 1
                 self.allocator.free([pid])
+                if self.heat is not None:
+                    self.heat.evict(pid)
                 self.evictions += 1
                 return True
         return False

@@ -164,7 +164,7 @@ class ServingEngine:
     module grows that touches them."""
 
     def __init__(self, engine, config=None, clock=time.monotonic, fault_injector=None,
-                 tracer=None):
+                 tracer=None, heat_tracer=None):
         from ..runtime.config import ServingConfig
 
         if config is None:
@@ -500,6 +500,20 @@ class ServingEngine:
         self._ema_step_s = 0.0  # EWMA decode-step latency (straggler budget)
         self._step_count = 0
 
+        # -- ISSUE 16: page-lifetime / session-heat tracing ----------------
+        # explicit tracer wins, else the engine's telemetry plane provides
+        # one (telemetry.kv_heat), else the plane is off — every hook is
+        # one None check
+        self._heat = None            # the KVHeatTracer
+        self._heat_decode = None     # decode/shared pool ledger
+        self._heat_prefill = None    # prefill pool ledger (aliases in shared)
+        ht = (
+            heat_tracer if heat_tracer is not None
+            else getattr(getattr(engine, "telemetry", None), "kv_heat_tracer", None)
+        )
+        if ht is not None:
+            self.attach_heat(ht)
+
         self._prefill_exec = None
         self._decode_exec = None
         self._verify_exec = None
@@ -560,6 +574,105 @@ class ServingEngine:
             2 + (1 if self.chunk_width > 0 else 0)
             + (2 if self.disaggregated else 0)
         )
+
+    # ------------------------------------------------------------------
+    # ISSUE 16: page-lifetime / session-heat tracing
+    # ------------------------------------------------------------------
+    def attach_heat(self, tracer) -> None:
+        """Attach a :class:`~deepspeed_tpu.telemetry.kv_heat.KVHeatTracer`:
+        one ledger per placement pool, seeded from the allocator's CURRENT
+        refcount table (attaching mid-run — e.g. bench attaches after
+        warm-up — must reconcile from the first event), hooks installed on
+        the allocator(s) and the prefix index, derived gauges bound to this
+        engine's registry. Idempotent for the same tracer."""
+        if tracer is self._heat:
+            return
+        tracer.bind_registry(self.metrics)
+        mc = self.model_config
+        page_b = pool_bytes(
+            mc.n_layer, 1, mc.n_head, self.page_size, mc.head_dim,
+            np.dtype(self.cache_dtype).itemsize,
+        )
+        now = self.clock()
+        alloc = self.decode_set.allocator
+        led = tracer.pool(
+            self.decode_placement.name, alloc.capacity,
+            page_size=self.page_size, page_bytes=page_b, clock=self.clock,
+        )
+        prefix_held = (
+            [int(p) for p in self.prefix_cache.held_pages]
+            if self.prefix_cache is not None and not self.disaggregated else []
+        )
+        led.seed(alloc.refcounts(), prefix_held, now)
+        alloc.heat = led
+        self._heat_decode = led
+        if self.disaggregated:
+            palloc = self.prefill_set.allocator
+            pled = tracer.pool(
+                self.prefill_placement.name, palloc.capacity,
+                page_size=self.page_size, page_bytes=page_b, clock=self.clock,
+            )
+            pled.seed(
+                palloc.refcounts(),
+                [int(p) for p in self.prefix_cache.held_pages]
+                if self.prefix_cache is not None else [],
+                now,
+            )
+            palloc.heat = pled
+            self._heat_prefill = pled
+        else:
+            self._heat_prefill = led
+        if self.prefix_cache is not None:
+            # the index lives on the prefill placement's pool
+            self.prefix_cache.heat = self._heat_prefill
+        self._heat = tracer
+
+    def detach_heat(self) -> None:
+        """Uninstall every heat hook (the tracer and its records survive —
+        this only stops further recording on this engine)."""
+        self.decode_set.allocator.heat = None
+        self.prefill_set.allocator.heat = None
+        if self.prefix_cache is not None:
+            self.prefix_cache.heat = None
+        self._heat = None
+        self._heat_decode = None
+        self._heat_prefill = None
+
+    def draft_index_bytes(self) -> int:
+        """Host bytes held by live slots' incremental n-gram drafter state
+        (ISSUE 16 satellite: the host-metadata budget) — the context list
+        plus the n-gram → position index built by :meth:`_draft`."""
+        import sys as _sys
+
+        total = 0
+        for slot in self.slots:
+            req = slot.request
+            st = getattr(req, "_draft_state", None) if req is not None else None
+            if not st:
+                continue
+            ctx, index, _watermark = st
+            total += _sys.getsizeof(ctx) + 28 * len(ctx)
+            total += _sys.getsizeof(index)
+            # per entry: the n-token tuple key + one int position value
+            total += len(index) * (28 * (self.spec_ngram + 1) + 56)
+        return total
+
+    def host_metadata_breakdown(self) -> dict:
+        """The host-side (RSS, not HBM) metadata ledger: prefix-index
+        structures, per-request drafter indexes, heat-ledger mirrors —
+        budgeted next to the device pools in :meth:`memory_report`."""
+        prefix_b = (
+            self.prefix_cache.host_metadata_bytes()
+            if self.prefix_cache is not None else 0
+        )
+        draft_b = self.draft_index_bytes()
+        heat_b = self._heat.ledger_bytes() if self._heat is not None else 0
+        return {
+            "prefix_index_bytes": prefix_b,
+            "draft_index_bytes": draft_b,
+            "heat_ledger_bytes": heat_b,
+            "total_bytes": prefix_b + draft_b + heat_b,
+        }
 
     # ------------------------------------------------------------------
     # compilation: a fixed feature-derived program set, ahead-of-time
@@ -1078,6 +1191,9 @@ class ServingEngine:
             # finishing request's buffer into its terminal record
             emitted: list = []
             ev_batch: list = []
+            heat_batch: list = []
+            heat = self._heat_decode  # ISSUE 16: decode-pool heat ledger
+            page = self.page_size
             for i in active:
                 req = self.slots[i].request
                 if self.spec_enabled:
@@ -1085,6 +1201,16 @@ class ServingEngine:
                 else:
                     toks = [int(out_np[i])]
                 req.tokens.extend(toks)
+                if heat is not None:
+                    # the step's KV write landed in the page holding the last
+                    # emitted position; the attended set is the slot's
+                    # block-table prefix (leanest columnar shape — offline
+                    # expansion rides the session's S-event page list)
+                    pos_after = self.slots[i].pos + len(toks)
+                    heat_batch.append((
+                        i, int(self.table.block_tables[i, (pos_after - 1) // page]),
+                        pages_for(pos_after, page),
+                    ))
                 # one emission timestamp per token: an accepted speculative
                 # run lands at ONE instant — the streaming-client truth the
                 # TPOT quantiles derive from (ISSUE 11)
@@ -1107,6 +1233,8 @@ class ServingEngine:
                     self.tracer.step_events(ev_batch)
                 else:
                     self.tracer.decode_events(ev_batch)
+            if heat_batch:
+                heat.touch_step(now, self._step_count, heat_batch)
             # pass 2 — advance/retire the slots
             for i, toks in emitted:
                 slot = self.slots[i]
@@ -1326,6 +1454,13 @@ class ServingEngine:
         slot.prefilling = False
         req.prefix_shared_tokens = shared_tokens
         req.cow_forked = cow_page is not None
+        if self._heat_decode is not None:
+            # session owner map (ISSUE 16): the FULL decode reservation is
+            # taken here — no decode-time growth — so the S event's
+            # block-table-ordered page list is the slot's complete footprint
+            self._heat_decode.session_start(
+                req.t_admit, slot_i, req.id, req.tenant, pages
+            )
         if self.tracer is not None:
             self.tracer.event(
                 req, "admit", req.t_admit, step=self._step_count,
@@ -1604,6 +1739,8 @@ class ServingEngine:
             self._h_tpot.observe(gap)
         self._c_requests.inc(status=status)
         self._c_tokens.inc(len(req.tokens))
+        if self._heat_decode is not None:
+            self._heat_decode.session_end(now, slot_i)
         self.allocator.free(slot.pages)
         if slot.prefill_pages:
             # evicted mid-prefill (timeout / preempt) before the handoff
@@ -1671,6 +1808,8 @@ class ServingEngine:
         scratch, the evicted KV is gone) or finish it terminal FAILED."""
         slot = self.slots[slot_i]
         req = slot.request
+        if self._heat_decode is not None:
+            self._heat_decode.session_end(now, slot_i)
         self.allocator.free(slot.pages)
         if slot.prefill_pages:
             self.prefill_set.allocator.free(slot.prefill_pages)
@@ -2035,6 +2174,9 @@ class ServingEngine:
                          mcfg_m.n_head)
             if self.quantized else 0
         )
+        # ISSUE 16 satellite: the full host-RSS metadata ledger (prefix
+        # index + drafter indexes + heat ledgers), budgeted beside HBM
+        host_breakdown = self.host_metadata_breakdown()
         out = {}
         for name, ana in (self._memory_analyses or {}).items():
             budget = dsmem.resolve_budget(mcfg, name)
@@ -2047,6 +2189,7 @@ class ServingEngine:
             # shadow (ISSUE 10)
             rec["metadata_bytes"] = ana.by_category.get("metadata", 0)
             rec["host_metadata_bytes"] = host_meta
+            rec["host_metadata"] = dict(host_breakdown)
             # int8 pools (ISSUE 12): quantized payload + scales reported
             # SEPARATELY — the pool entry is codes only, the scales live
             # under metadata (where Engine E categorizes them)
@@ -2119,6 +2262,23 @@ class ServingEngine:
             }
             if self.tracer.encode_error is not None:
                 out["request_trace"]["encode_error"] = self.tracer.encode_error
+        # ISSUE 16: heat-plane health + the host-metadata budget
+        out["host_metadata"] = self.host_metadata_breakdown()
+        if self._heat is not None:
+            self._heat.refresh_gauges(now)
+            out["kv_heat"] = {
+                "path": self._heat.file_path,
+                "records": self._heat.records_emitted,
+                "rotations": self._heat.rotations,
+                "records_lost": self._heat.records_lost,
+                "ledger_bytes": self._heat.ledger_bytes(),
+                "pools": {
+                    name: led.occupancy(now, self._heat.idle_thresholds_s)
+                    for name, led in self._heat.ledgers.items()
+                },
+            }
+            if self._heat.encode_error is not None:
+                out["kv_heat"]["encode_error"] = self._heat.encode_error
         out["kv_pages_shared"] = self.allocator.pages_shared
         out["kv_cow_forks"] = self.allocator.cow_forks_total
         # ISSUE 12: the pool's storage dtype + its HBM split (codes vs

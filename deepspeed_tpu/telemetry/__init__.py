@@ -26,6 +26,7 @@ from typing import Any, Dict, List, Optional
 from . import compile_stats, introspect
 from . import watchdog as watchdog_mod
 from .exporters import MonitorBridge, PrometheusTextfileExporter
+from .kv_heat import KVHeatLedger, KVHeatTracer
 from .registry import Counter, Gauge, Histogram, MetricsRegistry
 from .request_trace import RequestTracer
 from .tracer import Span, StepTracer, aggregate_scalars, spans_to_tree
@@ -33,8 +34,8 @@ from .watchdog import AnomalyError, AnomalyWatchdog
 
 __all__ = [
     "AnomalyError", "AnomalyWatchdog",
-    "Counter", "Gauge", "Histogram", "MetricsRegistry",
-    "MonitorBridge", "PrometheusTextfileExporter",
+    "Counter", "Gauge", "Histogram", "KVHeatLedger", "KVHeatTracer",
+    "MetricsRegistry", "MonitorBridge", "PrometheusTextfileExporter",
     "RequestTracer", "Span", "StepTracer", "Telemetry",
     "aggregate_scalars", "device_hbm_stats", "from_config", "introspect",
     "spans_to_tree",
@@ -105,6 +106,19 @@ class Telemetry:
                 flush_interval=int(rt.flush_interval),
                 max_bytes=int(rt.max_mb) * 2**20,
                 max_events_per_request=int(rt.max_events_per_request),
+                process_index=process_index,
+            )
+        # ISSUE 16: page-lifetime / session-heat tracing — picked up by
+        # ServingEngine (the scheduler attaches per-placement pool ledgers)
+        self.kv_heat_tracer: Optional[KVHeatTracer] = None
+        kh = getattr(config, "kv_heat", None)
+        if kh is not None and getattr(kh, "enabled", False):
+            self.kv_heat_tracer = KVHeatTracer(
+                kh.path or os.path.join(config.trace_path or ".", "kv_heat.jsonl"),
+                flush_interval=int(kh.flush_interval),
+                max_bytes=int(kh.max_mb) * 2**20,
+                segment_events=int(kh.segment_events),
+                idle_thresholds_s=tuple(kh.idle_thresholds_s),
                 process_index=process_index,
             )
         compile_stats.install(self.registry)
@@ -258,6 +272,8 @@ class Telemetry:
             self.tracer.flush()
         if self.request_tracer is not None:
             self.request_tracer.flush()
+        if self.kv_heat_tracer is not None:
+            self.kv_heat_tracer.flush()
         if self.prometheus is not None:
             self.prometheus.export()
 
@@ -267,6 +283,8 @@ class Telemetry:
             self.tracer.close()
         if self.request_tracer is not None:
             self.request_tracer.close()
+        if self.kv_heat_tracer is not None:
+            self.kv_heat_tracer.close()
 
 
 def _is_num(v) -> bool:

@@ -34,8 +34,8 @@ def pytest_collection_modifyitems(config, items):
     """Under DS_TPU_TESTS=1 the real TPU backend is active: enforce that only
     tpu-marked tests run (CPU-mesh tests assume 8 virtual devices).
 
-    On the CPU mesh, serving-, lint-, resilience-, dsan- and dsmem-marked
-    tests are hoisted to the front of the run (stable sort): the tier-1
+    On the CPU mesh, serving-, lint-, resilience-, dsan-, dsmem- and
+    heat-marked tests are hoisted to the front of the run (stable sort): the tier-1
     sweep runs under a wall-clock budget and kills the tail of the
     alphabet, and the serving simulation suite, the dslint static-analysis
     gate (ISSUE 6), the fault-tolerance matrix (ISSUE 7), the concurrency
@@ -43,7 +43,7 @@ def pytest_collection_modifyitems(config, items):
     are acceptance gates that must stay inside the budget regardless of
     where their files sort."""
     if not _TPU_MODE:
-        _hoisted = ("serving", "lint", "resilience", "dsan", "dsmem")
+        _hoisted = ("serving", "lint", "resilience", "dsan", "dsmem", "heat")
         items.sort(
             key=lambda item: 0
             if any(k in item.keywords for k in _hoisted) else 1

@@ -1,0 +1,530 @@
+"""Page-lifetime / session-heat tracing plane (ISSUE 16): KVHeatLedger
+mirror semantics + bit-exact allocator reconciliation, KVHeatTracer JSONL
+schema/rotation/determinism, registry gauges with the Prometheus
+``_sum``/``_count`` pin, the replay analyses (occupancy replay, cold-fraction
+curves, what-if spill policies), the ``tools/kv_heat.py`` CLI exit contract,
+and the serving acceptance: heat tracing ON leaves the 16-request mixed
+suite's token streams bit-identical (spec + prefix + chunk + int8; TP under
+the 8-device mesh marker) while the ledger reconciles against the live
+allocator at drain."""
+
+import json
+import os
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models import gpt2
+from deepspeed_tpu.serving.kv_cache import PageAllocator, PrefixCache
+from deepspeed_tpu.telemetry.exporters import PrometheusTextfileExporter
+from deepspeed_tpu.telemetry.kv_heat import (
+    SCHEMA,
+    KVHeatError,
+    KVHeatLedger,
+    KVHeatTracer,
+    cold_fraction_curve,
+    evaluate_spill_policies,
+    heat_report,
+    load_heat_records,
+    pools_in,
+    replay_heat,
+)
+from deepspeed_tpu.telemetry.registry import MetricsRegistry
+from deepspeed_tpu.tools import kv_heat as cli
+
+warnings.filterwarnings("ignore")
+
+pytestmark = pytest.mark.heat
+
+needs_8_devices = pytest.mark.skipif(
+    jax.device_count() < 4, reason="needs the forced 8-device CPU mesh"
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture(scope="module")
+def tiny_cfg():
+    return gpt2.get_config("gpt2-tiny", attn_impl="jnp")
+
+
+@pytest.fixture(scope="module")
+def inference_engine(tiny_cfg):
+    from deepspeed_tpu.inference.engine import InferenceEngine
+
+    params = gpt2.init_params(tiny_cfg, jax.random.PRNGKey(0))
+    return InferenceEngine(
+        gpt2.make_module(tiny_cfg), params=params, dtype=jnp.float32
+    )
+
+
+SERVING_CFG = {
+    "max_slots": 4,
+    "page_size": 4,
+    "num_pages": 64,
+    "max_prompt_len": 12,
+    "max_new_tokens": 8,
+    "kv_cache_dtype": "float32",
+}
+ALL_FEATURES = {
+    "speculative": {"enabled": True, "k": 3},
+    "prefix_cache": {"enabled": True},
+    "prefill_chunk_tokens": 8,
+}
+
+
+def _mixed_requests(vocab, n=16, seed=7):
+    rs = np.random.RandomState(seed)
+    plens = [2, 5, 8, 12, 7, 3, 11, 4] * 2
+    return [
+        (rs.randint(0, vocab, (plens[i],)).astype(np.int32),
+         6 if i % 7 else (1, 3, 8)[i // 7])
+        for i in range(n)
+    ]
+
+
+def _streams(srv, reqs):
+    subs = [
+        srv.submit(p, max_new_tokens=n, seed=i)
+        for i, (p, n) in enumerate(reqs)
+    ]
+    srv.run()
+    return [list(r.tokens) for r in subs]
+
+
+def _mk_tracer(tmp_path, clock=None, **kw):
+    kw.setdefault("flush_interval", 1)
+    return KVHeatTracer(
+        str(tmp_path / "kv_heat.jsonl"),
+        clock=clock if clock is not None else FakeClock(),
+        **kw,
+    )
+
+
+def _scripted_trace(tmp_path, capacity=16):
+    """A small deterministic trace exercising every event kind; returns the
+    trace path."""
+    clk = FakeClock()
+    tr = _mk_tracer(tmp_path, clock=clk)
+    led = tr.pool("decode", capacity, page_size=4, page_bytes=2048)
+    led.seed({}, set(), 0.0)
+    clk.t = 0.1
+    led.alloc([1, 2, 3])
+    led.session_start(0.1, 0, 11, "ten0", [1, 2, 3])
+    clk.t = 0.2
+    led.touch_step(0.2, 1, [(0, 3, 3)])
+    clk.t = 0.5
+    led.alloc([4, 5])
+    led.register([4, 5])
+    led.session_start(0.5, 1, 12, "ten1", [4, 5])
+    clk.t = 1.0
+    led.hit([4], "partial")
+    led.retain([4])
+    clk.t = 2.0
+    led.session_end(2.0, 0)
+    led.free([1, 2, 3])
+    clk.t = 3.0
+    led.touch_step(3.0, 2, [(1, 5, 2)])
+    clk.t = 6.5
+    led.free([5])          # live order: allocator frees, THEN the index evicts
+    led.evict(5)
+    tr.flush()
+    tr.close()
+    return tr.file_path
+
+
+# ---------------------------------------------------------------------------
+# ledger mirror semantics
+# ---------------------------------------------------------------------------
+
+class TestLedger:
+    def test_lifecycle_counts_and_occupancy_split(self):
+        clk = FakeClock()
+        led = KVHeatLedger("p", 8, clock=clk)
+        led.alloc([1, 2])
+        led.session_start(0.0, 0, 1, "t", [1, 2])
+        led.alloc([3, 4])
+        led.register([3, 4])          # prefix-held, no owning session
+        led.retain([3])               # shared
+        clk.t = 10.0
+        assert led.pages_in_use == 4 and led.free_count == 4
+        occ = led.occupancy(10.0, (1.0,))
+        assert occ["pages"] == {
+            "active": 2, "prefix": 2, "shared": 0, "other": 0, "free": 4,
+        }
+        # everything idle > 1s: all 4 in-use pages cold
+        assert occ["cold_fraction"]["1.0"] == 1.0
+        # a touch re-heats exactly the touched pages
+        led.touch_step(10.0, 1, [(0, 2, 2)])
+        occ = led.occupancy(10.0, (1.0,))
+        assert occ["cold_fraction"]["1.0"] == 0.5
+        assert occ["sessions"] == 1
+
+    def test_fragmentation_contiguous_vs_scattered(self):
+        led = KVHeatLedger("p", 8)
+        assert led.fragmentation() == 0.0          # all free, one run
+        led.alloc([1, 2, 3])
+        assert led.fragmentation() == 0.0          # free = 4..8 contiguous
+        led.free([2])
+        assert led.fragmentation() > 0.0           # {2} + {4..8}
+
+    def test_reconcile_tracks_allocator_and_prefix(self):
+        alloc = PageAllocator(num_pages=17)
+        cache = PrefixCache(alloc, page_size=2, max_pages=8)
+        led = KVHeatLedger("p", alloc.capacity)
+        alloc.heat = led
+        cache.heat = led
+        got = alloc.alloc(4)
+        assert led.reconcile(alloc, cache) is None
+        alloc.retain(got[:2])
+        prompt = np.arange(4, dtype=np.int32)
+        cache.insert(prompt, got[:2])
+        assert led.reconcile(alloc, cache) is None
+        alloc.free(got[:2] + got)
+        assert led.reconcile(alloc, cache) is None
+        # a deliberate mirror perturbation is caught, precisely
+        led.refs[99] = 1
+        msg = led.reconcile(alloc, cache)
+        assert msg is not None and "refcount" in msg
+        del led.refs[99]
+        assert led.reconcile(alloc, cache) is None
+
+    def test_free_of_unseen_page_tolerated(self):
+        """Attach-after-warmup: frees of pages allocated before the ledger
+        existed must not corrupt the mirror."""
+        led = KVHeatLedger("p", 8)
+        led.free([5])                  # never seen
+        assert led.pages_in_use == 0 and led.free_count == 8
+        led.alloc([1])
+        led.free([1, 5])
+        assert led.pages_in_use == 0
+
+    def test_ledger_bytes_grows_with_state(self):
+        led = KVHeatLedger("p", 64)
+        b0 = led.ledger_bytes()
+        led.alloc(list(range(1, 33)))
+        led.session_start(0.0, 0, 1, "t", list(range(1, 33)))
+        assert led.ledger_bytes() > b0
+
+
+# ---------------------------------------------------------------------------
+# tracer: schema, tolerance, determinism
+# ---------------------------------------------------------------------------
+
+class TestTracerSchema:
+    def test_roundtrip_meta_and_segments(self, tmp_path):
+        path = _scripted_trace(tmp_path)
+        records = load_heat_records(path)
+        metas = [r for r in records if r["kind"] == "kv_heat_meta"]
+        segs = [r for r in records if r["kind"] == "kv_heat"]
+        assert len(metas) == 1 and segs
+        m = metas[0]
+        assert m["schema"] == SCHEMA == "dstpu-kvheat-v1"
+        assert m["pool"] == "decode" and m["capacity"] == 16
+        assert m["page_bytes"] == 2048
+        assert list(m["idle_thresholds_s"]) == [1.0, 5.0, 30.0]
+        # segment records are seq-ordered and NEVER carry wall-clock fields
+        # (the byte-determinism contract under seeded replay)
+        assert [s["seq"] for s in segs] == list(range(len(segs)))
+        for s in segs:
+            assert "ts" not in s and "host" not in s
+        assert pools_in(records) == ["decode"]
+
+    def test_torn_tail_tolerated_mid_file_fatal(self, tmp_path):
+        path = _scripted_trace(tmp_path)
+        with open(path, "a") as fh:
+            fh.write('{"kind": "kv_heat", "trunc')   # torn final line
+        n = len(load_heat_records(path))
+        assert n > 0
+        lines = open(path).read().splitlines()
+        lines[0] = lines[0][:10]                     # torn FIRST line
+        with open(path, "w") as fh:
+            fh.write("\n".join(lines) + "\n")
+        with pytest.raises(KVHeatError):
+            load_heat_records(path)
+
+    def test_schema_mismatch_raises(self, tmp_path):
+        path = str(tmp_path / "bad.jsonl")
+        with open(path, "w") as fh:
+            fh.write(json.dumps({
+                "kind": "kv_heat_meta", "schema": "dstpu-kvheat-v0",
+                "pool": "p", "capacity": 4,
+            }) + "\n")
+        with pytest.raises(KVHeatError):
+            load_heat_records(path)
+
+    def test_same_script_byte_identical_traces(self, tmp_path):
+        a = _scripted_trace(tmp_path / "a")
+        b = _scripted_trace(tmp_path / "b")
+        assert open(a, "rb").read() == open(b, "rb").read()
+
+    def test_segment_seal_threshold(self, tmp_path):
+        clk = FakeClock()
+        tr = _mk_tracer(tmp_path, clock=clk, segment_events=4)
+        led = tr.pool("p", 32)
+        for i in range(1, 13):
+            clk.t = float(i)
+            led.alloc([i])
+        tr.flush()
+        tr.close()
+        segs = [
+            r for r in load_heat_records(tr.file_path) if r["kind"] == "kv_heat"
+        ]
+        assert len(segs) >= 3
+        assert sum(len(s["events"]) for s in segs) == 12  # every alloc, once
+
+
+# ---------------------------------------------------------------------------
+# gauges + Prometheus _sum/_count pin (satellite 2)
+# ---------------------------------------------------------------------------
+
+class TestGauges:
+    def test_registry_gauges_and_exporter_sum_count(self, tmp_path):
+        clk = FakeClock()
+        tr = _mk_tracer(tmp_path, clock=clk)
+        reg = MetricsRegistry()
+        tr.bind_registry(reg)
+        led = tr.pool("decode", 16)
+        led.alloc([1, 2, 3])
+        led.session_start(0.0, 0, 1, "t", [1, 2, 3])
+        clk.t = 2.0
+        led.free([1, 2, 3])            # 3 lifetime observations of 2.0s each
+        tr.refresh_gauges(2.0)
+        assert tr._g_pages.value(pool="decode", category="free") == 16 - 0
+        h = reg.histogram("serving_kv_page_lifetime_seconds", "", ("pool",))
+        total, n = h.stats(pool="decode")
+        assert n == 3 and total == pytest.approx(6.0)
+
+        # the pin: textfile export carries _sum and _count lines alongside
+        # the buckets, so lifetime means/quantiles are derivable server-side
+        out = str(tmp_path / "metrics.prom")
+        PrometheusTextfileExporter(reg, out).export()
+        text = open(out).read()
+        assert 'serving_kv_page_lifetime_seconds_bucket{pool="decode",le="2.5"} 3' in text
+        assert 'serving_kv_page_lifetime_seconds_bucket{pool="decode",le="+Inf"} 3' in text
+        assert 'serving_kv_page_lifetime_seconds_sum{pool="decode"} 6' in text
+        assert 'serving_kv_page_lifetime_seconds_count{pool="decode"} 3' in text
+        assert "serving_kv_heat_fragmentation" in text
+        assert "serving_kv_heat_ledger_bytes" in text
+
+    def test_idle_age_quantile_gauges(self, tmp_path):
+        clk = FakeClock()
+        tr = _mk_tracer(tmp_path, clock=clk)
+        led = tr.pool("decode", 16)
+        reg = MetricsRegistry()
+        tr.bind_registry(reg)
+        for slot in range(4):
+            led.alloc([slot + 1])
+            led.session_start(float(slot), slot, slot, "t", [slot + 1])
+        tr.refresh_gauges(10.0)
+        p50 = tr._g_idle.value(q="p50")
+        p99 = tr._g_idle.value(q="p99")
+        assert p50 in (8.0, 9.0) and p99 == 10.0
+
+
+# ---------------------------------------------------------------------------
+# replay analyses
+# ---------------------------------------------------------------------------
+
+class TestReplay:
+    def test_replay_rebuilds_live_occupancy(self, tmp_path):
+        path = _scripted_trace(tmp_path)
+        led = replay_heat(load_heat_records(path), "decode")
+        occ = led.occupancy(6.5, (1.0,))
+        # end state: page 4 (refs 2) alive; 5 freed + evicted; 1-3 freed
+        assert led.refs == {4: 2}
+        assert occ["pages_in_use"] == 1
+        assert led.prefix_hits == 1
+        assert led.sessions_started == 2
+
+    def test_cold_fraction_curve_shape(self, tmp_path):
+        path = _scripted_trace(tmp_path)
+        curve = cold_fraction_curve(
+            load_heat_records(path), "decode", 1.0, bins=8
+        )
+        assert len(curve) == 8
+        for pt in curve:
+            frac = pt["cold_fraction"]
+            assert frac is None or 0.0 <= frac <= 1.0
+        assert curve[-1]["t"] >= curve[0]["t"]
+
+    def test_what_if_policies_differentiate(self, tmp_path):
+        path = _scripted_trace(tmp_path)
+        wi = evaluate_spill_policies(
+            load_heat_records(path), "decode", resident_fraction=0.25
+        )
+        assert set(wi["policies"]) == {
+            "idle_lru", "prefix_aware", "slot_priority",
+        }
+        assert wi["resident_cap"] == 4
+        for r in wi["policies"].values():
+            assert r["spills"] >= 0 and r["restore_stalls"] >= 0
+            assert r["spilled_bytes"] == r["spills"] * wi["page_bytes"]
+            assert r["restored_bytes"] == r["restored_pages"] * wi["page_bytes"]
+
+
+# ---------------------------------------------------------------------------
+# CLI exit contract
+# ---------------------------------------------------------------------------
+
+class TestCLI:
+    def test_report_timeline_heatmap_exit0(self, tmp_path, capsys):
+        path = _scripted_trace(tmp_path)
+        assert cli.main([path]) == 0
+        out = capsys.readouterr().out
+        assert "pool decode" in out and "cold fraction" in out
+        assert cli.main([path, "--page", "4"]) == 0
+        assert "legend" in capsys.readouterr().out
+        assert cli.main([path, "--heatmap", "--bins", "8"]) == 0
+        assert "heatmap" in capsys.readouterr().out
+        assert cli.main([path, "--json"]) == 0
+        assert json.loads(capsys.readouterr().out)["schema"] == SCHEMA
+
+    def test_what_if_and_diff(self, tmp_path, capsys):
+        path = _scripted_trace(tmp_path)
+        assert cli.main([path, "--what-if", "--resident-fraction", "0.25"]) == 0
+        assert "fewest restore stalls" in capsys.readouterr().out
+        assert cli.main([path, "--diff", path]) == 0
+        assert "no regressions" in capsys.readouterr().out
+
+    def test_gates(self, tmp_path, capsys):
+        path = _scripted_trace(tmp_path)
+        # cold floor: end state is 1 page idle since t=1.0 → 100% cold @1s
+        assert cli.main([path, "--min-cold-fraction", "99"]) == 0
+        assert cli.main(
+            [path, "--min-cold-fraction", "99", "--threshold", "30.0"]
+        ) == 1
+        assert cli.main(
+            [path, "--min-cold-fraction", "1", "--threshold", "7.7"]
+        ) == 2
+        bench = tmp_path / "bench.json"
+        bench.write_text(json.dumps({"overhead": {"heat_overhead_pct": 1.2}}))
+        assert cli.main(
+            [path, "--max-overhead-pct", "2.0", "--bench", str(bench)]
+        ) == 0
+        assert cli.main(
+            [path, "--max-overhead-pct", "1.0", "--bench", str(bench)]
+        ) == 1
+        assert cli.main([path, "--max-overhead-pct", "1.0"]) == 2
+        capsys.readouterr()
+
+    def test_errors_exit2(self, tmp_path, capsys):
+        assert cli.main([str(tmp_path / "nope.jsonl")]) == 2
+        empty = tmp_path / "empty.jsonl"
+        empty.write_text("")
+        assert cli.main([str(empty)]) == 2
+        path = _scripted_trace(tmp_path)
+        assert cli.main([path, "--pool", "prefill"]) == 2
+        capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# serving acceptance
+# ---------------------------------------------------------------------------
+
+class TestServingAcceptance:
+    def test_serving_reconciles_and_reports(
+        self, tiny_cfg, inference_engine, tmp_path
+    ):
+        clk = FakeClock()
+        tr = _mk_tracer(tmp_path, clock=clk)
+        srv = inference_engine.serve(
+            dict(SERVING_CFG, **ALL_FEATURES), clock=clk, heat_tracer=tr
+        )
+        _streams(srv, _mixed_requests(tiny_cfg.vocab_size))
+        led = tr.ledgers[srv.decode_placement.name]
+        assert led.reconcile(srv.allocator, srv.prefix_cache) is None
+        st = srv.stats()
+        kh = st["kv_heat"]
+        assert kh["pools"][srv.decode_placement.name]["capacity"] == 63
+        hm = st["host_metadata"]
+        assert set(hm) >= {
+            "prefix_index_bytes", "draft_index_bytes",
+            "heat_ledger_bytes", "total_bytes",
+        }
+        assert hm["heat_ledger_bytes"] > 0
+        mr = srv.memory_report()
+        assert all("host_metadata" in rec for rec in mr.values())
+        srv.release_prefix_cache()
+        srv.check_no_leaks()
+        assert led.reconcile(srv.allocator, srv.prefix_cache) is None
+        tr.flush()
+        tr.close()
+        records = load_heat_records(tr.file_path)
+        rep = heat_report(records)
+        pl = rep["pools"][srv.decode_placement.name]
+        assert pl["sessions_started"] == 16 and pl["sessions_ended"] == 16
+        assert pl["allocs"] > 0 and pl["touch_steps"] > 0
+
+    def test_mixed_suite_bit_identical_heat_on(
+        self, tiny_cfg, inference_engine, tmp_path
+    ):
+        """The acceptance pin: heat tracing is pure host-side observation —
+        int8 + spec + prefix + chunk streams match exactly with it on."""
+        cfg = dict(SERVING_CFG, kv_cache_dtype="int8", **ALL_FEATURES)
+        reqs = _mixed_requests(tiny_cfg.vocab_size)
+        base = _streams(inference_engine.serve(cfg), reqs)
+        tr = _mk_tracer(tmp_path)
+        srv = inference_engine.serve(cfg, heat_tracer=tr)
+        assert _streams(srv, reqs) == base
+        led = tr.ledgers[srv.decode_placement.name]
+        assert led.reconcile(srv.allocator, srv.prefix_cache) is None
+        srv.release_prefix_cache()
+        srv.check_no_leaks()
+        tr.close()
+
+    @needs_8_devices
+    def test_tp2_bit_identical_heat_on(
+        self, tiny_cfg, inference_engine, tmp_path
+    ):
+        cfg = dict(SERVING_CFG, kv_cache_dtype="int8", **ALL_FEATURES)
+        reqs = _mixed_requests(tiny_cfg.vocab_size)
+        base = _streams(inference_engine.serve(cfg), reqs)
+        tr = _mk_tracer(tmp_path)
+        srv = inference_engine.serve(
+            dict(cfg, placement={"tp": 2}), heat_tracer=tr
+        )
+        assert _streams(srv, reqs) == base
+        assert tr.ledgers[srv.decode_placement.name].reconcile(
+            srv.allocator, srv.prefix_cache
+        ) is None
+        srv.release_prefix_cache()
+        srv.check_no_leaks()
+        tr.close()
+
+    def test_telemetry_config_builds_heat_tracer(self, tiny_cfg, tmp_path):
+        from deepspeed_tpu.inference.engine import InferenceEngine
+
+        params = gpt2.init_params(tiny_cfg, jax.random.PRNGKey(0))
+        eng = InferenceEngine(
+            gpt2.make_module(tiny_cfg), params=params, dtype=jnp.float32,
+            config={"telemetry": {
+                "enabled": True,
+                "trace_path": str(tmp_path / "tel"),
+                "kv_heat": {"enabled": True},
+            }},
+        )
+        assert eng.telemetry.kv_heat_tracer is not None
+        srv = eng.serve(SERVING_CFG)
+        assert srv._heat is eng.telemetry.kv_heat_tracer
+        srv.submit(np.arange(4, dtype=np.int32), max_new_tokens=3)
+        srv.run()
+        srv.check_no_leaks()
+        eng.telemetry.close()
+        records = load_heat_records(eng.telemetry.kv_heat_tracer.file_path)
+        assert pools_in(records) == [srv.decode_placement.name]
+
+    def test_env_report_heat_section(self, capsys):
+        from deepspeed_tpu import env_report
+
+        assert env_report.main() == 0
+        assert "KV heat" in capsys.readouterr().out

@@ -522,9 +522,15 @@ class TestLockstepFuzz:
             PageAllocatorError,
         )
 
+        from deepspeed_tpu.telemetry.kv_heat import KVHeatLedger
+
         rs = np.random.RandomState(seed)
         alloc = PageAllocator(num_pages=17)
         mirror = _MirrorAllocator(17)
+        # ISSUE 16 lockstep acceptance: a sink-less heat ledger rides the
+        # allocator hooks and must reconcile bit-exact at EVERY op
+        led = KVHeatLedger("fuzz", alloc.capacity)
+        alloc.heat = led
         held = []   # flat list of held page ids (one entry per reference)
         for _ in range(300):
             op = rs.randint(4)
@@ -554,17 +560,24 @@ class TestLockstepFuzz:
             assert alloc.check_consistent() is None
             assert alloc.free_pages == mirror.free_count
             assert dict(alloc._refs) == mirror.refs
+            assert led.reconcile(alloc) is None
         alloc.free(held)
         alloc.check_no_leaks()
+        assert led.reconcile(alloc) is None and led.pages_in_use == 0
 
     @pytest.mark.parametrize("seed", [0, 1, 2])
     def test_prefix_cache_lockstep(self, seed):
         from deepspeed_tpu.serving.kv_cache import PageAllocator, PrefixCache
 
+        from deepspeed_tpu.telemetry.kv_heat import KVHeatLedger
+
         rs = np.random.RandomState(seed)
         page = 2
         alloc = PageAllocator(num_pages=33)
         cache = PrefixCache(alloc, page_size=page, max_pages=12)
+        led = KVHeatLedger("fuzz", alloc.capacity)
+        alloc.heat = led
+        cache.heat = led
         live = []   # (pages, n_shared) per simulated in-flight request
         for _ in range(150):
             op = rs.randint(3)
@@ -590,6 +603,9 @@ class TestLockstepFuzz:
             # every index-held page is alive with at least its index ref
             for p in cache.held_pages:
                 assert alloc.refcount(p) >= 1
+            # ISSUE 16: the heat ledger's mirror (refcounts + prefix-held
+            # set) reconciles bit-exact after every op
+            assert led.reconcile(alloc, cache) is None
         for pages in live:
             alloc.free(pages)
         held = cache.held_pages
