@@ -1248,6 +1248,278 @@ def run_kv_heat_bench():
     return pr16
 
 
+def run_kv_tiering_bench():
+    """BENCH_pr17.json (ISSUE 17): the host-DRAM second KV tier.
+
+    Four headline measurements, all on real engines (gpt2-tiny on CPU, the
+    real preset on TPU):
+
+    - EQUIVALENCE: the PR-11 seeded replay (diurnal + bursty + hot-tenant
+      prefix skew) run tiering OFF then tiering ON on a virtual ReplayClock
+      — every request's token stream must be bit-identical (demote/restore
+      round-trips the exact KV bytes; a cold miss recomputes the same
+      pages).
+    - RESIDENT SESSIONS at fixed HBM: a parade of distinct prefix sessions
+      through the same fixed device pool, untiered vs tiered; a session
+      counts as resident when its whole prefix chain is still resumable
+      without recompute (device index OR host store). Pin: tiered/untiered
+      >= 3.12x (1.5x over PR-14's 2.08x tp-sharding baseline).
+    - RESTORE STALLS: every live ``KVTieringEngine.restore`` call timed
+      (the synchronous device_put + scatter the admission path waits on);
+      p99 reported.
+    - DECODE-STEP LATENCY: per-step decode wall time, tiering ON (tier
+      idle, no restore in flight) vs OFF — the background spiller and the
+      admission prefetch probe must cost nothing on the steady-state path.
+
+    BENCH_KVTIER_ONLY=1 standalone."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.models import gpt2
+    from deepspeed_tpu.serving import (
+        ReplayClock,
+        WorkloadSpec,
+        generate_workload,
+        replay,
+    )
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    model_name = os.environ.get(
+        "BENCH_SERVING_MODEL", "gpt2" if on_tpu else "gpt2-tiny"
+    )
+    cfg = gpt2.get_config(model_name)
+    params = jax.jit(lambda r: gpt2.init_params(cfg, r))(jax.random.PRNGKey(0))
+    eng = InferenceEngine(
+        gpt2.make_module(cfg), params=params,
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+    )
+    n_new = int(os.environ.get("BENCH_KVTIER_NEW_TOKENS", "16"))
+    base = {
+        "max_slots": 4,
+        "page_size": 16 if on_tpu else 4,
+        "num_pages": 2048 if on_tpu else 64,
+        "max_prompt_len": 128 if on_tpu else 12,
+        "max_new_tokens": n_new,
+        "max_queue_depth": 256,
+        "prefix_cache": {"enabled": True},
+    }
+    host_budget = int(os.environ.get(
+        "BENCH_KVTIER_HOST_BUDGET", str(4 * base["num_pages"])
+    ))
+    policy = os.environ.get("BENCH_KVTIER_POLICY", "idle_lru")
+    tiered = dict(base, tiering={
+        "enabled": True, "host_budget_pages": host_budget, "policy": policy,
+    })
+    n_req = int(os.environ.get("BENCH_KVTIER_REQUESTS", "48"))
+
+    # capacity probe → virtual step_dt (run_kv_heat_bench's methodology)
+    srv0 = eng.serve(dict(base))
+    rs = np.random.RandomState(0)
+    warm = rs.randint(
+        0, cfg.vocab_size, (base["max_prompt_len"],)
+    ).astype(np.int32)
+    srv0.submit(warm, max_new_tokens=n_new)
+    srv0.run()
+    t0 = _time.monotonic()
+    for _ in range(2 * base["max_slots"]):
+        srv0.submit(warm, max_new_tokens=n_new)
+    srv0.run()
+    sat_wall = max(_time.monotonic() - t0, 1e-9)
+    cap_rps = 2 * base["max_slots"] / sat_wall
+    step_s = max(base["max_slots"] / (cap_rps * n_new), 1e-5)
+    srv0.release_prefix_cache()
+    srv0.check_no_leaks()
+
+    items = generate_workload(WorkloadSpec(
+        n_requests=n_req, seed=1700, vocab_size=cfg.vocab_size,
+        max_prompt_len=base["max_prompt_len"], max_new_tokens=n_new,
+        base_interarrival_s=1.0 / cap_rps,
+        diurnal_amplitude=0.6, diurnal_period_s=n_req / (2 * cap_rps),
+        burst_factor=3.0, burst_duty=0.2,
+        prompt_len_median=base["max_prompt_len"] / 3,
+        prompt_len_sigma=0.6, n_tenants=4, prefix_fraction=0.5,
+    ))
+
+    stall_s: list = []
+
+    def _time_restores(srv):
+        orig = srv.tiering.restore
+
+        def timed(key, pid):
+            t0 = _time.perf_counter()
+            ok = orig(key, pid)
+            stall_s.append(_time.perf_counter() - t0)
+            return ok
+
+        srv.tiering.restore = timed
+
+    # --- A) bit-identical token streams, tiering OFF vs ON ---------------
+    # a deliberately tight device pool so the replay actually exercises the
+    # tier: the spill pump and the restore prefetch both fire mid-stream
+    eq_base = dict(base, num_pages=512 if on_tpu else 32)
+    eq_tiered = dict(eq_base, tiering=tiered["tiering"])
+    srv_off = eng.serve(dict(eq_base), clock=ReplayClock())
+    res_off = replay(srv_off, items, step_dt=step_s)
+    toks_off = [list(r.tokens) for r in res_off["requests"]]
+    srv_off.drain()
+    srv_off.release_prefix_cache()
+    srv_off.check_no_leaks()
+
+    srv_on = eng.serve(dict(eq_tiered), clock=ReplayClock())
+    _time_restores(srv_on)
+    res_on = replay(srv_on, items, step_dt=step_s)
+    toks_on = [list(r.tokens) for r in res_on["requests"]]
+    bit_identical = toks_off == toks_on
+    srv_on.tiering.flush()
+    replay_counters = dict(srv_on.tiering.stats())
+    srv_on.drain()
+    srv_on.release_prefix_cache()
+    srv_on.check_no_leaks()
+
+    # --- B) resident sessions at fixed device HBM ------------------------
+    # parade of DISTINCT prefix sessions (each registers its own chain);
+    # untiered eviction DROPS cold chains, tiered eviction demotes them —
+    # a chain resumable from either tier still counts as resident
+    chain_pages = max(1, (base["max_prompt_len"] - 1) // base["page_size"])
+    n_sessions = int(os.environ.get(
+        "BENCH_KVTIER_SESSIONS",
+        str((base["num_pages"] + host_budget) // chain_pages),
+    ))
+    par_rs = np.random.RandomState(17)
+    session_prompts = [
+        par_rs.randint(
+            0, cfg.vocab_size, (base["max_prompt_len"],)
+        ).astype(np.int32)
+        for _ in range(n_sessions)
+    ]
+
+    def parade(srv):
+        for i, p in enumerate(session_prompts):
+            srv.submit(p, max_new_tokens=2, seed=i)
+            srv.run()
+        if srv.tiering is not None:
+            srv.tiering.flush()
+        resident = 0
+        for p in session_prompts:
+            keys = srv.prefix_cache.chain_keys(p)
+            if keys and all(
+                k in srv.prefix_cache._entries
+                or (srv.tiering is not None and k in srv.tiering.store)
+                for k in keys
+            ):
+                resident += 1
+        return resident
+
+    srv_base = eng.serve(dict(base))
+    baseline_sessions = parade(srv_base)
+    srv_base.drain()
+    srv_base.release_prefix_cache()
+    srv_base.check_no_leaks()
+
+    srv_tier = eng.serve(dict(tiered))
+    _time_restores(srv_tier)
+    tiered_sessions = parade(srv_tier)
+    resident_ratio = round(tiered_sessions / max(1, baseline_sessions), 3)
+
+    # restore-under-pressure: resume sessions whose chains still live on
+    # host (the host LRU dropped the oldest overflow, so pick live ones) —
+    # admission prefetch restores them through serving_kv_restore
+    host_resumable = [
+        p for p in session_prompts
+        if any(
+            k in srv_tier.tiering.store
+            for k in srv_tier.prefix_cache.chain_keys(p)
+        )
+    ]
+    n_resume = min(8, len(host_resumable))
+    for i, p in enumerate(host_resumable[:n_resume]):
+        srv_tier.submit(p, max_new_tokens=2, seed=100 + i)
+        srv_tier.run()
+    srv_tier.tiering.flush()
+    tier_counters = dict(srv_tier.tiering.stats())
+    tiers = {
+        "device_pages": srv_tier.prefill_set.allocator.capacity,
+        "host_budget_pages": srv_tier.tiering.store.budget_pages,
+        "page_bytes": srv_tier.tiering.store.page_bytes,
+        "host_bytes": srv_tier.tiering.store.host_bytes(),
+    }
+    host_meta = srv_tier.host_metadata_breakdown()
+    srv_tier.drain()
+    srv_tier.release_prefix_cache()
+    srv_tier.check_no_leaks()
+
+    stall_ms = sorted(s * 1e3 for s in stall_s)
+    p99 = (
+        round(stall_ms[min(len(stall_ms) - 1,
+                           int(0.99 * len(stall_ms)))], 3)
+        if stall_ms else None
+    )
+
+    # --- C) decode-step latency, tier idle vs tiering off ----------------
+    def decode_step_ms(scfg_d):
+        srv = eng.serve(dict(scfg_d))
+        srv.submit(warm, max_new_tokens=n_new)   # compile outside the window
+        srv.run()
+        srv.submit(warm, max_new_tokens=n_new)
+        while any(s.prefilling for s in srv.slots) or srv.queue:
+            srv.step()
+        times = []
+        while any(s.request is not None for s in srv.slots):
+            t0 = _time.perf_counter()
+            srv.step()
+            times.append(_time.perf_counter() - t0)
+        srv.drain()
+        srv.release_prefix_cache()
+        srv.check_no_leaks()
+        times.sort()
+        return round(times[len(times) // 2] * 1e3, 4)   # median
+
+    step_off_ms = decode_step_ms(base)
+    step_on_ms = decode_step_ms(tiered)
+    step_delta_pct = round(
+        (step_on_ms - step_off_ms) / max(step_off_ms, 1e-9) * 100.0, 2
+    )
+
+    min_ratio = 3.12   # 1.5x over PR-14's 2.08x baseline
+    pr17 = {
+        "schema": "bench_pr17_kv_tiering_v1",
+        "model": model_name,
+        "backend": jax.default_backend(),
+        "serving_config": base,
+        "tiering": tiered["tiering"],
+        "requests": n_req,
+        "step_dt_s": round(step_s, 6),
+        "bit_identical": bit_identical,
+        "replay_counters": replay_counters,
+        "counters": tier_counters,
+        "tiers": tiers,
+        "host_metadata": host_meta,
+        "restore_stall_p99_ms": p99,
+        "restore_samples": len(stall_ms),
+        "resident_sessions_at_fixed_hbm": {
+            "sessions_offered": n_sessions,
+            "chain_pages_per_session": chain_pages,
+            "baseline_sessions": baseline_sessions,
+            "tiered_sessions": tiered_sessions,
+            "ratio": resident_ratio,
+            "pr14_ratio": 2.083,
+        },
+        "resident_pin_min_ratio": min_ratio,
+        "resident_pin_ok": resident_ratio >= min_ratio,
+        "decode_step": {
+            "tiering_off_ms": step_off_ms,
+            "tiering_on_idle_ms": step_on_ms,
+            "delta_pct": step_delta_pct,
+        },
+    }
+    with open(os.path.join(_BENCH_DIR, "BENCH_pr17.json"), "w") as fh:
+        json.dump(pr17, fh, indent=1)
+    return pr17
+
+
 def run_kv_quant_bench():
     """BENCH_pr12.json (ISSUE 12): quantized KV pages + quantized remaining
     wire. Four measurements:
@@ -2546,6 +2818,19 @@ def main():
             result["kv_heat_reconcile_ok"] = pr16["reconcile_ok"]
         except Exception as e:
             result["pr16_error"] = f"{type(e).__name__}: {e}"
+    # --- BENCH_pr17.json (ISSUE 17): host-DRAM KV tier — bit-identical
+    # replay tiering on/off, resident sessions at fixed HBM across tiers,
+    # restore-stall p99, decode-step latency with the tier idle
+    if os.environ.get("BENCH_SERVING", "1") == "1":
+        try:
+            pr17 = run_kv_tiering_bench()
+            result["pr17_artifact"] = "BENCH_pr17.json"
+            result["kv_tiering_bit_identical"] = pr17["bit_identical"]
+            result["kv_tiering_resident_ratio"] = (
+                pr17["resident_sessions_at_fixed_hbm"]["ratio"]
+            )
+        except Exception as e:
+            result["pr17_error"] = f"{type(e).__name__}: {e}"
     # --- BENCH_pr12.json (ISSUE 12): int8 KV pages + quantized remaining
     # wire — Engine E kv-pool bf16-vs-int8, resident sessions at fixed HBM,
     # decode latency at the 151MB-equivalent pool, and the two new
@@ -2691,6 +2976,9 @@ if __name__ == "__main__":
     elif os.environ.get("BENCH_KVHEAT_ONLY", "0") == "1":
         # ISSUE 16: just the page-heat measurement plane (BENCH_pr16.json)
         print(json.dumps(run_kv_heat_bench()))
+    elif os.environ.get("BENCH_KVTIER_ONLY", "0") == "1":
+        # ISSUE 17: just the host-DRAM KV tier bench (BENCH_pr17.json)
+        print(json.dumps(run_kv_tiering_bench()))
     elif os.environ.get("BENCH_KVQUANT_ONLY", "0") == "1":
         # ISSUE 12: just the KV-quantization + compressed-wire bench
         # (BENCH_pr12.json) — pins 8 host devices so the collective paths

@@ -91,6 +91,10 @@ MODEL_RULES: Dict[str, str] = {
         "disaggregated admission reserved on both allocators but a terminal "
         "path released only one pool"
     ),
+    "proto-host-tier-bound": (
+        "host-tier occupancy left the [0, host_budget] envelope: a demotion "
+        "or restore miscounted the host-resident pages"
+    ),
 }
 
 #: Known-bug mutations for the self-test gate.  Each flips one guard in the
@@ -104,6 +108,8 @@ MUTATIONS: FrozenSet[str] = frozenset(
         "double-free-finish", # finish releases the slot's pages twice
         "decode-after-free",  # retry rewind frees pages but keeps decoding
         "skip-queue-drain",   # drain forgets to reject the queued backlog
+        "drop-host-free",     # prefix demotion copies to host but skips the
+                              # device-side free (page owned by neither tier)
     }
 )
 
@@ -132,6 +138,8 @@ class ProtoModelConfig:
     prefix_cache: bool = True
     retry_max: int = 1
     allow_timeout: bool = True
+    tiering: bool = False      # host-DRAM second tier for evicted prefix pages
+    host_budget: int = 1       # host-tier slots (page capacity of the store)
     mutations: FrozenSet[str] = frozenset()
     max_states: int = 200_000
 
@@ -139,6 +147,10 @@ class ProtoModelConfig:
         bad = set(self.mutations) - set(MUTATIONS)
         if bad:
             raise ValueError(f"unknown protocol mutations: {sorted(bad)}")
+        if self.tiering and not self.prefix_cache:
+            raise ValueError("tiering requires prefix_cache (demotion source)")
+        if self.tiering and self.host_budget < 1:
+            raise ValueError("tiering requires host_budget >= 1")
 
     # Pools are sized so admission can transiently block (pool pressure is
     # part of the explored behaviour) but never permanently starve: enough
@@ -198,6 +210,7 @@ def _initial(cfg: ProtoModelConfig):
         cfg.prefill_capacity,
         cfg.decode_capacity,
         0,       # index_pages: full pages resident in the prefix chain
+        0,       # host_pages: prefix pages demoted to the host-DRAM tier
         False,   # draining
     )
 
@@ -207,7 +220,7 @@ def _ev(name: str, i: Optional[int] = None) -> str:
 
 
 def _enabled(cfg: ProtoModelConfig, st) -> List[str]:
-    reqs, free_p, free_d, index, draining = st
+    reqs, free_p, free_d, index, host, draining = st
     P, R = cfg.prompt_pages, cfg.reserve_pages
     active = sum(1 for r in reqs if r[0] in (_PREFILL, _HANDOFF, _DECODE))
     out: List[str] = []
@@ -250,13 +263,18 @@ def _enabled(cfg: ProtoModelConfig, st) -> List[str]:
     if not draining:
         out.append("drain")
     if index > 0 and all(r[3] == 0 and r[4] == 0 for r in reqs):
-        out.append("evict_prefix")
+        # With a host tier configured the LRU prefix eviction *demotes* the
+        # page to host DRAM instead of dropping it (ISSUE 17); the device
+        # page is freed either way.
+        out.append("demote_prefix" if cfg.tiering else "evict_prefix")
+    if cfg.tiering and host > 0 and free_p > 0:
+        out.append("restore_prefix")
     return out
 
 
 def _apply(cfg: ProtoModelConfig, st, ev: str):
     """Apply ``ev`` to ``st``; return ``(next_state, violation_rule|None)``."""
-    reqs, free_p, free_d, index, draining = st
+    reqs, free_p, free_d, index, host, draining = st
     reqs = list(reqs)
     P, R = cfg.prompt_pages, cfg.reserve_pages
     vio: Optional[str] = None
@@ -379,20 +397,46 @@ def _apply(cfg: ProtoModelConfig, st, ev: str):
     elif name == "evict_prefix":
         index -= 1
         free_p += 1
+    elif name == "demote_prefix":
+        # LRU prefix eviction with a host tier: the page's KV moves to a
+        # host slot (evicting the host LRU first when the store is full, so
+        # host occupancy saturates at the budget) and the device page is
+        # freed.  ``drop-host-free`` skips that free: the page is then owned
+        # by neither tier and device conservation breaks.
+        index -= 1
+        if "drop-host-free" not in cfg.mutations:
+            free_p += 1
+        host = min(host + 1, cfg.host_budget)
+    elif name == "restore_prefix":
+        # A prefix hit on a demoted chain restores the page into a freshly
+        # allocated device page and drops the host copy.
+        host -= 1
+        index += 1
+        free_p -= 1
     else:  # pragma: no cover - defensive
         raise ValueError(f"unknown event {ev!r}")
 
-    nxt = (tuple(reqs), free_p, free_d, index, draining)
+    nxt = (tuple(reqs), free_p, free_d, index, host, draining)
     return nxt, vio
 
 
 def _check_state(cfg: ProtoModelConfig, st) -> Optional[Tuple[str, str]]:
     """Invariant check; returns ``(rule, message)`` or ``None``."""
-    reqs, free_p, free_d, index, draining = st
+    reqs, free_p, free_d, index, host, draining = st
     if free_p < 0 or free_d < 0 or index < 0:
         return (
             "proto-refcount-conservation",
             f"negative counter: free_p={free_p} free_d={free_d} index={index}",
+        )
+    if host < 0 or host > cfg.host_budget:
+        return (
+            "proto-host-tier-bound",
+            f"host tier holds {host} page(s), budget {cfg.host_budget}",
+        )
+    if host and not cfg.tiering:
+        return (
+            "proto-host-tier-bound",
+            f"host tier holds {host} page(s) with tiering disabled",
         )
     if any(min(r[1:6]) < 0 for r in reqs):
         return ("proto-refcount-conservation", "negative per-request counter")
@@ -497,6 +541,8 @@ def model_findings(
 ) -> List[Finding]:
     """Render a report's violations as standard Engine-G findings."""
     mode = "disagg" if report.config.disaggregated else "shared"
+    if report.config.tiering:
+        mode += "+tiered"
     out = []
     for v in report.violations:
         trace = " -> ".join(v.trace) if v.trace else "<initial state>"
@@ -668,12 +714,15 @@ class ProtocolMonitor:
 def apply_engine_mutation(srv, name: str):
     """Re-introduce a model mutation into a live engine; returns an undo().
 
-    Only the two gate mutations are supported on the real engine:
+    Only the gate mutations are supported on the real engine:
 
     * ``drop-drain-free`` — preempted slots keep their pages (the drain
       path's frees are skipped), reproducing the leak the model finds;
     * ``skip-cow-fork`` — a full prefix hit maps the shared tail page into
-      the writable row instead of forking it by recompute.
+      the writable row instead of forking it by recompute;
+    * ``drop-host-free`` — prefix demotion copies the page into the host
+      tier but skips the device-side free, so the page is owned by neither
+      tier (needs ``serving.tiering`` enabled).
     """
     from deepspeed_tpu.serving.request import RequestStatus
 
@@ -740,6 +789,30 @@ def apply_engine_mutation(srv, name: str):
 
         return undo
 
+    if name == "drop-host-free":
+        if getattr(srv, "tiering", None) is None:
+            raise ValueError("drop-host-free needs serving.tiering enabled")
+        cache = srv.prefix_cache
+        alloc = cache.allocator
+        orig_evict_one = cache._evict_one
+
+        def evict_one():
+            # demotion runs inside _evict_one; silence the device-side free
+            # for its duration so the demoted page stays allocated
+            orig_free = alloc.free
+            alloc.free = lambda pages: None
+            try:
+                return orig_evict_one()
+            finally:
+                alloc.free = orig_free
+
+        cache._evict_one = evict_one
+
+        def undo():
+            cache._evict_one = orig_evict_one
+
+        return undo
+
     raise ValueError(f"unsupported engine mutation: {name!r}")
 
 
@@ -784,8 +857,16 @@ def replay_trace(
                 clock.advance(1e6)
             srv.step()
             steps += 1
+        elif name == "demote_prefix":
+            # tiered LRU eviction: force one leaf out of the index; with the
+            # tier wired its KV demotes to the host store
+            pc = srv.prefix_cache
+            if pc is not None and len(pc):
+                pc.evict(keep=len(pc) - 1)
+            if getattr(srv, "tiering", None) is not None:
+                srv.tiering.flush()
         elif name in ("admit", "prefill_done", "handoff", "decode", "retry",
-                      "preempt", "evict_prefix"):
+                      "preempt", "evict_prefix", "restore_prefix"):
             if not drained:
                 srv.step()
                 steps += 1

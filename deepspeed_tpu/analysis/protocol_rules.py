@@ -90,6 +90,12 @@ RULES = {
 }
 
 _PROTO_OPS = ("alloc", "retain", "free")
+# host-tier handoff ops (ISSUE 17): the callee takes ownership of the page
+# argument — ``PrefixCache.adopt`` installs a restored page into the index
+# and ``KVTieringEngine.demote_begin`` moves a page's KV into the host
+# store. Both sides are audited holders (``check_no_leaks`` reconciles the
+# index and the host store), so a handoff discharges like an escape.
+_HANDOFF_OPS = ("adopt", "demote", "demote_begin")
 # attribute names whose stores mean "this is now a writable page set"
 _PAGE_ATTRS = ("pages", "prefill_pages", "row")
 # per-function path-state cap: states merge aggressively (most statements
@@ -357,6 +363,17 @@ class _FunctionCheck:
                             (frozenset(owns), frozenset(freed)),
                             a, call.lineno, f".{call.func.attr}()",
                         )
+                # host-tier handoff: ownership of the page args transfers
+                # to an audited holder (index / host store) — discharge
+                if (
+                    isinstance(call.func, ast.Attribute)
+                    and call.func.attr in _HANDOFF_OPS
+                    and call.args
+                ):
+                    handed = frozenset().union(
+                        *[_names(a) for a in call.args]
+                    )
+                    owns = {o for o in owns if not (o[3] & handed)}
                 continue
             op, recv = m
             arg_names = frozenset().union(

@@ -435,6 +435,78 @@ def main() -> int:
     except Exception as e:
         print(f"kv heat ............. {RED_NO} ({type(e).__name__}: {e})")
     print("-" * 60)
+    print("KV tiering (ISSUE 17):")
+    try:
+        import json
+        import os
+
+        from deepspeed_tpu.runtime.config import TieringConfig
+        from deepspeed_tpu.serving.tiering import TIERING_POLICIES
+
+        tcfg = TieringConfig()
+        print(
+            f"host-DRAM tier ...... {GREEN_OK} serving.tiering — "
+            f"{'on' if tcfg.enabled else 'off'} by default; policies: "
+            f"{', '.join(TIERING_POLICIES)} (default {tcfg.policy})"
+        )
+        print(
+            f"knobs ............... host_budget_pages="
+            f"{tcfg.host_budget_pages} (0 = device pool capacity), "
+            f"prefetch_depth={tcfg.prefetch_depth}, "
+            f"crc={'on' if tcfg.crc else 'off'}"
+        )
+        # tier sizes + spill/restore counters come from the committed bench
+        # artifact — env_report stays cheap (no serving replay here)
+        bench_path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "BENCH_pr17.json",
+        )
+        if os.path.exists(bench_path):
+            with open(bench_path, encoding="utf-8") as fh:
+                doc = json.load(fh)
+            tiers = doc.get("tiers") or {}
+            if tiers:
+                print(
+                    f"  tier sizes ........ device {tiers.get('device_pages')}"
+                    f" pages / host {tiers.get('host_budget_pages')} pages "
+                    f"x {tiers.get('page_bytes')} B "
+                    f"(host buffer {(tiers.get('host_bytes') or 0) / 1e6:.2f}"
+                    " MB pinned)"
+                )
+            run = doc.get("tiering") or {}
+            cnt = doc.get("counters") or {}
+            if cnt:
+                print(
+                    f"  spill/restore ..... policy {run.get('policy')}: "
+                    f"{cnt.get('spills')} spills "
+                    f"({(cnt.get('spilled_bytes') or 0) / 1e6:.2f} MB) / "
+                    f"{cnt.get('restores')} restores, "
+                    f"{cnt.get('restore_misses', 0)} cold miss(es), "
+                    f"{cnt.get('host_evictions', 0)} host eviction(s)"
+                )
+            p99 = doc.get("restore_stall_p99_ms")
+            if p99 is not None:
+                print(f"  restore stall ..... p99 {p99} ms "
+                      "(queue-wait cause: kv_restore)")
+            res = doc.get("resident_sessions_at_fixed_hbm") or {}
+            if res:
+                print(
+                    f"  resident sessions  {res.get('tiered_sessions')} vs "
+                    f"{res.get('baseline_sessions')} untiered at fixed HBM "
+                    f"(x{res.get('ratio')}; PR-14 baseline "
+                    f"x{res.get('pr14_ratio')})"
+                )
+        else:
+            print("  tier metrics ...... unmeasured — run bench.py "
+                  "(BENCH_KVTIER_ONLY=1)")
+        print(
+            "cross-check ......... python -m deepspeed_tpu.tools.kv_heat "
+            "kv_heat.jsonl --policy idle_lru (what-if simulator vs live "
+            "tier, field-by-field)"
+        )
+    except Exception as e:
+        print(f"kv tiering .......... {RED_NO} ({type(e).__name__}: {e})")
+    print("-" * 60)
     return 0
 
 

@@ -1026,6 +1026,56 @@ class PlacementConfig(DSConfigModel):
 
 
 @dataclass
+class TieringConfig(DSConfigModel):
+    """serving.tiering section (ISSUE 17): host-DRAM second tier for cold
+    KV pages — ZeRO-Infinity's overlap-the-slow-tier pattern (arXiv
+    2104.07857) applied to the serving page pool.
+
+    When enabled (requires ``serving.prefix_cache``), PrefixCache LRU-leaf
+    eviction *demotes* pages into pinned host numpy buffers instead of
+    dropping them (``serving/tiering.py:HostPageStore``, same
+    ``[L, P, KV, page, D]`` layout as the device pool, int8 codes+scales
+    spill as-is). A later prompt re-hitting the demoted prefix restores the
+    page through one compiled width-1 scatter program
+    (``serving_kv_restore``) at admission — a ``kv_restore`` queue-wait in
+    the request trace — instead of recomputing it. Device→host copies run
+    on a background worker off the step path (the async_swapper pattern)."""
+
+    enabled: bool = False
+    # host slots (pages) in the second tier; 0 = auto-size to the device
+    # pool's capacity (every device page could go cold at once)
+    host_budget_pages: int = 0
+    # spill-victim policy — must be one of telemetry.kv_heat.SPILL_POLICIES
+    # (idle_lru: oldest direct touch first; prefix_aware: non-index pages
+    # first; slot_priority: idle/ended sessions first). The PR-16 what-if
+    # evaluator ranks these offline from a recorded heat trace.
+    policy: str = "idle_lru"
+    # max pages restored from host per admission attempt (bounds the
+    # synchronous device_put work a single step can absorb)
+    prefetch_depth: int = 4
+    # CRC32 every spilled buffer and verify on restore; a mismatch demotes
+    # the hit to a cold miss (recompute) instead of decoding corrupt KV
+    crc: bool = True
+
+    def __post_init__(self):
+        if self.policy not in ("idle_lru", "prefix_aware", "slot_priority"):
+            raise DeepSpeedConfigError(
+                "serving.tiering.policy must be one of 'idle_lru', "
+                f"'prefix_aware', 'slot_priority'; got {self.policy!r}"
+            )
+        if int(self.host_budget_pages) < 0:
+            raise DeepSpeedConfigError(
+                "serving.tiering.host_budget_pages must be >= 0, got "
+                f"{self.host_budget_pages}"
+            )
+        if int(self.prefetch_depth) < 1:
+            raise DeepSpeedConfigError(
+                "serving.tiering.prefetch_depth must be >= 1, got "
+                f"{self.prefetch_depth}"
+            )
+
+
+@dataclass
 class ServingConfig(DSConfigModel):
     """serving section (TPU-native; no reference analog — the reference serves
     one static batch per ``InferenceEngine.forward`` call). Drives the
@@ -1098,6 +1148,8 @@ class ServingConfig(DSConfigModel):
     slo: SLOConfig = field(default_factory=SLOConfig)
     # --- ISSUE 14: tensor-parallel sharding + prefill/decode disaggregation
     placement: PlacementConfig = field(default_factory=PlacementConfig)
+    # --- ISSUE 17: host-DRAM second tier for cold KV pages -----------------
+    tiering: TieringConfig = field(default_factory=TieringConfig)
 
     def __post_init__(self):
         for key in ("max_slots", "page_size", "num_pages", "max_prompt_len",
@@ -1116,6 +1168,14 @@ class ServingConfig(DSConfigModel):
             self.slo = SLOConfig.from_dict(self.slo)
         if isinstance(self.placement, dict):
             self.placement = PlacementConfig.from_dict(self.placement)
+        if isinstance(self.tiering, dict):
+            self.tiering = TieringConfig.from_dict(self.tiering)
+        if self.tiering.enabled and not self.prefix_cache.enabled:
+            raise DeepSpeedConfigError(
+                "serving.tiering requires serving.prefix_cache (demotion "
+                "spills prefix-index pages; there is nothing to tier "
+                "without the index)"
+            )
         if int(self.prefill_chunk_tokens) < 0:
             raise DeepSpeedConfigError(
                 "serving.prefill_chunk_tokens must be >= 0, got "

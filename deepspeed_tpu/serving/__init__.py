@@ -14,6 +14,9 @@ The layer above the kernels that wins serving throughput at scale (PAPERS.md
 - :mod:`~deepspeed_tpu.serving.replay` — the seeded trace-replay workload
   harness (bursty arrivals, heavy-tailed prompts, hot-tenant prefix skew;
   ISSUE 11) that scores goodput + SLO attainment from request traces
+- :mod:`~deepspeed_tpu.serving.tiering` — the host-DRAM second tier for
+  cold KV pages (:class:`HostPageStore` + :class:`KVTieringEngine`;
+  ISSUE 17): prefix demotion, async spill, compiled width-1 restore
 
 Entry point: ``deepspeed_tpu.init_inference(...).serve(serving_config)``, or
 the ``serving`` section of the engine config. See docs/SERVING.md and
@@ -39,8 +42,22 @@ from .replay import (
 )
 from .request import Request, RequestStatus
 from .scheduler import ServingEngine
+from .tiering import (
+    TIERING_POLICIES,
+    HostPageStore,
+    HostTierError,
+    KVTieringEngine,
+    policy_victim_key,
+    replay_live_tier,
+)
 
 __all__ = [
+    "HostPageStore",
+    "HostTierError",
+    "KVTieringEngine",
+    "TIERING_POLICIES",
+    "policy_victim_key",
+    "replay_live_tier",
     "PageAllocator",
     "PageAllocatorError",
     "PrefixCache",

@@ -309,6 +309,16 @@ class PrefixCache:
         self.evictions = 0
         # ISSUE 16: optional KVHeatLedger (register/hit/evict hooks)
         self.heat = None
+        # ISSUE 17: host-tier hooks. ``demote_sink`` (a KVTieringEngine)
+        # receives (key, pid) BEFORE an evicted leaf's device page frees —
+        # the page moves to the host tier instead of vanishing.
+        # ``victim_order`` ranks the evictable leaves ([(key, pid)] → the
+        # chosen pair) under the configured spill policy; None keeps the
+        # plain LRU order.
+        self.demote_sink = None
+        self.victim_order = None
+        self.demotions = 0
+        self.adoptions = 0
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -416,20 +426,76 @@ class PrefixCache:
         return added
 
     def _evict_one(self) -> bool:
-        """Release the least-recently-used LEAF entry. → False if none."""
-        for key in self._entries:  # insertion(/recency) order
-            if self._children.get(key, 0) == 0:
-                pid = self._entries.pop(key)
-                parent = self._parent.pop(key)
-                self._children.pop(key, None)
-                if parent is not None and parent in self._children:
-                    self._children[parent] -= 1
-                self.allocator.free([pid])
-                if self.heat is not None:
-                    self.heat.evict(pid)
-                self.evictions += 1
-                return True
-        return False
+        """Release one evictable LEAF entry — the LRU one, unless a
+        ``victim_order`` policy reranks the candidates. → False if none.
+
+        ISSUE 17 demotion: when a ``demote_sink`` is wired and the index
+        holds the page's LAST reference (a still-shared page stays
+        device-live with its other holder — duplicating it host-side would
+        fork ownership), the sink snapshots the page to the host tier
+        FIRST. Ordering is load-bearing for the cross-tier ledger: the
+        sink's D event lands before the F/E pair below, so no trace prefix
+        ever shows the page in neither tier (satellite 2, pinned by the
+        lockstep-fuzz test)."""
+        leaves = [(key, pid) for key, pid in self._entries.items()
+                  if self._children.get(key, 0) == 0]
+        if not leaves:
+            return False
+        if self.victim_order is not None:
+            key, pid = self.victim_order(leaves)
+        else:
+            key, pid = leaves[0]  # insertion(/recency) order = LRU
+        self._entries.pop(key)
+        parent = self._parent.pop(key)
+        self._children.pop(key, None)
+        if parent is not None and parent in self._children:
+            self._children[parent] -= 1
+        if self.demote_sink is not None and self.allocator.refcount(pid) == 1:
+            if self.demote_sink.demote_begin(key, pid) is not None:
+                self.demotions += 1
+        self.allocator.free([pid])
+        if self.heat is not None:
+            self.heat.evict(pid)
+        self.evictions += 1
+        return True
+
+    def adopt(self, key: Tuple, pid: int) -> None:
+        """Re-insert a host-restored page under its original chain ``key``
+        (ISSUE 17 restore path). The caller hands over a freshly allocated
+        refcount-1 page whose K/V was just device_put from the host tier —
+        ownership transfers to the index (no extra retain), exactly undoing
+        what demotion's free released. The parent link must already be
+        resident (restores walk the chain root→leaf)."""
+        parent = key[0]
+        if key in self._entries:
+            raise PageAllocatorError(f"prefix key already resident: {key!r}")
+        if parent is not None and parent not in self._entries:
+            raise PageAllocatorError(
+                "adopt out of chain order: parent key not resident"
+            )
+        self._entries[key] = int(pid)
+        self._parent[key] = parent
+        self._children[key] = 0
+        if parent is not None:
+            self._children[parent] += 1
+        if self.heat is not None:
+            self.heat.register([int(pid)])
+        self.adoptions += 1
+
+    def chain_keys(self, prompt: np.ndarray) -> List[Tuple]:
+        """The prompt's full chain keys root→leaf (same ``(plen-1)//page``
+        cap as :meth:`lookup`), resident or not — the restore prefetch
+        walks this list checking each tier."""
+        plen = int(np.asarray(prompt).shape[-1])
+        page = self.page_size
+        limit = max(0, (plen - 1) // page)
+        keys: List[Tuple] = []
+        parent: Optional[Tuple] = None
+        for j in range(limit):
+            key = self._key(parent, prompt[j * page:(j + 1) * page])
+            keys.append(key)
+            parent = key
+        return keys
 
     def evict(self, keep: Optional[int] = None, need_free: int = 0) -> int:
         """Evict LRU leaves until the index holds ≤ ``keep`` entries (when

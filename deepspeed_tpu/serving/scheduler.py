@@ -84,6 +84,7 @@ from .kv_cache import (
 )
 from .placement import Placement, ProgramSet
 from .request import Request, RequestStatus
+from .tiering import HostPageStore, KVTieringEngine
 
 # TTFT/TPOT/queue-wait histogram buckets (seconds): sub-ms CPU-sim steps
 # through multi-second queue waits. Defined in telemetry/request_trace.py so
@@ -336,6 +337,37 @@ class ServingEngine:
                         max_pages=int(pcfg.max_pages) if pcfg else 0)
             if self.prefix_enabled else None
         )
+        # -- ISSUE 17: host-DRAM second tier for cold prefix pages ---------
+        # The prefix index holds the only cross-request pages, so demotion
+        # tiers on the PREFILL placement's pool (which IS the decode pool in
+        # shared mode): evicted leaves spill to pinned host buffers instead
+        # of dropping, and a later prompt re-hitting the chain restores them
+        # through one compiled width-1 scatter (serving_kv_restore).
+        tcfg = getattr(config, "tiering", None)
+        self.tiering_enabled = bool(
+            tcfg and tcfg.enabled and self.prefix_cache is not None
+        )
+        self.tiering: Optional[KVTieringEngine] = None
+        if self.tiering_enabled:
+            budget = int(tcfg.host_budget_pages) or self.prefill_set.allocator.capacity
+            store = HostPageStore(
+                budget,
+                n_layer=mcfg.n_layer,
+                n_kv_head=mcfg.n_head,  # GLOBAL layout: device_get unshards
+                page_size=page,
+                head_dim=mcfg.head_dim,
+                dtype=self.cache_dtype,
+                quantized=self.quantized,
+                crc=bool(tcfg.crc),
+            )
+            self.tiering = KVTieringEngine(
+                store, self.prefill_set,
+                policy=str(tcfg.policy),
+                prefetch_depth=int(tcfg.prefetch_depth),
+                clock=self.clock,
+            )
+            self.prefix_cache.demote_sink = self.tiering
+            self.prefix_cache.victim_order = self.tiering.select_leaf
         cw = int(getattr(config, "prefill_chunk_tokens", 0) or 0)
         self._chunk_cold = cw > 0  # chunk long COLD prompts too
         if cw > 0:
@@ -520,6 +552,7 @@ class ServingEngine:
         self._chunk_exec = None
         self._gather_exec = None
         self._scatter_exec = None
+        self._restore_exec = None
         self.executables: List[Any] = []
         # program name -> {"exe", "pset", "kind"} (built by _ensure_compiled;
         # verify() derives per-program local shapes and aliasing from it)
@@ -569,10 +602,12 @@ class ServingEngine:
         step REPLACES the plain decode step when enabled — never both), the
         chunk-prefill program when chunking or the prefix cache needs it,
         and — under disaggregated placements (ISSUE 14) — the KV-handoff
-        gather + scatter pair."""
+        gather + scatter pair; the host tier (ISSUE 17) adds the width-1
+        ``serving_kv_restore`` scatter."""
         return (
             2 + (1 if self.chunk_width > 0 else 0)
             + (2 if self.disaggregated else 0)
+            + (1 if self.tiering_enabled else 0)
         )
 
     # ------------------------------------------------------------------
@@ -625,6 +660,10 @@ class ServingEngine:
         if self.prefix_cache is not None:
             # the index lives on the prefill placement's pool
             self.prefix_cache.heat = self._heat_prefill
+        if self.tiering is not None:
+            # the tier spills/restores prefill-pool pages: its D/U/V events
+            # and policy victim keys read the same ledger
+            self.tiering.ledger = self._heat_prefill
         self._heat = tracer
 
     def detach_heat(self) -> None:
@@ -634,6 +673,8 @@ class ServingEngine:
         self.prefill_set.allocator.heat = None
         if self.prefix_cache is not None:
             self.prefix_cache.heat = None
+        if self.tiering is not None:
+            self.tiering.ledger = None
         self._heat = None
         self._heat_decode = None
         self._heat_prefill = None
@@ -667,11 +708,15 @@ class ServingEngine:
         )
         draft_b = self.draft_index_bytes()
         heat_b = self._heat.ledger_bytes() if self._heat is not None else 0
+        tier_b = (
+            self.tiering.store.host_bytes() if self.tiering is not None else 0
+        )
         return {
             "prefix_index_bytes": prefix_b,
             "draft_index_bytes": draft_b,
             "heat_ledger_bytes": heat_b,
-            "total_bytes": prefix_b + draft_b + heat_b,
+            "kv_host_tier_bytes": tier_b,
+            "total_bytes": prefix_b + draft_b + heat_b + tier_b,
         }
 
     # ------------------------------------------------------------------
@@ -814,6 +859,9 @@ class ServingEngine:
         if self.disaggregated:
             self._compile_handoff(info, quant, S, i32)
 
+        if self.tiering_enabled:
+            self._compile_restore(info, quant, S, i32)
+
         self._program_info = info
         self._set_collective_gauges()
 
@@ -890,6 +938,49 @@ class ServingEngine:
             "exe": self._scatter_exec, "pset": dset, "kind": "scatter",
         }
         self.executables.append(self._scatter_exec)
+
+    def _compile_restore(self, info: dict, quant: bool, S, i32) -> None:
+        """The host-tier restore program (ISSUE 17): a width-1 scatter into
+        the PREFILL placement's pool (where the prefix index lives) —
+        ``(pools..., packed_k, packed_v[, packed_s], dst) -> pools`` with
+        the pools donated, so a restore rewrites exactly one page column in
+        place. The packed operands arrive as host numpy straight out of the
+        :class:`HostPageStore` buffers (the ``device_put`` leg of the
+        async_swapper pattern rides the program's own operand transfer)."""
+        def restore_fn(k_pool, v_pool, *rest):
+            scales, packed = _split_scales(rest, quant)
+            if quant:
+                pk, pv, ps, dst = packed
+            else:
+                pk, pv, dst = packed
+            k_pool = k_pool.at[:, dst].set(pk)
+            v_pool = v_pool.at[:, dst].set(pv)
+            if quant:
+                return k_pool, v_pool, scales.at[:, dst].set(ps)
+            return k_pool, v_pool
+
+        sfx = "_int8" if quant else ""
+        pp, pset = self.prefill_placement, self.prefill_set
+        pools = pset.pool_args()
+        packed_sds = tuple(
+            S((p.shape[0], 1) + tuple(p.shape[2:]), p.dtype) for p in pools
+        )
+        args = pools + packed_sds + (S((1,), i32),)
+        dn = tuple(range(len(pools)))
+        if pp.mesh is None:
+            self._restore_exec = pp.aot(restore_fn, args, (), (), dn)
+        else:
+            pool_specs = tuple(pp.pool_spec(p.ndim) for p in pools)
+            self._restore_exec = pp.aot(
+                restore_fn, args,
+                pool_specs + pool_specs + (pp.rep_spec(),),
+                pool_specs, dn,
+            )
+        info[f"serving_kv_restore{sfx}{pp.suffix()}"] = {
+            "exe": self._restore_exec, "pset": pset, "kind": "restore",
+        }
+        self.executables.append(self._restore_exec)
+        self.tiering.bind_restore_exec(self._restore_exec)
 
     def _set_collective_gauges(self) -> None:
         """Static per-invocation all-reduce payload of each TP program: the
@@ -1073,6 +1164,16 @@ class ServingEngine:
             if idx is None:
                 break
             req = self.queue[idx]
+            # ISSUE 17: before costing the reservation, restore any of the
+            # prompt's demoted prefix pages from the host tier (each restore
+            # turns a would-be recompute page into a mapped hit, shrinking
+            # `need` below). Depth-bounded per step — a long host-held chain
+            # keeps the request queued with a kv_restore wait and continues
+            # next step rather than absorbing unbounded device_put work.
+            if self.tiering is not None and self._tier_prefetch(req, now):
+                if self.tracer is not None:
+                    self.tracer.note_wait(req, "kv_restore")
+                break
             # under disaggregation BOTH placements gate admission: the
             # decode pool must hold the full private reservation, the
             # prefill pool the prompt pages net of prefix hits. The index
@@ -1285,6 +1386,8 @@ class ServingEngine:
         self._g_pages_shared.set(self.allocator.pages_shared)
         if self.prefix_cache is not None:
             self._g_index_pages.set(len(self.prefix_cache))
+        if self.tiering is not None:
+            self._tier_pump()
         if self._step_count and self._step_count % 32 == 0:
             self.stats()  # refresh the quantile gauges for textfile scrapes
         return n_active
@@ -1309,6 +1412,67 @@ class ServingEngine:
         if self.prefix_cache is None:
             return pp
         return pp - self.prefix_cache.probe(req.prompt)
+
+    # ------------------------------------------------------------------
+    # ISSUE 17: host-tier restore prefetch + background spill pump
+    # ------------------------------------------------------------------
+    def _tier_prefetch(self, req: Request, now: float) -> bool:
+        """Walk ``req``'s prefix chain root→leaf and restore every link the
+        host tier holds back into freshly allocated prefill-pool pages (the
+        ``serving_kv_restore`` program), re-adopting each into the index so
+        the admission probe right after maps it as a plain hit. Returns
+        True when the restore budget (``tiering.prefetch_depth``) ran out
+        with host-held links remaining — the caller keeps the request
+        queued under a ``kv_restore`` wait and continues next step.
+
+        Miss semantics: a broken chain, a CRC-failed buffer, or an
+        exhausted pool all just stop the walk — the un-restored tail
+        re-prefills through the normal (chunked) path, bit-identically."""
+        pc = self.prefix_cache
+        tier = self.tiering
+        palloc = self.prefill_set.allocator
+        restored = 0
+        for key in pc.chain_keys(req.prompt):
+            if key in pc._entries:
+                continue  # already device-resident
+            if key not in tier.store:
+                break  # chain broken here: cold from this link on
+            if restored >= tier.prefetch_depth:
+                return True  # budget spent, host still holds links
+            try:
+                pids = palloc.alloc(1)
+            except PageAllocatorError:
+                break  # pool pressure: the relief-valve path takes over
+            t0 = self.clock()
+            if not tier.restore(key, pids[0]):
+                palloc.free(pids)  # cold miss (CRC/failed fill): recompute
+                break
+            pc.adopt(key, pids[0])
+            restored += 1
+            if self.tracer is not None:
+                t1 = self.clock()
+                self.tracer.event(
+                    req, "kv_restore", t1, page=int(pids[0]),
+                    bytes=tier.store.page_bytes, dur_s=t1 - t0,
+                )
+        return False
+
+    def _tier_pump(self) -> None:
+        """Keep free-page headroom by demoting cold index leaves to host
+        BEFORE admissions hit the relief valve: when the prefill pool's
+        free list drops under 1/8 capacity, evict (= demote, the sink is
+        wired) enough LRU leaves to climb back. The device-side snapshot
+        is dispatched here; the blocking device→host copy runs on the
+        spill worker — the step path never waits on host DMA."""
+        pc = self.prefix_cache
+        if pc is None or not len(pc):
+            return
+        palloc = self.prefill_set.allocator
+        low = max(1, palloc.capacity // 8)
+        if palloc.free_pages >= low:
+            return
+        pc.evict(need_free=low)
+        self._g_index_pages.set(len(pc))
 
     def _draft(self, req: Request) -> np.ndarray:
         """Host-side prompt-lookup draft (ISSUE 10): the continuation of the
@@ -1906,6 +2070,9 @@ class ServingEngine:
         self._g_queue.set(0)
         self._g_util.set(0.0)
         self._g_pages.set(self.allocator.pages_in_use)
+        if self.tiering is not None:
+            # land every in-flight spill before callers audit the tiers
+            self.tiering.flush()
         if self.tracer is not None:
             # every request is terminal now — make the records durable
             self.tracer.flush()
@@ -2132,6 +2299,10 @@ class ServingEngine:
                     prefix_cache=self.prefix_cache is not None,
                     retry_max=int(getattr(pcfg, "retry_max", 1)),
                     max_states=int(getattr(pcfg, "max_states", 200_000)),
+                    tiering=self.tiering is not None,
+                    host_budget=min(
+                        self.tiering.store.budget_pages, 2
+                    ) if self.tiering is not None else 1,
                 )
                 findings.extend(
                     dsproto.model_findings(dsproto.explore(mcfg))
@@ -2334,6 +2505,11 @@ class ServingEngine:
                 (pc.hits_full + pc.hits_partial) / lookups if lookups else None
             )
             out["prefix_host_metadata_bytes"] = pc.host_metadata_bytes()
+            out["prefix_demotions"] = pc.demotions
+            out["prefix_adoptions"] = pc.adoptions
+        # ISSUE 17: host-tier sizes + spill/restore traffic
+        if self.tiering is not None:
+            out["kv_tiering"] = {"enabled": True, **self.tiering.stats()}
         if self.spec_enabled:
             total, n = self._h_accept.stats()
             out["spec_steps"] = int(self._c_spec_steps.value())
@@ -2369,3 +2545,10 @@ class ServingEngine:
         assert all(not s.prefill_pages for s in self.slots)
         assert (self.table.block_tables == 0).all()
         assert (self.table.seq_lens == 0).all()
+        if self.tiering is not None:
+            # ISSUE 17: the host tier must be internally consistent, agree
+            # with the heat ledger's handle mirror, and never hold a key
+            # the device index also holds (exactly-one-tier)
+            self.tiering.flush()
+            err = self.tiering.check_consistent(self.prefix_cache)
+            assert err is None, f"host tier inconsistent at drain: {err}"

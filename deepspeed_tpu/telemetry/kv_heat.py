@@ -156,6 +156,12 @@ class KVHeatLedger:
         self.touch_steps = 0
         self.sessions_started = 0
         self.sessions_ended = 0
+        # -- ISSUE 17: host-tier mirror ------------------------------------
+        # live host handles (reconciles against HostPageStore.handles())
+        self.host_handles: Set[int] = set()
+        self.demotions = 0
+        self.restores_up = 0
+        self.host_drops = 0
         # -- segment buffers (sealed into the sink) -------------------------
         self._events: List[Tuple] = []
         self._touches: List[Tuple] = []
@@ -252,6 +258,33 @@ class KVHeatLedger:
         self.prefix_pages.discard(int(page))
         self.prefix_evictions += 1
         self._ev(("E", t, int(page)))
+
+    # -- host-tier-facing hooks (ISSUE 17: KVTieringEngine.ledger) ------
+    def demote(self, page: int, hid: int) -> None:
+        """Device page ``page`` is spilling to host handle ``hid``. Emitted
+        BEFORE the device-side free's F/E pair (PrefixCache._evict_one), so
+        every trace prefix shows the page owned by at least one tier."""
+        t = self._clock()
+        self.host_handles.add(int(hid))
+        self.demotions += 1
+        self._ev(("D", t, int(page), int(hid)))
+
+    def restore_up(self, hid: int, page: int) -> None:
+        """Host handle ``hid`` restored into freshly allocated device page
+        ``page`` — the host copy retires (exactly-one-tier)."""
+        t = self._clock()
+        self.host_handles.discard(int(hid))
+        self.page_last[int(page)] = t
+        self.restores_up += 1
+        self._ev(("U", t, int(hid), int(page)))
+
+    def host_drop(self, hid: int) -> None:
+        """Host handle ``hid`` evicted from the host tier (LRU pressure) —
+        the page now lives in NEITHER tier; a future hit is a cold miss."""
+        t = self._clock()
+        self.host_handles.discard(int(hid))
+        self.host_drops += 1
+        self._ev(("V", t, int(hid)))
 
     # -- scheduler-facing hooks ----------------------------------------
     def session_start(self, t: float, slot: int, rid: int, tenant: str,
@@ -368,7 +401,8 @@ class KVHeatLedger:
     def session_idle_ages(self, now: float) -> List[float]:
         return [now - ss["last"] for ss in self.sessions.values()]
 
-    def reconcile(self, allocator, prefix_cache=None) -> Optional[str]:
+    def reconcile(self, allocator, prefix_cache=None,
+                  host_store=None) -> Optional[str]:
         """Bit-exact cross-check of the derived mirror against the live
         allocator (and prefix index): the ISSUE 16 lockstep acceptance.
         Returns None when they agree, else a one-line mismatch."""
@@ -395,6 +429,14 @@ class KVHeatLedger:
                     f"prefix-held mirror diverged: ledger "
                     f"{sorted(self.prefix_pages)[:6]} != index {sorted(held)[:6]}"
                 )
+        if host_store is not None:
+            theirs = host_store.handles()
+            if self.host_handles != theirs:
+                return (
+                    f"host-handle mirror diverged: ledger "
+                    f"{sorted(self.host_handles)[:6]} != store "
+                    f"{sorted(theirs)[:6]}"
+                )
         return None
 
     def ledger_bytes(self) -> int:
@@ -404,6 +446,7 @@ class KVHeatLedger:
         for d in (self.refs, self.page_alloc_t, self.page_last, self.owner):
             total += sys.getsizeof(d) + 56 * len(d)
         total += sys.getsizeof(self.prefix_pages) + 28 * len(self.prefix_pages)
+        total += sys.getsizeof(self.host_handles) + 28 * len(self.host_handles)
         total += sys.getsizeof(self.sessions) + 256 * len(self.sessions)
         total += sys.getsizeof(self._events) + 96 * len(self._events)
         total += sys.getsizeof(self._touches) + 96 * len(self._touches)
@@ -845,6 +888,12 @@ def replay_heat(
             led.hit(ev[2], ev[3] if len(ev) > 3 else "")
         elif op == "E":
             led.evict(ev[2])
+        elif op == "D":
+            led.demote(ev[2], ev[3])
+        elif op == "U":
+            led.restore_up(ev[2], ev[3])
+        elif op == "V":
+            led.host_drop(ev[2])
         elif op == "S":
             led.session_start(float(ev[1]), int(ev[2]), ev[3], ev[4], ev[5])
         elif op == "X":
@@ -1142,6 +1191,10 @@ def heat_report(records: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
             "touch_steps": led.touch_steps,
             "sessions_started": led.sessions_started,
             "sessions_ended": led.sessions_ended,
+            "demotions": led.demotions,
+            "restores_up": led.restores_up,
+            "host_drops": led.host_drops,
+            "host_handles": len(led.host_handles),
             "occupancy": occ,
             "page_lifetime_s": {
                 "count": len(lifetimes),

@@ -73,8 +73,10 @@ LATENCY_BUCKETS = (
     1.0, 2.5, 5.0, 10.0, 30.0,
 )
 
-# admission-wait causes the scheduler attributes (span catalog, docs/REQUEST_TRACING.md)
-WAIT_CAUSES = ("no_free_slot", "page_budget", "backoff")
+# admission-wait causes the scheduler attributes (span catalog, docs/REQUEST_TRACING.md);
+# kv_restore (ISSUE 17): steps spent restoring demoted prefix pages from
+# the host tier before the request could be costed for admission
+WAIT_CAUSES = ("no_free_slot", "page_budget", "backoff", "kv_restore")
 
 
 class RequestTraceError(Exception):

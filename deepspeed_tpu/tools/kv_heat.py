@@ -2,7 +2,7 @@
 
     python -m deepspeed_tpu.tools.kv_heat KV_HEAT.jsonl \
         [--pool NAME] [--page N] [--heatmap] [--bins N] \
-        [--what-if] [--resident-fraction F] \
+        [--what-if] [--policy NAME] [--resident-fraction F] \
         [--min-cold-fraction PCT] [--threshold S] \
         [--max-overhead-pct PCT --bench BENCH.json] \
         [--diff B.jsonl --threshold-pct 10] [--json]
@@ -26,6 +26,12 @@ touches) and renders:
   each candidate eviction policy (idle-age LRU / prefix-aware /
   slot-priority), reporting hypothetical spills, restore stalls and host
   traffic — what ROADMAP item 2 picks its policy from;
+- the **policy cross-check** (``--policy``, ISSUE 17 satellite): the same
+  recorded stream replayed against the LIVE tier implementation
+  (``serving.tiering.replay_live_tier`` — real ``HostPageStore``, CRC
+  verified) under one named policy, and diffed field-by-field against the
+  what-if simulator's prediction; any divergence (victim order, residency
+  accounting, restore stalls) exits 1;
 - a **diff** (``--diff``): two runs' heat metrics compared, worse-than-
   threshold deltas flagged.
 
@@ -289,6 +295,69 @@ def _format_whatif(wi: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def _policy_crosscheck(
+    records, pool: str, policy: str, resident_fraction: float,
+    as_json: bool = False,
+) -> int:
+    """``--policy``: the what-if simulator's prediction vs the LIVE tier
+    implementation replaying the same stream (ISSUE 17 satellite). The two
+    must agree field-by-field — a delta means the simulator no longer
+    models the engine's victim order or residency accounting. Exit 0 in
+    agreement, 1 on any mismatch, 2 on an unknown policy."""
+    from ..serving.tiering import TIERING_POLICIES, replay_live_tier
+
+    if policy not in TIERING_POLICIES:
+        print(
+            f"kv_heat: unknown policy {policy!r} "
+            f"(have {list(TIERING_POLICIES)})", file=sys.stderr,
+        )
+        return 2
+    sim = evaluate_spill_policies(
+        records, pool, resident_fraction=resident_fraction,
+        policies=(policy,),
+    )["policies"][policy]
+    live = replay_live_tier(
+        records, pool, policy, resident_fraction=resident_fraction,
+    )
+    fields = sorted(set(sim) | set(live))
+    rows = [
+        {
+            "field": f,
+            "predicted": sim.get(f),
+            "live": live.get(f),
+            "match": sim.get(f) == live.get(f),
+        }
+        for f in fields
+    ]
+    mismatches = [r for r in rows if not r["match"]]
+    out = {
+        "pool": pool, "policy": policy,
+        "resident_fraction": resident_fraction,
+        "rows": rows, "mismatches": len(mismatches),
+    }
+    if as_json:
+        print(json.dumps(out, indent=1))
+    else:
+        lines = [
+            f"policy cross-check: pool {pool}  policy {policy}  resident "
+            f"{100.0 * resident_fraction:.0f}%",
+            f"{'field':<18} {'predicted':>12} {'live':>12}  flag",
+            "-" * 52,
+        ]
+        for r in rows:
+            lines.append(
+                f"{r['field']:<18} {r['predicted']:>12} {r['live']:>12}  "
+                f"{'' if r['match'] else 'MISMATCH'}"
+            )
+        lines.append("-" * 52)
+        lines.append(
+            f"{len(mismatches)} mismatch(es)" if mismatches
+            else "simulator and live tier agree"
+        )
+        print("\n".join(lines))
+    return 1 if mismatches else 0
+
+
 # ---------------------------------------------------------------------------
 # diff + gates
 # ---------------------------------------------------------------------------
@@ -416,9 +485,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="time windows for --heatmap / timeline width scale")
     p.add_argument("--what-if", action="store_true",
                    help="replay the trace through candidate spill policies")
+    p.add_argument("--policy", default=None, metavar="NAME",
+                   help="cross-check NAME's what-if prediction against the "
+                   "live tier implementation; mismatches exit 1")
     p.add_argument("--resident-fraction", type=float, default=0.5,
-                   metavar="F", help="--what-if resident set, fraction of "
-                   "capacity (default 0.5)")
+                   metavar="F", help="--what-if/--policy resident set, "
+                   "fraction of capacity (default 0.5)")
     p.add_argument("--min-cold-fraction", type=float, default=None,
                    metavar="PCT", help="gate: exit 1 if the pool's cold "
                    "fraction is below PCT%% (tiering viability floor)")
@@ -491,6 +563,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             )
             print(json.dumps(dr, indent=1) if args.json else _format_diff(dr))
             return 1 if (dr["regressions"] or gates) else 0
+        if args.policy is not None:
+            rc = _policy_crosscheck(
+                records, pool, args.policy, args.resident_fraction,
+                as_json=args.json,
+            )
+            if rc == 2:
+                return 2
+            return 1 if (rc or gates) else 0
         if args.what_if:
             wi = evaluate_spill_policies(
                 records, pool, resident_fraction=args.resident_fraction,

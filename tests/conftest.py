@@ -43,7 +43,8 @@ def pytest_collection_modifyitems(config, items):
     are acceptance gates that must stay inside the budget regardless of
     where their files sort."""
     if not _TPU_MODE:
-        _hoisted = ("serving", "lint", "resilience", "dsan", "dsmem", "heat")
+        _hoisted = ("serving", "lint", "resilience", "dsan", "dsmem", "heat",
+                    "tiering")
         items.sort(
             key=lambda item: 0
             if any(k in item.keywords for k in _hoisted) else 1
