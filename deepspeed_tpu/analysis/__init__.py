@@ -111,6 +111,7 @@ from .protocol_model import (  # noqa: F401
     default_model_configs,
     explore,
     model_findings,
+    replay_fleet_trace,
     replay_trace,
 )
 from .protocol_model import MODEL_RULES as PROTOCOL_MODEL_RULES  # noqa: F401

@@ -63,6 +63,7 @@ __all__ = [
     "default_model_configs",
     "explore",
     "model_findings",
+    "replay_fleet_trace",
     "replay_trace",
 ]
 
@@ -95,6 +96,16 @@ MODEL_RULES: Dict[str, str] = {
         "host-tier occupancy left the [0, host_budget] envelope: a demotion "
         "or restore miscounted the host-resident pages"
     ),
+    "proto-dual-emit": (
+        "a migrating (or migrated) session emitted a token on more than one "
+        "replica: the source kept decoding after the payload left, or the "
+        "destination decoded a slot the source still owns"
+    ),
+    "proto-replica-page-leak": (
+        "a replica died still holding pages (or index refs) owned by "
+        "sessions that no longer run there — a migration's source-side "
+        "release was skipped"
+    ),
 }
 
 #: Known-bug mutations for the self-test gate.  Each flips one guard in the
@@ -110,12 +121,25 @@ MUTATIONS: FrozenSet[str] = frozenset(
         "skip-queue-drain",   # drain forgets to reject the queued backlog
         "drop-host-free",     # prefix demotion copies to host but skips the
                               # device-side free (page owned by neither tier)
+        "drop-migration-free",  # migrate_commit forgets the SOURCE replica's
+                                # release: pages/refs leak across replica death
     }
 )
 
-# request lifecycle states of the abstraction
-_NEW, _QUEUED, _PREFILL, _HANDOFF, _DECODE, _DONE = range(6)
-_STATUS_NAMES = ("new", "queued", "prefill", "handoff", "decode", "done")
+# request lifecycle states of the abstraction.  _MIGRATE and _DECODE_B are
+# fleet-only (ISSUE 18): a migrating session is dual-owned — source pages
+# still held while the destination's reservation exists, exactly like the
+# disaggregated dual-reserve window — and _DECODE_B decodes on the peer.
+_NEW, _QUEUED, _PREFILL, _HANDOFF, _DECODE, _DONE, _MIGRATE, _DECODE_B = range(8)
+_STATUS_NAMES = (
+    "new", "queued", "prefill", "handoff", "decode", "done",
+    "migrate", "decode_b",
+)
+
+# ``draining`` bitfield (plain bool pre-ISSUE-18 traces == bit 0):
+_DRAIN = 1       # full drain: admissions stopped fleet-wide
+_PREEMPT_A = 2   # replica A received its SIGTERM: migrating sessions out
+_DEAD_A = 4      # replica A retired: nothing may touch its pools again
 
 # request tuple layout: (status, own, d_own, sref, reg, cow, emitted, retries)
 # own    -- private pages held on the prefill-side pool (sole pool when shared)
@@ -140,6 +164,10 @@ class ProtoModelConfig:
     allow_timeout: bool = True
     tiering: bool = False      # host-DRAM second tier for evicted prefix pages
     host_budget: int = 1       # host-tier slots (page capacity of the store)
+    # fleet mode (ISSUE 18): replica A is modeled concretely (prefill pool +
+    # index), replica B's pool rides the decode-pool machinery — migration
+    # dual-owns a session across both exactly like dual-reserve does
+    fleet: bool = False
     mutations: FrozenSet[str] = frozenset()
     max_states: int = 200_000
 
@@ -151,6 +179,11 @@ class ProtoModelConfig:
             raise ValueError("tiering requires prefix_cache (demotion source)")
         if self.tiering and self.host_budget < 1:
             raise ValueError("tiering requires host_budget >= 1")
+        if self.fleet and self.disaggregated:
+            raise ValueError(
+                "fleet mode reuses the decode pool as replica B; combine "
+                "with disaggregated later if both are ever needed at once"
+            )
 
     # Pools are sized so admission can transiently block (pool pressure is
     # part of the explored behaviour) but never permanently starve: enough
@@ -168,7 +201,9 @@ class ProtoModelConfig:
 
     @property
     def decode_capacity(self) -> int:
-        return self.requests * self.reserve_pages if self.disaggregated else 0
+        if self.disaggregated or self.fleet:
+            return self.requests * self.reserve_pages
+        return 0
 
 
 @dataclass(frozen=True)
@@ -192,10 +227,11 @@ class ProtoReport:
 
 
 def default_model_configs() -> Dict[str, ProtoModelConfig]:
-    """The two stock configurations the dslint gate / bench explore."""
+    """The stock configurations the dslint gate / bench explore."""
     return {
         "shared": ProtoModelConfig(disaggregated=False),
         "disaggregated": ProtoModelConfig(disaggregated=True),
+        "fleet": ProtoModelConfig(fleet=True),
     }
 
 
@@ -211,7 +247,7 @@ def _initial(cfg: ProtoModelConfig):
         cfg.decode_capacity,
         0,       # index_pages: full pages resident in the prefix chain
         0,       # host_pages: prefix pages demoted to the host-DRAM tier
-        False,   # draining
+        0,       # draining bitfield: _DRAIN | _PREEMPT_A | _DEAD_A
     )
 
 
@@ -223,51 +259,81 @@ def _enabled(cfg: ProtoModelConfig, st) -> List[str]:
     reqs, free_p, free_d, index, host, draining = st
     P, R = cfg.prompt_pages, cfg.reserve_pages
     active = sum(1 for r in reqs if r[0] in (_PREFILL, _HANDOFF, _DECODE))
+    # replica B slot pressure (fleet): a migrating session holds its B
+    # reservation from migrate_begin on, so it occupies a B slot already
+    b_active = sum(1 for r in reqs if r[0] in (_MIGRATE, _DECODE_B))
     out: List[str] = []
     for i, r in enumerate(reqs):
         status = r[0]
-        if status == _NEW and not draining:
+        if status == _NEW and not (draining & _DRAIN):
             out.append(_ev("submit", i))
-        elif status == _QUEUED and not draining and active < cfg.slots:
-            shared = min(index, P - 1) if cfg.prefix_cache else 0
-            cow_hit = cfg.prefix_cache and index >= P
-            skip_cow = cow_hit and "skip-cow-fork" in cfg.mutations
-            if cfg.disaggregated:
-                p_need = P - shared - (1 if skip_cow else 0)
-                if free_p >= p_need and free_d >= R:
-                    out.append(_ev("admit", i))
-            else:
-                need = R - shared - (1 if skip_cow else 0)
-                if free_p >= need:
-                    out.append(_ev("admit", i))
+        elif status == _QUEUED:
+            if draining == 0 and active < cfg.slots:
+                shared = min(index, P - 1) if cfg.prefix_cache else 0
+                cow_hit = cfg.prefix_cache and index >= P
+                skip_cow = cow_hit and "skip-cow-fork" in cfg.mutations
+                if cfg.disaggregated:
+                    p_need = P - shared - (1 if skip_cow else 0)
+                    if free_p >= p_need and free_d >= R:
+                        out.append(_ev("admit", i))
+                else:
+                    need = R - shared - (1 if skip_cow else 0)
+                    if free_p >= need:
+                        out.append(_ev("admit", i))
+            # ISSUE 18: once replica A drains, the router lands new (and
+            # re-queued) work on replica B — its own pool and slots
+            if (cfg.fleet and (draining & _PREEMPT_A)
+                    and not (draining & _DRAIN)
+                    and free_d >= R and b_active < cfg.slots):
+                out.append(_ev("admit_b", i))
         elif status == _PREFILL:
             out.append(_ev("prefill_done", i))
             if cfg.allow_timeout:
                 out.append(_ev("timeout_evict", i))
-            if draining:
+            if draining & _DRAIN:
                 out.append(_ev("preempt", i))
         elif status == _HANDOFF:
             out.append(_ev("handoff", i))
             if cfg.allow_timeout:
                 out.append(_ev("timeout_evict", i))
-            if draining:
+            if draining & _DRAIN:
                 out.append(_ev("preempt", i))
         elif status == _DECODE:
-            out.append(_ev("decode", i))
-            if r[7] < cfg.retry_max and not draining:
-                out.append(_ev("retry", i))
+            if not (cfg.fleet and (draining & _PREEMPT_A)):
+                # a preempted replica A emits NOTHING more: its sessions
+                # migrate or restart — decode here would be dual-emission
+                out.append(_ev("decode", i))
+                if r[7] < cfg.retry_max and draining == 0:
+                    out.append(_ev("retry", i))
+            elif not (draining & _DEAD_A) and free_d >= R and b_active < cfg.slots:
+                out.append(_ev("migrate_begin", i))
             if cfg.allow_timeout:
                 out.append(_ev("timeout_evict", i))
-            if draining:
+            if draining & _DRAIN:
                 out.append(_ev("preempt", i))
-    if not draining:
+        elif status == _MIGRATE:
+            out.append(_ev("migrate_commit", i))
+            out.append(_ev("migrate_abort", i))
+        elif status == _DECODE_B:
+            out.append(_ev("decode_b", i))
+            if cfg.allow_timeout:
+                out.append(_ev("timeout_evict", i))
+    if not (draining & _DRAIN):
         out.append("drain")
-    if index > 0 and all(r[3] == 0 and r[4] == 0 for r in reqs):
+    if cfg.fleet and draining == 0:
+        out.append("replica_preempt")
+    if (cfg.fleet and (draining & _PREEMPT_A) and not (draining & _DEAD_A)
+            and not any(r[0] in (_PREFILL, _HANDOFF, _DECODE, _MIGRATE)
+                        for r in reqs)):
+        # A may retire only once nothing still runs (or is mid-flight) there
+        out.append("replica_die")
+    if (index > 0 and not (draining & _DEAD_A)
+            and all(r[3] == 0 and r[4] == 0 for r in reqs)):
         # With a host tier configured the LRU prefix eviction *demotes* the
         # page to host DRAM instead of dropping it (ISSUE 17); the device
-        # page is freed either way.
+        # page is freed either way.  A dead replica's index is frozen.
         out.append("demote_prefix" if cfg.tiering else "evict_prefix")
-    if cfg.tiering and host > 0 and free_p > 0:
+    if cfg.tiering and host > 0 and free_p > 0 and not (draining & _DEAD_A):
         out.append("restore_prefix")
     return out
 
@@ -291,6 +357,13 @@ def _apply(cfg: ProtoModelConfig, st, ev: str):
             cfg.disaggregated
             and "drop-handoff-free" in cfg.mutations
             and s == _DECODE
+        ) or (
+            # a committed migration that skipped the source-side release left
+            # the A-pool pages behind permanently: B's terminal path only
+            # frees B's reservation
+            cfg.fleet
+            and "drop-migration-free" in cfg.mutations
+            and s == _DECODE_B
         )
         if not skip_free:
             free_d += d_own
@@ -388,7 +461,7 @@ def _apply(cfg: ProtoModelConfig, st, ev: str):
     elif name == "preempt":
         release(idx, skip_free="drop-drain-free" in cfg.mutations)
     elif name == "drain":
-        draining = True
+        draining |= _DRAIN
         for i, r in enumerate(reqs):
             if r[0] in (_NEW, _QUEUED):
                 if "skip-queue-drain" in cfg.mutations and r[0] == _QUEUED:
@@ -413,6 +486,64 @@ def _apply(cfg: ProtoModelConfig, st, ev: str):
         host -= 1
         index += 1
         free_p -= 1
+    elif name == "replica_preempt":
+        # SIGTERM on replica A: the router marks it draining-for-retirement.
+        # New admissions land on replica B; live decodes migrate or restart.
+        draining |= _PREEMPT_A
+    elif name == "replica_die":
+        draining |= _DEAD_A
+    elif name == "admit_b":
+        # router re-lands a queued request on replica B (fresh restart —
+        # prefix reuse on B is out of scope for the abstract model, so B
+        # sessions are modeled decode-pool-only like a disaggregated row)
+        retries = reqs[idx][7]
+        free_d -= R
+        emitted = 1
+        reqs[idx] = (_DECODE_B, 0, R, 0, 0, 0, emitted, retries)
+        if emitted >= cfg.new_tokens:
+            release(idx)
+    elif name == "migrate_begin":
+        # session becomes dual-owned (like dual-reserve during handoff): A
+        # still holds its pages, B's destination reservation is charged now
+        s, own, d_own, sref, reg, cow, emitted, retries = reqs[idx]
+        free_d -= R
+        d_own += R
+        reqs[idx] = (_MIGRATE, own, d_own, sref, reg, cow, emitted, retries)
+    elif name == "migrate_commit":
+        s, own, d_own, sref, reg, cow, emitted, retries = reqs[idx]
+        if s != _MIGRATE:
+            vio = vio or "proto-dual-emit"
+        if "drop-migration-free" in cfg.mutations:
+            # source-side release skipped: A's pages/refs stay charged to the
+            # request but no slot records them — leaked across A's death
+            pass
+        else:
+            free_p += own
+            own = sref = reg = 0
+        cow = 0
+        reqs[idx] = (_DECODE_B, own, d_own, sref, reg, cow, emitted, retries)
+    elif name == "migrate_abort":
+        # crc-failed / no-capacity payload: B's reservation returns, A's
+        # pages are released and the request restarts from the queue — or,
+        # when the fleet already drained, fails terminally (PREEMPTED): the
+        # router never requeues into a drained fleet
+        s, own, d_own, sref, reg, cow, emitted, retries = reqs[idx]
+        free_p += own
+        free_d += d_own
+        if draining & _DRAIN:
+            reqs[idx] = (_DONE, 0, 0, 0, 0, 0, emitted, retries)
+        else:
+            reqs[idx] = (_QUEUED, 0, 0, 0, 0, 0, 0, retries)
+    elif name == "decode_b":
+        s, own, d_own, sref, reg, cow, emitted, retries = reqs[idx]
+        if s != _DECODE_B:
+            vio = vio or "proto-dual-emit"
+        if d_own == 0:
+            vio = vio or "proto-use-after-free"
+        emitted += 1
+        reqs[idx] = (s, own, d_own, sref, reg, cow, emitted, retries)
+        if emitted >= cfg.new_tokens:
+            release(idx)
     else:  # pragma: no cover - defensive
         raise ValueError(f"unknown event {ev!r}")
 
@@ -448,12 +579,23 @@ def _check_state(cfg: ProtoModelConfig, st) -> Optional[Tuple[str, str]]:
             f"prefill pool: free {free_p} + owned {held_p} + index {index} "
             f"!= capacity {cfg.prefill_capacity}",
         )
-    if cfg.disaggregated and free_d + held_d != cfg.decode_capacity:
+    if (cfg.disaggregated or cfg.fleet) and free_d + held_d != cfg.decode_capacity:
         return (
             "proto-refcount-conservation",
             f"decode pool: free {free_d} + owned {held_d} "
             f"!= capacity {cfg.decode_capacity}",
         )
+    if cfg.fleet and (draining & _DEAD_A):
+        # replica_die is gated on no session running (or migrating) on A, so
+        # anything still charged to the A-side pools at death is leaked — a
+        # migration's source-side release was skipped
+        a_leak = sum(r[1] + r[3] + r[4] for r in reqs)
+        if a_leak:
+            return (
+                "proto-replica-page-leak",
+                f"replica A died holding {a_leak} page(s)/ref(s) charged to "
+                f"sessions that no longer run there",
+            )
     if draining and all(r[0] == _DONE for r in reqs):
         p_leak = sum(r[1] + r[3] + r[4] for r in reqs)
         d_leak = held_d
@@ -722,7 +864,11 @@ def apply_engine_mutation(srv, name: str):
       the writable row instead of forking it by recompute;
     * ``drop-host-free`` — prefix demotion copies the page into the host
       tier but skips the device-side free, so the page is owned by neither
-      tier (needs ``serving.tiering`` enabled).
+      tier (needs ``serving.tiering`` enabled);
+    * ``drop-migration-free`` — a migration's source-side release keeps the
+      slot-table bookkeeping but skips the allocator frees, leaking the
+      source replica's pages across its death (``srv`` must be a
+      :class:`~deepspeed_tpu.serving.fleet.FleetRouter`).
     """
     from deepspeed_tpu.serving.request import RequestStatus
 
@@ -813,6 +959,40 @@ def apply_engine_mutation(srv, name: str):
 
         return undo
 
+    if name == "drop-migration-free":
+        reps = getattr(srv, "replicas", None)
+        if reps is None:
+            raise ValueError("drop-migration-free needs a FleetRouter")
+        saved = []
+        for rep in reps:
+            eng = rep.srv
+            orig_release = eng.release_slot
+
+            def release(slot_i, now=None, *, _eng=eng, _orig=orig_release):
+                # the migration path frees the source pages via release_slot
+                # right before the payload leaves; silence both allocators
+                # for its duration so the bookkeeping proceeds pages-in-hand
+                allocs = {id(_eng.allocator): _eng.allocator,
+                          id(_eng.prefill_set.allocator):
+                          _eng.prefill_set.allocator}
+                frees = [(a, a.free) for a in allocs.values()]
+                for a, _ in frees:
+                    a.free = lambda pages: None
+                try:
+                    return _orig(slot_i, now=now)
+                finally:
+                    for a, f in frees:
+                        a.free = f
+
+            eng.release_slot = release
+            saved.append((eng, orig_release))
+
+        def undo():
+            for eng, orig in saved:
+                eng.release_slot = orig
+
+        return undo
+
     raise ValueError(f"unsupported engine mutation: {name!r}")
 
 
@@ -884,6 +1064,102 @@ def replay_trace(
     return {
         "ok": not mon.violations,
         "violations": list(mon.violations),
+        "steps": steps,
+        "handles": handles,
+    }
+
+
+def replay_fleet_trace(
+    fleet,
+    trace,
+    prompts,
+    max_new_tokens: int = 2,
+    clock: Optional[ReplayClock] = None,
+    max_steps: int = 300,
+) -> dict:
+    """Drive a fleet-model counterexample through a real ``FleetRouter``.
+
+    Replica events map onto the router API (``replica_preempt`` triggers
+    :meth:`FleetRouter.preempt` on the first live replica; migration and
+    replica-B events advance the fleet), with one :class:`ProtocolMonitor`
+    per replica.  A leak the retirement path detects (``check_no_leaks``
+    raising inside :meth:`FleetRouter.step`) is recorded as
+    ``proto-replica-page-leak`` rather than propagated, so a mutated fleet
+    replays red instead of crashing the harness.
+    """
+    monitors = {rep.rid: ProtocolMonitor(rep.srv) for rep in fleet.replicas}
+    violations: List[str] = []
+    handles: Dict[int, object] = {}
+    drained = False
+    steps = 0
+
+    def step_all() -> None:
+        nonlocal steps
+        try:
+            fleet.step()
+        except Exception as e:  # retirement leak-check tripping mid-step
+            violations.append(f"proto-replica-page-leak: {e}")
+        steps += 1
+        for rep in fleet.replicas:
+            if rep.alive:
+                monitors[rep.rid].check_step()
+
+    for ev in trace:
+        m = _EV_RE.match(ev)
+        name, idx = m.group(1), (int(m.group(2)) if m.group(2) else None)
+        if name == "submit":
+            handles[idx] = fleet.submit(
+                prompts[idx % len(prompts)],
+                max_new_tokens=max_new_tokens,
+                seed=7 + (idx or 0),
+            )
+        elif name == "replica_preempt":
+            # the abstract model preempts "the" replica running work; pick
+            # the most-loaded live replica so the victim actually holds the
+            # trace's sessions (mirrors the router's default victim policy)
+            alive = fleet.alive()
+            if alive:
+                victim = max(alive, key=fleet._load)
+                fleet.preempt(victim.rid)
+        elif name == "drain":
+            try:
+                fleet.drain(deadline_s=5.0)
+            except Exception as e:
+                violations.append(f"proto-replica-page-leak: {e}")
+            drained = True
+        elif name == "timeout_evict":
+            if clock is not None:
+                clock.advance(1e6)
+            step_all()
+        elif name in ("admit", "prefill_done", "handoff", "decode", "retry",
+                      "preempt", "admit_b", "migrate_begin", "migrate_commit",
+                      "migrate_abort", "decode_b", "replica_die",
+                      "evict_prefix", "demote_prefix", "restore_prefix"):
+            if not drained:
+                step_all()
+    # settle: run the fleet to quiescence, then drain and leak-check every
+    # replica — the dead ones included; a retired replica must hold nothing
+    while (not drained and steps < max_steps
+           and any(rep.srv.queue or any(s.request is not None
+                                        for s in rep.srv.slots)
+                   for rep in fleet.alive())):
+        step_all()
+    if not drained:
+        try:
+            fleet.drain(deadline_s=5.0)
+        except Exception as e:
+            violations.append(f"proto-replica-page-leak: {e}")
+    for rep in fleet.replicas:
+        try:
+            rep.srv.check_no_leaks()
+        except Exception as e:
+            violations.append(f"proto-replica-page-leak: {e}")
+    violations.extend(
+        v for mon in monitors.values() for v in mon.violations
+    )
+    return {
+        "ok": not violations,
+        "violations": violations,
         "steps": steps,
         "handles": handles,
     }

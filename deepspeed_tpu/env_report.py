@@ -507,6 +507,75 @@ def main() -> int:
     except Exception as e:
         print(f"kv tiering .......... {RED_NO} ({type(e).__name__}: {e})")
     print("-" * 60)
+    print("Serving fleet (ISSUE 18):")
+    try:
+        import json
+        import os
+
+        from deepspeed_tpu.runtime.config import FleetConfig
+
+        fcfg = FleetConfig()
+        print(
+            f"fleet router ........ {GREEN_OK} serving.fleet — "
+            f"{'on' if fcfg.enabled else 'off'} by default; policies: "
+            f"affinity, round_robin, least_loaded (default {fcfg.policy})"
+        )
+        print(
+            f"knobs ............... replicas={fcfg.replicas}, "
+            f"migrate_sessions={'on' if fcfg.migrate_sessions else 'off'}, "
+            f"preempt_policy={fcfg.preempt_policy}, "
+            f"admit_attainment_floor={fcfg.admit_attainment_floor}"
+        )
+        # router/migration numbers come from the committed bench artifact —
+        # env_report stays cheap (no fleet replay here)
+        bench_path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "BENCH_pr18.json",
+        )
+        if os.path.exists(bench_path):
+            with open(bench_path, encoding="utf-8") as fh:
+                doc = json.load(fh)
+            fl = doc.get("fleet") or {}
+            sg = doc.get("single") or {}
+            ratio = doc.get("fleet_goodput_over_single")
+            print(
+                f"  goodput ........... {doc.get('replicas')} replicas "
+                f"({doc.get('router_policy')}): "
+                f"{fl.get('goodput_tokens_per_sec')} tok/s vs single "
+                f"{sg.get('goodput_tokens_per_sec')} tok/s (x{ratio}) at "
+                f"{doc.get('offered_load_of_single_capacity')}x single "
+                "capacity"
+            )
+            att = fl.get("slo_attainment")
+            satt = sg.get("slo_attainment")
+            if att is not None and satt is not None:
+                print(
+                    f"  slo attainment .... fleet {100 * att:.1f}% vs "
+                    f"single {100 * satt:.1f}% (one scripted preemption "
+                    f"mid-run; {fl.get('replicas_alive_at_end')} replicas "
+                    "alive at end)"
+                )
+            mig = doc.get("migration") or {}
+            if mig:
+                p99 = mig.get("blackout_p99_s")
+                print(
+                    f"  migration ......... {mig.get('ok')} ok / "
+                    f"{mig.get('crc_failed')} crc-failed / "
+                    f"{mig.get('no_capacity')} no-capacity, "
+                    f"{(mig.get('bytes') or 0) / 1e3:.1f} kB moved, "
+                    f"blackout p99 "
+                    f"{'-' if p99 is None else f'{p99 * 1e3:.0f} ms'}"
+                )
+        else:
+            print("  fleet metrics ..... unmeasured — run bench.py "
+                  "(BENCH_FLEET_ONLY=1)")
+        print(
+            "trace grouping ...... python -m deepspeed_tpu.tools."
+            "request_trace requests.jsonl --by replica"
+        )
+    except Exception as e:
+        print(f"serving fleet ....... {RED_NO} ({type(e).__name__}: {e})")
+    print("-" * 60)
     return 0
 
 

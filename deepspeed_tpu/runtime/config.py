@@ -1012,9 +1012,14 @@ class PlacementConfig(DSConfigModel):
     decode_tp: int = 0       # 0 = tp
     prefill_tp: int = 0      # 0 = tp
     prefill_num_pages: int = 0  # 0 = auto-size from max_slots * prompt pages
+    # first visible device this engine's placements start from (ISSUE 18):
+    # a fleet gives each replica its own core-set by offsetting the base —
+    # replica i serves from devices[base_i : base_i + decode_tp (+prefill_tp)]
+    device_base: int = 0
 
     def __post_init__(self):
-        for key in ("tp", "decode_tp", "prefill_tp", "prefill_num_pages"):
+        for key in ("tp", "decode_tp", "prefill_tp", "prefill_num_pages",
+                    "device_base"):
             if int(getattr(self, key)) < 0:
                 raise DeepSpeedConfigError(
                     f"serving.placement.{key} must be >= 0"
@@ -1072,6 +1077,78 @@ class TieringConfig(DSConfigModel):
             raise DeepSpeedConfigError(
                 "serving.tiering.prefetch_depth must be >= 1, got "
                 f"{self.prefetch_depth}"
+            )
+
+
+@dataclass
+class FleetConfig(DSConfigModel):
+    """serving.fleet section (ISSUE 18): multi-replica router with live
+    session migration — DeepSpeed-Inference's multi-replica serving layer
+    (arXiv 2207.00032) over N :class:`ServingEngine` replicas.
+
+    When enabled, ``serving/fleet.py:FleetRouter`` fronts ``replicas``
+    engines (each its own Placement — ``spread_devices`` offsets every
+    replica's ``placement.device_base`` so replicas own disjoint
+    core-sets), routing sessions by per-tenant SLO-class affinity +
+    prefix-locality (the replica whose PrefixCache / host tier is warm for
+    the prompt's chain) + least-pending-work fairness. Admission
+    backpressure is driven by the PR-11 goodput/attainment signals, not
+    raw queue depth: with ``admit_attainment_floor`` > 0 the router sheds
+    load (REJECTED) only once every replica's measured SLO attainment sits
+    below the floor. On a replica's SIGTERM (PreemptionGuard), live decode
+    sessions migrate to a peer — KV pages ride ``serving_kv_gather`` →
+    host transfer → ``serving_kv_scatter`` wrapped in the PR-7 crc-checked
+    manifest format — so a preemption costs latency, not conversations;
+    a corrupt payload is a counted failure that re-queues the session."""
+
+    enabled: bool = False
+    replicas: int = 2
+    # routing policy: "affinity" (SLO-class affinity -> prefix locality ->
+    # fairness; the default), "round_robin", "least_loaded"
+    policy: str = "affinity"
+    # give each replica its own device base (replica i starts at
+    # i * devices_per_replica); off = all replicas share device 0 (CPU sim)
+    spread_devices: bool = True
+    # migrate live sessions on preemption; off = preempted replicas requeue
+    # their sessions to peers from scratch (regenerate)
+    migrate_sessions: bool = True
+    # where migration manifests land; "" = a per-router temp directory
+    migration_dir: str = ""
+    # goodput-driven admission backpressure: reject new sessions only while
+    # EVERY replica's SLO attainment (over >= min_slo_samples verdicts)
+    # sits below this floor. 0 disables shedding.
+    admit_attainment_floor: float = 0.0
+    min_slo_samples: int = 8
+    # install a real SIGTERM handler at the fleet level (one process hosts
+    # all replicas in the CPU sim): on delivery the router preempts ONE
+    # victim replica per preempt_policy instead of killing the whole fleet
+    install_sigterm: bool = False
+    preempt_policy: str = "most_loaded"   # most_loaded | first
+
+    def __post_init__(self):
+        if int(self.replicas) < 1:
+            raise DeepSpeedConfigError(
+                f"serving.fleet.replicas must be >= 1, got {self.replicas}"
+            )
+        if self.policy not in ("affinity", "round_robin", "least_loaded"):
+            raise DeepSpeedConfigError(
+                "serving.fleet.policy must be one of 'affinity', "
+                f"'round_robin', 'least_loaded'; got {self.policy!r}"
+            )
+        if self.preempt_policy not in ("most_loaded", "first"):
+            raise DeepSpeedConfigError(
+                "serving.fleet.preempt_policy must be 'most_loaded' or "
+                f"'first'; got {self.preempt_policy!r}"
+            )
+        if not 0.0 <= float(self.admit_attainment_floor) <= 1.0:
+            raise DeepSpeedConfigError(
+                "serving.fleet.admit_attainment_floor must be in [0, 1], "
+                f"got {self.admit_attainment_floor}"
+            )
+        if int(self.min_slo_samples) < 1:
+            raise DeepSpeedConfigError(
+                "serving.fleet.min_slo_samples must be >= 1, got "
+                f"{self.min_slo_samples}"
             )
 
 
@@ -1150,6 +1227,8 @@ class ServingConfig(DSConfigModel):
     placement: PlacementConfig = field(default_factory=PlacementConfig)
     # --- ISSUE 17: host-DRAM second tier for cold KV pages -----------------
     tiering: TieringConfig = field(default_factory=TieringConfig)
+    # --- ISSUE 18: multi-replica fleet + live session migration ------------
+    fleet: FleetConfig = field(default_factory=FleetConfig)
 
     def __post_init__(self):
         for key in ("max_slots", "page_size", "num_pages", "max_prompt_len",
@@ -1170,6 +1249,8 @@ class ServingConfig(DSConfigModel):
             self.placement = PlacementConfig.from_dict(self.placement)
         if isinstance(self.tiering, dict):
             self.tiering = TieringConfig.from_dict(self.tiering)
+        if isinstance(self.fleet, dict):
+            self.fleet = FleetConfig.from_dict(self.fleet)
         if self.tiering.enabled and not self.prefix_cache.enabled:
             raise DeepSpeedConfigError(
                 "serving.tiering requires serving.prefix_cache (demotion "

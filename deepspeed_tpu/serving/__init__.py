@@ -17,12 +17,17 @@ The layer above the kernels that wins serving throughput at scale (PAPERS.md
 - :mod:`~deepspeed_tpu.serving.tiering` — the host-DRAM second tier for
   cold KV pages (:class:`HostPageStore` + :class:`KVTieringEngine`;
   ISSUE 17): prefix demotion, async spill, compiled width-1 restore
+- :mod:`~deepspeed_tpu.serving.fleet` — the multi-replica availability
+  layer (:class:`FleetRouter`; ISSUE 18): SLO-affinity + prefix-locality
+  routing, goodput-driven backpressure, live session migration on
+  preemption (crc-checked manifest payloads, bit-identical streams)
 
 Entry point: ``deepspeed_tpu.init_inference(...).serve(serving_config)``, or
 the ``serving`` section of the engine config. See docs/SERVING.md and
 docs/REQUEST_TRACING.md.
 """
 
+from .fleet import FleetError, FleetReplica, FleetRouter, replay_fleet
 from .kv_cache import (
     PageAllocator,
     PageAllocatorError,
@@ -52,6 +57,10 @@ from .tiering import (
 )
 
 __all__ = [
+    "FleetError",
+    "FleetReplica",
+    "FleetRouter",
+    "replay_fleet",
     "HostPageStore",
     "HostTierError",
     "KVTieringEngine",

@@ -450,13 +450,23 @@ class PrefixCache:
         self._children.pop(key, None)
         if parent is not None and parent in self._children:
             self._children[parent] -= 1
+        demoted = False
         if self.demote_sink is not None and self.allocator.refcount(pid) == 1:
             if self.demote_sink.demote_begin(key, pid) is not None:
                 self.demotions += 1
+                demoted = True
         self.allocator.free([pid])
         if self.heat is not None:
             self.heat.evict(pid)
         self.evictions += 1
+        if (not demoted and self.demote_sink is not None
+                and hasattr(self.demote_sink, "drop_orphans")):
+            # ISSUE 18 satellite: the key left the device index WITHOUT
+            # reaching the host tier (shared page, or the sink declined) —
+            # any host-held children just became unreachable; drop them
+            # now (ledger V events) instead of squatting until host-LRU.
+            # Safe after the F/E pair: the pin only fixes D→F→E adjacency.
+            self.demote_sink.drop_orphans()
         return True
 
     def adopt(self, key: Tuple, pid: int) -> None:

@@ -61,6 +61,11 @@ class Request:
     # the scheduler resolves unknown/empty to the configured default
     tenant: str = "default"
     slo_class: str = ""
+    # -- fleet (ISSUE 18) ----------------------------------------------
+    # replica currently serving this request; stamped by the FleetRouter
+    # at routing time and restamped on migration ("" = no fleet in play).
+    # Lands in the terminal trace record so reports can group --by replica.
+    replica: str = ""
 
     # -- prefix cache (ISSUE 10) ---------------------------------------
     # prompt tokens served from shared prefix-index pages at admission

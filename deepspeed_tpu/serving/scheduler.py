@@ -240,13 +240,19 @@ class ServingEngine:
                 "rank-major QKV permute operates on the plain injected tree); "
                 "int8 KV pages (serving.kv_cache_dtype) shard fine"
             )
-        devices = jax.devices()
+        all_devices = jax.devices()
+        # ISSUE 18: a fleet offsets each replica's device window so replicas
+        # own disjoint core-sets — replica i serves from
+        # devices[base : base + decode_tp (+ prefill_tp)]
+        base = int(getattr(plc, "device_base", 0) or 0) if plc is not None else 0
+        devices = all_devices[base:]
         n_dev = decode_tp + (prefill_tp if self.disaggregated else 0)
         if n_dev > len(devices):
             raise ValueError(
                 f"serving.placement needs {n_dev} devices "
                 f"(decode_tp={decode_tp}"
                 + (f" + prefill_tp={prefill_tp}" if self.disaggregated else "")
+                + (f" from device_base={base}" if base else "")
                 + f"), only {len(devices)} visible"
             )
         self.decode_placement = Placement(
@@ -368,6 +374,12 @@ class ServingEngine:
             )
             self.prefix_cache.demote_sink = self.tiering
             self.prefix_cache.victim_order = self.tiering.select_leaf
+            # ISSUE 18 satellite: the tier needs device-index residency to
+            # eagerly drop host entries whose parent chain link left BOTH
+            # tiers (otherwise unreachable until host-LRU ages them out)
+            self.tiering.device_resident = (
+                self.prefix_cache._entries.__contains__
+            )
         cw = int(getattr(config, "prefill_chunk_tokens", 0) or 0)
         self._chunk_cold = cw > 0  # chunk long COLD prompts too
         if cw > 0:
@@ -553,6 +565,10 @@ class ServingEngine:
         self._gather_exec = None
         self._scatter_exec = None
         self._restore_exec = None
+        # ISSUE 18: full-row migration transport (compiled on first use —
+        # only fleets ever migrate, so solo engines never pay the compile)
+        self._migrate_gather_exec = None
+        self._migrate_scatter_exec = None
         self.executables: List[Any] = []
         # program name -> {"exe", "pset", "kind"} (built by _ensure_compiled;
         # verify() derives per-program local shapes and aliasing from it)
@@ -2119,6 +2135,287 @@ class ServingEngine:
                 f"active={sum(1 for s in self.slots if s.request)})"
             )
         return self.completed[start:]
+
+    # ------------------------------------------------------------------
+    # ISSUE 18: live session migration (fleet replica -> peer replica)
+    # ------------------------------------------------------------------
+    def _ensure_migration_programs(self) -> None:
+        """Compile the full-row migration transport pair on first use:
+        ``serving_kv_gather`` packs a slot's whole page row out of the
+        decode pool ([L, pages_per_slot, KV, page, D] per pool, int8
+        scales ride along); ``serving_kv_scatter`` writes a packed row
+        into the DESTINATION engine's decode pool (pools donated). Page-id
+        lists are scratch-padded to the static ``pages_per_slot`` width —
+        pad entries all target scratch page 0, which no live slot reads —
+        so each side compiles exactly once per engine."""
+        if self._migrate_gather_exec is not None:
+            return
+        self._ensure_compiled()
+        S = jax.ShapeDtypeStruct
+        i32 = jnp.int32
+        quant = self.quantized
+        W = self.pages_per_slot
+
+        def gather_fn(k_pool, v_pool, *rest):
+            scales, (src,) = _split_scales(rest, quant)
+            out = (k_pool[:, src], v_pool[:, src])
+            if scales is not None:
+                out = out + (scales[:, src],)
+            return out
+
+        def scatter_fn(k_pool, v_pool, *rest):
+            scales, packed = _split_scales(rest, quant)
+            if quant:
+                pk, pv, ps, dst = packed
+            else:
+                pk, pv, dst = packed
+            k_pool = k_pool.at[:, dst].set(pk)
+            v_pool = v_pool.at[:, dst].set(pv)
+            if quant:
+                return k_pool, v_pool, scales.at[:, dst].set(ps)
+            return k_pool, v_pool
+
+        dp, dset = self.decode_placement, self.decode_set
+        pools = dset.pool_args()
+        ids_sds = S((W,), i32)
+        # gather: decode pools READ, not donated — the source row stays
+        # live until the peer's adoption is validated (crc), so a corrupt
+        # payload never costs the conversation more than a requeue
+        g_args = pools + (ids_sds,)
+        if dp.mesh is None:
+            self._migrate_gather_exec = dp.aot(gather_fn, g_args, (), (), ())
+        else:
+            self._migrate_gather_exec = dp.aot(
+                gather_fn, g_args,
+                tuple(dp.pool_spec(p.ndim) for p in pools) + (dp.rep_spec(),),
+                tuple(dp.pool_spec(p.ndim) for p in pools), (),
+            )
+        packed_sds = tuple(
+            S((p.shape[0], W) + tuple(p.shape[2:]), p.dtype) for p in pools
+        )
+        s_args = pools + packed_sds + (ids_sds,)
+        dn = tuple(range(len(pools)))
+        if dp.mesh is None:
+            self._migrate_scatter_exec = dp.aot(scatter_fn, s_args, (), (), dn)
+        else:
+            pool_specs = tuple(dp.pool_spec(p.ndim) for p in pools)
+            self._migrate_scatter_exec = dp.aot(
+                scatter_fn, s_args,
+                pool_specs + pool_specs + (dp.rep_spec(),),
+                pool_specs, dn,
+            )
+
+    def export_session(self, slot_i: int):
+        """Serialize slot ``slot_i``'s live decode session for migration
+        (ISSUE 18): ``(client_state, arrays)`` — the JSON-able request +
+        slot state, and the KV page row (+ sampling keys) as host numpy,
+        gathered through ``serving_kv_gather``. The caller wraps both in
+        the PR-7 crc-checked manifest, transfers, and the peer rebuilds the
+        slot with :meth:`adopt_session`. The slot itself is untouched —
+        pair with :meth:`release_slot` once the payload is written."""
+        slot = self.slots[slot_i]
+        req = slot.request
+        if req is None:
+            raise ValueError(f"slot {slot_i} is empty")
+        if slot.prefilling or slot.pending_tok is not None:
+            raise ValueError(
+                f"slot {slot_i} is still prefilling — nothing emitted yet; "
+                "requeue it instead of migrating"
+            )
+        self._ensure_migration_programs()
+        n = len(slot.pages)
+        ids = np.zeros((self.pages_per_slot,), np.int32)
+        ids[:n] = np.asarray(slot.pages, np.int32)
+        dset = self.decode_set
+        packed = self._migrate_gather_exec(*dset.pool_args(), ids)
+        packed_np = [np.asarray(x) for x in jax.device_get(packed)]  # dslint: disable=host-sync-in-step
+        arrays = {"k_pages": packed_np[0], "v_pages": packed_np[1]}
+        if self.quantized:
+            arrays["kv_scales"] = packed_np[2]
+        if slot.keys is not None:
+            arrays["keys"] = np.asarray(slot.keys)
+        state = {
+            "kind": "migration",
+            "id": int(req.id),
+            "prompt": [int(t) for t in req.prompt_list],
+            "tokens": [int(t) for t in req.tokens],
+            "seed": int(req.seed),
+            "max_new_tokens": int(req.max_new_tokens),
+            "requested_new_tokens": req.requested_new_tokens,
+            "eos_token_id": req.eos_token_id,
+            "deadline_s": req.deadline_s,
+            "retries": int(req.retries),
+            "tenant": req.tenant,
+            "slo_class": req.slo_class,
+            "prefix_shared_tokens": int(req.prefix_shared_tokens),
+            "cow_forked": bool(req.cow_forked),
+            "t_submit": req.t_submit,
+            "t_admit": req.t_admit,
+            "t_requeue": req.t_requeue,
+            "t_first_token": req.t_first_token,
+            "t_emissions": [float(t) for t in req.t_emissions],
+            "pos": int(slot.pos),
+            "step": int(slot.step),
+            "n_pages": n,
+            "last_token": int(self.table.tokens[slot_i]),
+        }
+        return state, arrays
+
+    def release_slot(self, slot_i: int, now: Optional[float] = None):
+        """Free a migrated-out session's slot WITHOUT terminal accounting
+        (ISSUE 18): pages back to the allocator(s), table row cleared, the
+        request handed back to the caller still RUNNING — it finishes on
+        the peer replica. The source can never emit for this session again
+        (its slot is gone), which is the concrete form of the model's
+        no-dual-emission invariant."""
+        slot = self.slots[slot_i]
+        req = slot.request
+        if req is None:
+            raise ValueError(f"slot {slot_i} is empty")
+        if now is None:
+            now = self.clock()
+        if self._heat_decode is not None:
+            self._heat_decode.session_end(now, slot_i)
+        self.allocator.free(slot.pages)
+        if slot.prefill_pages:
+            self.prefill_set.allocator.free(slot.prefill_pages)
+        self.table.clear(slot_i)
+        self.slots[slot_i] = _Slot()
+        return req
+
+    def adopt_session(self, state: dict, arrays: dict, request=None):
+        """Rebuild a migrated decode session from a validated payload
+        (ISSUE 18): allocate a private page row, scatter the KV through
+        ``serving_kv_scatter``, and resume decoding exactly where the
+        source stopped — greedy/speculative streams continue BIT-identical
+        (the drafter index rebuilds deterministically from prompt+tokens;
+        sampling keys ride the payload). Returns the live request, or
+        ``None`` when this engine cannot host it (no free slot / pages) —
+        the router requeues elsewhere. ``request`` re-binds the original
+        in-process handle; omitted, the request is rebuilt from
+        ``client_state`` (the cross-process path)."""
+        if self._draining:
+            return None
+        slot_i = next(
+            (i for i, s in enumerate(self.slots) if s.request is None), None
+        )
+        if slot_i is None:
+            return None
+        n = int(state["n_pages"])
+        if n > self.pages_per_slot:
+            raise ValueError(
+                f"migration payload needs {n} pages/slot, this engine "
+                f"holds {self.pages_per_slot}"
+            )
+        self._ensure_migration_programs()
+        if n > self.allocator.free_pages and self.prefix_cache is not None \
+                and not self.disaggregated:
+            self.prefix_cache.evict(need_free=n)
+            self._g_index_pages.set(len(self.prefix_cache))
+        try:
+            pages = self.allocator.alloc(n)
+        except PageAllocatorError:
+            return None
+        if request is not None:
+            req = request
+            if int(req.id) != int(state["id"]):
+                self.allocator.free(pages)
+                raise ValueError(
+                    f"migration payload id {state['id']} does not match "
+                    f"request {req.id}"
+                )
+        else:
+            req = Request(
+                prompt=np.asarray(state["prompt"], np.int32),
+                max_new_tokens=int(state["max_new_tokens"]),
+                seed=int(state["seed"]),
+                eos_token_id=state["eos_token_id"],
+                deadline_s=state["deadline_s"],
+                tenant=state["tenant"],
+                slo_class=state["slo_class"],
+            )
+            req.id = int(state["id"])
+            req.requested_new_tokens = state["requested_new_tokens"]
+            req.retries = int(state["retries"])
+            req.prefix_shared_tokens = int(state["prefix_shared_tokens"])
+            req.cow_forked = bool(state["cow_forked"])
+            req.t_submit = state["t_submit"]
+            req.t_admit = state["t_admit"]
+            req.t_requeue = state["t_requeue"]
+            req.t_first_token = state["t_first_token"]
+        req.tokens = [int(t) for t in state["tokens"]]
+        req.t_emissions = [float(t) for t in state["t_emissions"]]
+        req.status = RequestStatus.RUNNING
+        # the incremental n-gram drafter index rebuilds deterministically
+        # from prompt + tokens on the first _draft() here
+        object.__setattr__(req, "_draft_state", None)
+        dst = np.zeros((self.pages_per_slot,), np.int32)
+        dst[:n] = np.asarray(pages, np.int32)
+        dset = self.decode_set
+        args = [arrays["k_pages"], arrays["v_pages"]]
+        if self.quantized:
+            args.append(arrays["kv_scales"])
+        out = self._migrate_scatter_exec(*dset.pool_args(), *args, dst)
+        dset.set_pools(out)
+        slot = self.slots[slot_i]
+        slot.request = req
+        slot.pages = list(pages)
+        slot.pos = int(state["pos"])
+        slot.step = int(state["step"])
+        slot.prefilling = False
+        keys = arrays.get("keys")
+        if keys is not None:
+            slot.keys = np.asarray(keys)
+        self.table.assign(slot_i, slot.pages)
+        self.table.seq_lens[slot_i] = slot.pos
+        self.table.tokens[slot_i] = int(state["last_token"])
+        if slot.keys is not None and slot.step < len(slot.keys):
+            self.table.keys[slot_i] = slot.keys[slot.step]
+        return req
+
+    def takeover_queue(self) -> List[Request]:
+        """Hand the whole waiting queue to the caller (ISSUE 18): the
+        router reroutes a draining replica's backlog to peers instead of
+        preempting it. The requests stay QUEUED; this engine forgets them."""
+        out = list(self.queue)
+        self.queue.clear()
+        self._g_queue.set(0)
+        return out
+
+    def adopt_request(self, req: Request) -> bool:
+        """Enqueue a request rerouted from a peer replica (ISSUE 18).
+        Validation already ran at the original submit (identical configs
+        across a fleet); only the live gates apply here. False = this
+        engine cannot take it (draining / queue full)."""
+        if self._draining:
+            return False
+        if len(self.queue) >= int(self.config.max_queue_depth):
+            return False
+        self.queue.append(req)
+        self._g_queue.set(len(self.queue))
+        return True
+
+    def slo_snapshot(self) -> dict:
+        """Cheap PR-11 goodput/attainment snapshot for fleet routing and
+        backpressure (ISSUE 18) — the gauges' source numbers without the
+        full ``stats()`` quantile sweep."""
+        met = sum(c[0] for c in self._slo_counts.values())
+        evaluated = sum(c[1] for c in self._slo_counts.values())
+        now = self.clock()
+        span = (
+            now - self._t_first_submit
+            if self._t_first_submit is not None else 0.0
+        )
+        return {
+            "good_tokens": int(self._slo_good_tokens),
+            "met": int(met),
+            "evaluated": int(evaluated),
+            "attainment": (met / evaluated) if evaluated else None,
+            "goodput_tokens_per_sec": (
+                self._slo_good_tokens / span if span > 0 else 0.0
+            ),
+            "span_s": span,
+        }
 
     # ------------------------------------------------------------------
     def executable_names(self) -> List[tuple]:

@@ -306,11 +306,18 @@ class KVTieringEngine:
         # wired by ServingEngine.attach_heat / _ensure_compiled
         self.ledger: Optional[Any] = None
         self._restore_exec = None
+        # ISSUE 18 satellite: device-index residency predicate (the
+        # scheduler wires ``prefix_cache._entries.__contains__``) — lets
+        # the tier drop host entries whose parent chain link left BOTH
+        # tiers instead of waiting for host-LRU to age them out. None
+        # (standalone/fuzz construction) disables the eager sweep.
+        self.device_resident = None
         # counters (stats()["kv_tiering"])
         self.spills = 0
         self.restores = 0
         self.restore_misses = 0
         self.host_evictions = 0
+        self.orphan_drops = 0
         self.spilled_bytes = 0
         self.restored_bytes = 0
         # async spill worker: scheduler enqueues (hid, device arrays);
@@ -395,6 +402,14 @@ class KVTieringEngine:
             self.host_evictions += 1
             if self.ledger is not None:
                 self.ledger.host_drop(dropped[1])
+        # eager orphan sweep BEFORE the D event lands: host-LRU above (or
+        # an earlier non-demoting device eviction) may have severed a
+        # chain link, and the lockstep trace pin requires any resulting V
+        # events to precede D, never split a D→F→E triple. ``key`` itself
+        # is mid-demotion (already popped from the device index, not yet
+        # reserved here) — treat it as resident so its own host children
+        # survive the sweep.
+        self.drop_orphans(keep=key)
         # async read of the page column; device_get happens on the worker
         k_dev = self.pset.k_pool[:, pid]
         v_dev = self.pset.v_pool[:, pid]
@@ -411,6 +426,43 @@ class KVTieringEngine:
             self.ledger.demote(pid, hid)
         return hid
 
+    def drop_orphans(self, keep: Any = None) -> int:
+        """Eagerly drop host entries whose parent chain link left BOTH
+        tiers (ISSUE 18 satellite, closing the PR-17 documented edge): a
+        chained-hash key is only reachable through its parent, so once the
+        parent is neither device-resident nor host-held the entry can
+        never be restored — before this sweep it squatted in the host
+        budget until LRU aged it out. Each drop emits a ledger ``V`` event
+        exactly like a host-LRU eviction. Runs to a fixpoint (dropping an
+        orphan may orphan its own host-held children). ``keep`` names a
+        key that is mid-transition (being reserved right now) and counts
+        as resident. Returns the number of entries dropped; no-ops when no
+        ``device_resident`` predicate is wired (standalone fuzz rigs) —
+        reachability is unknowable without the device index."""
+        if self.device_resident is None:
+            return 0
+        dropped_n = 0
+        changed = True
+        while changed:
+            changed = False
+            for key in list(self.store._entries):
+                parent = key[0] if isinstance(key, tuple) and key else None
+                # only proper chain parents are links: tuples. Roots
+                # (parent None) and foreign key shapes (replay_live_tier
+                # uses ("page", p) ids) have nothing to sever.
+                if not isinstance(parent, tuple) or parent == keep:
+                    continue
+                if parent in self.store or self.device_resident(parent):
+                    continue
+                hid = self.store.drop(key)
+                if hid is not None:
+                    self.orphan_drops += 1
+                    dropped_n += 1
+                    changed = True
+                    if self.ledger is not None:
+                        self.ledger.host_drop(hid)
+        return dropped_n
+
     # -- restore side --------------------------------------------------
 
     def bind_restore_exec(self, fn) -> None:
@@ -425,6 +477,9 @@ class KVTieringEngine:
         payload = self.store.get(key)  # waits out an in-flight spill
         if payload is None:
             self.restore_misses += 1
+            # a CRC-mismatch drop inside get() severs the chain below
+            # ``key`` — sweep its now-unreachable host descendants
+            self.drop_orphans()
             return False
         if self._restore_exec is None:
             raise HostTierError("restore program not bound (call verify path "
@@ -479,6 +534,7 @@ class KVTieringEngine:
             "restores": self.restores,
             "restore_misses": self.restore_misses,
             "host_evictions": self.host_evictions,
+            "orphan_drops": self.orphan_drops,
             "crc_failures": self.store.crc_failures,
             "spilled_bytes": self.spilled_bytes,
             "restored_bytes": self.restored_bytes,

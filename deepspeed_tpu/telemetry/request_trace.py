@@ -245,6 +245,10 @@ class RequestTracer:
             "id": req.id,
             "tenant": req.tenant,
             "slo_class": req.slo_class,
+            # fleet replica that finished the request (ISSUE 18; "" = no
+            # fleet) — the router stamps it at routing time and restamps
+            # on migration, so --by replica aggregates post-migration
+            "replica": getattr(req, "replica", ""),
             "status": req.status,
             "detail": req.detail,
             "prompt_len": req.prompt_len,

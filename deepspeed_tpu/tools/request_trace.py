@@ -82,11 +82,21 @@ def _overall_metrics(
     }
 
 
+def _group_key(by: str):
+    """Record → group-name accessor for ``--by``. ``replica`` groups by the
+    fleet replica that FINISHED the request (ISSUE 18; the router restamps
+    on migration) — records from pre-fleet traces land in ``(none)``."""
+    if by == "tenant":
+        return lambda r: r.get("tenant") or ""
+    if by == "replica":
+        return lambda r: r.get("replica") or "(none)"
+    return lambda r: r.get("slo_class") or ""
+
+
 def build_report(
     records: List[Dict[str, Any]], by: str = "slo_class", bins: int = 0
 ) -> Dict[str, Any]:
-    key = (lambda r: r.get("tenant") or "") if by == "tenant" \
-        else (lambda r: r.get("slo_class") or "")
+    key = _group_key(by)
     score = score_requests(records, key=key)
     report = {
         "records": len(records),
@@ -266,8 +276,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="render one request's timeline by id")
     p.add_argument("--bins", type=int, default=0,
                    help="time-binned queue/prefill/decode breakdown")
-    p.add_argument("--by", choices=("slo_class", "tenant"), default="slo_class",
-                   help="grouping dimension of the aggregate report")
+    p.add_argument("--by", choices=("slo_class", "tenant", "replica"),
+                   default="slo_class",
+                   help="grouping dimension of the aggregate report "
+                        "(replica: the fleet replica that finished each "
+                        "request, ISSUE 18)")
     p.add_argument("--min-attainment", type=float, default=None, metavar="PCT",
                    help="gate: exit 1 if any SLO class attains below PCT%%")
     p.add_argument("--diff", default=None, metavar="B_JSONL",
@@ -285,8 +298,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"request_trace: {args.trace}: no request records", file=sys.stderr)
         return 2
 
-    key = (lambda r: r.get("tenant") or "") if args.by == "tenant" \
-        else (lambda r: r.get("slo_class") or "")
+    key = _group_key(args.by)
 
     def gate_early() -> int:
         """--min-attainment for the side modes (--request / --diff), which
