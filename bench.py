@@ -1726,6 +1726,246 @@ def run_fleet_bench():
     return pr18
 
 
+def run_tsdb_bench():
+    """BENCH_pr20.json (ISSUE 20): the metrics time-series plane.
+
+    1. **Snapshot-hook overhead** — the same seeded mixed replay (virtual
+       clock, PR-11 harness) run journal-off and journal-on, two rounds
+       each, min wall times compared at a compressed snapshot cadence
+       (~every 2nd step). The pinned number is the production one:
+       measured per-snapshot cost amortized at the default 1 Hz journal
+       cadence (one snapshot per second of serving). Acceptance: <= 2%.
+    2. **Journal bytes/hour** — measured bytes per emitted snapshot,
+       extrapolated to the default 1 Hz cadence (the replay's virtual span
+       is sub-second, so the run uses a compressed virtual interval and
+       normalizes per record).
+    3. **Injected sustained-SLO-violation replay** — a deterministic
+       healthy → degraded → recovered completion stream driven through the
+       real journal + SLOBudgetEngine under a virtual clock (compressed
+       windows, PR-16 style): the burn-rate alert must fire during the
+       violation (timestamp recorded) and resolve after recovery.
+    4. **fleet_dash self-check** — the alert journal diffed against itself
+       must exit 0.
+
+    BENCH_TSDB_ONLY=1 standalone."""
+    import contextlib
+    import io
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.models import gpt2
+    from deepspeed_tpu.runtime.config import SLOAlertsConfig
+    from deepspeed_tpu.serving import WorkloadSpec, generate_workload, replay
+    from deepspeed_tpu.serving.replay import ReplayClock
+    from deepspeed_tpu.telemetry.registry import MetricsRegistry
+    from deepspeed_tpu.telemetry.slo_budget import SLOBudgetEngine
+    from deepspeed_tpu.telemetry.timeseries import MetricsJournal
+    from deepspeed_tpu.tools.fleet_dash import main as fleet_dash_main
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    model_name = os.environ.get(
+        "BENCH_SERVING_MODEL", "gpt2" if on_tpu else "gpt2-tiny"
+    )
+    cfg = gpt2.get_config(model_name)
+    params = jax.jit(lambda r: gpt2.init_params(cfg, r))(jax.random.PRNGKey(0))
+    eng = InferenceEngine(
+        gpt2.make_module(cfg), params=params,
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+    )
+    n_new = 16
+    scfg = {
+        "max_slots": 4,
+        "page_size": 16 if on_tpu else 4,
+        "num_pages": 2048 if on_tpu else 128,
+        "max_prompt_len": 128 if on_tpu else 12,
+        "max_new_tokens": n_new,
+        "max_queue_depth": 256,
+        "prefix_cache": {"enabled": True},
+    }
+    n_req = int(os.environ.get("BENCH_TSDB_REQUESTS", "36"))
+
+    # saturated per-step latency (PR-11 argument), then the virtual clock
+    # advances exactly one step per round in both measured variants
+    srv0 = eng.serve(scfg)
+    rs = np.random.RandomState(0)
+    warm = rs.randint(0, cfg.vocab_size, (scfg["max_prompt_len"],)).astype(np.int32)
+    srv0.submit(warm, max_new_tokens=n_new)
+    srv0.run()
+    for _ in range(2 * scfg["max_slots"]):
+        srv0.submit(warm, max_new_tokens=n_new)
+    t0 = _time.monotonic()
+    nsteps = 0
+    while srv0.queue or any(s.request is not None for s in srv0.slots):
+        srv0.step()
+        nsteps += 1
+    step_s = max((_time.monotonic() - t0) / max(nsteps, 1), 1e-5)
+    cap_rps = scfg["max_slots"] / (n_new * step_s)
+    slo = {
+        "classes": {
+            "interactive": {
+                "ttft_target_s": 50 * step_s, "tpot_target_s": 5 * step_s,
+            },
+            "batch": {"ttft_target_s": 400 * step_s},
+        },
+        "default_class": "batch",
+    }
+    items = generate_workload(WorkloadSpec(
+        n_requests=n_req, seed=2008, vocab_size=cfg.vocab_size,
+        max_prompt_len=scfg["max_prompt_len"], max_new_tokens=n_new,
+        base_interarrival_s=1.0 / (cap_rps * 1.2),
+        prompt_len_median=scfg["max_prompt_len"] / 3, prompt_len_sigma=0.6,
+        n_tenants=4, prefix_fraction=0.5,
+        slo_classes=["interactive", "batch"],
+    ))
+    span_v = max(it.t_arrival for it in items)
+
+    trace_dir = os.path.join(_BENCH_DIR, ".bench_tsdb")
+    shutil.rmtree(trace_dir, ignore_errors=True)
+    os.makedirs(trace_dir, exist_ok=True)
+
+    # -- 1+2: hook overhead + bytes per snapshot -----------------------
+    # virtual interval = span/64: dozens of snapshots inside the
+    # sub-second virtual span, so the hook actually runs in-loop
+    interval_v = max(span_v / 64.0, 1e-6)
+    times = {"off": [], "on": []}
+    journal_bytes = journal_records = journal_snapshots = 0
+    for _round in range(2):
+        for variant in ("off", "on"):
+            j = None
+            if variant == "on":
+                jpath = os.path.join(trace_dir, f"replay_{_round}.jsonl")
+                j = MetricsJournal(jpath, interval_s=interval_v)
+            srv = eng.serve(dict(scfg, slo=slo), clock=ReplayClock(),
+                            journal=j)
+            srv.submit(warm, max_new_tokens=n_new, tenant="warmup")
+            srv.run()                  # compile outside the measured window
+            srv._t_first_submit = None
+            t0 = _time.perf_counter()
+            replay(srv, items, step_dt=step_s)
+            times[variant].append(_time.perf_counter() - t0)
+            srv.drain()
+            srv.release_prefix_cache()
+            srv.check_no_leaks()
+            if j is not None:
+                j.flush()
+                journal_bytes = os.path.getsize(j.file_path)
+                journal_records = j.records_emitted
+                journal_snapshots = j.snapshots
+                j.close()
+    t_off, t_on = min(times["off"]), min(times["on"])
+    # the compressed cadence snapshots every ~2 steps to exercise the
+    # path; the PIN is the production number: per-snapshot hook cost
+    # amortized at the default 1 Hz journal cadence (one snapshot per
+    # second of serving, whatever the step time)
+    compressed_pct = max(0.0, (t_on - t_off) / t_off * 100.0)
+    hook_cost_s = max(0.0, t_on - t_off) / max(journal_snapshots, 1)
+    overhead_pct = 100.0 * hook_cost_s * 1.0  # 1 snapshot/s vs 1 s served
+    bytes_per_record = (
+        journal_bytes / journal_records if journal_records else 0.0
+    )
+    # at the default 1 Hz cadence every interval emits at most one record
+    bytes_per_hour_1hz = bytes_per_record * 3600.0
+
+    # -- 3: injected sustained-violation replay ------------------------
+    class _VClock:
+        t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    vc = _VClock()
+    reg = MetricsRegistry()
+    c_ev = reg.counter(
+        "serving_slo_evaluated_total", "bench", labelnames=("slo_class",)
+    )
+    c_met = reg.counter(
+        "serving_slo_met_total", "bench", labelnames=("slo_class",)
+    )
+    alert_path = os.path.join(trace_dir, "alert.jsonl")
+    aj = MetricsJournal(alert_path, registry=reg, clock=vc, interval_s=1.0)
+    acfg = SLOAlertsConfig(
+        enabled=True, objective=0.99,
+        fast_short_s=5.0, fast_long_s=30.0, fast_burn_threshold=10.0,
+        slow_short_s=30.0, slow_long_s=120.0, slow_burn_threshold=1.0,
+        for_s=2.0,
+    )
+    budget = SLOBudgetEngine(aj, acfg, registry=reg, clock=vc)
+    t_degrade, t_recover, t_end = 60, 120, 300
+    transitions = []
+    for sec in range(t_end):
+        vc.t = float(sec)
+        for i in range(10):            # 10 completions per virtual second
+            c_ev.inc(slo_class="interactive")
+            degraded = t_degrade <= sec < t_recover
+            if not degraded or i % 2 == 0:   # degraded phase misses half
+                c_met.inc(slo_class="interactive")
+        aj.maybe_snapshot(vc.t)
+        transitions.extend(budget.maybe_evaluate())
+    aj.flush()
+    aj.close()
+    fired = [tr for tr in transitions if tr["state"] == "firing"]
+    resolved = [tr for tr in transitions if tr["state"] == "resolved"]
+    t_fired = min(tr["t"] for tr in fired) if fired else None
+    t_resolved = (
+        min(tr["t"] for tr in resolved if t_fired is None or tr["t"] > t_fired)
+        if resolved else None
+    )
+
+    # -- 4: fleet_dash --diff self-check -------------------------------
+    with contextlib.redirect_stdout(io.StringIO()):
+        dash_rc = fleet_dash_main([alert_path, "--diff", alert_path])
+
+    pr20 = {
+        "schema": "bench_pr20_tsdb_v1",
+        "model": model_name,
+        "backend": jax.default_backend(),
+        "serving_config": scfg,
+        "requests": n_req,
+        "replay_wall_s_journal_off": round(t_off, 4),
+        "replay_wall_s_journal_on": round(t_on, 4),
+        "replay_overhead_pct_compressed_cadence": round(compressed_pct, 3),
+        "snapshot_cost_ms": round(hook_cost_s * 1e3, 4),
+        "snapshot_hook_overhead_pct": round(overhead_pct, 3),
+        "snapshot_hook_overhead_pct_pin": 2.0,
+        "journal": {
+            "snapshots": journal_snapshots,
+            "records": journal_records,
+            "bytes": journal_bytes,
+            "bytes_per_record": round(bytes_per_record, 1),
+            "bytes_per_hour_at_1hz": round(bytes_per_hour_1hz, 1),
+        },
+        "alert_replay": {
+            "objective": acfg.objective,
+            "windows_s": [acfg.fast_short_s, acfg.fast_long_s,
+                          acfg.slow_short_s, acfg.slow_long_s],
+            "for_s": acfg.for_s,
+            "t_degrade_s": t_degrade,
+            "t_recover_s": t_recover,
+            "t_fired_s": t_fired,
+            "t_resolved_s": t_resolved,
+            "detection_delay_s": (
+                round(t_fired - t_degrade, 3) if t_fired is not None else None
+            ),
+            "fired": len(fired),
+            "resolved": len(resolved),
+        },
+        "fleet_dash_diff_exit": dash_rc,
+        "ok": (
+            overhead_pct <= 2.0
+            and t_fired is not None and t_degrade <= t_fired < t_recover
+            and t_resolved is not None and t_resolved >= t_recover
+            and dash_rc == 0
+        ),
+    }
+    with open(os.path.join(_BENCH_DIR, "BENCH_pr20.json"), "w") as fh:
+        json.dump(pr20, fh, indent=1)
+    shutil.rmtree(trace_dir, ignore_errors=True)
+    return pr20
+
+
 def run_kv_quant_bench():
     """BENCH_pr12.json (ISSUE 12): quantized KV pages + quantized remaining
     wire. Four measurements:
@@ -3201,6 +3441,12 @@ if __name__ == "__main__":
     elif os.environ.get("BENCH_FLEET_ONLY", "0") == "1":
         # ISSUE 18: just the multi-replica fleet bench (BENCH_pr18.json)
         print(json.dumps(run_fleet_bench()))
+    elif os.environ.get("BENCH_TSDB_ONLY", "0") == "1":
+        # ISSUE 20: just the time-series / SLO-budget plane (BENCH_pr20.json)
+        # — the exit code mirrors the overhead + alert pins so CI gates on it
+        _pr20 = run_tsdb_bench()
+        print(json.dumps(_pr20))
+        raise SystemExit(0 if _pr20["ok"] else 1)
     elif os.environ.get("BENCH_KVQUANT_ONLY", "0") == "1":
         # ISSUE 12: just the KV-quantization + compressed-wire bench
         # (BENCH_pr12.json) — pins 8 host devices so the collective paths

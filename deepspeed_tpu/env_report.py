@@ -576,6 +576,70 @@ def main() -> int:
     except Exception as e:
         print(f"serving fleet ....... {RED_NO} ({type(e).__name__}: {e})")
     print("-" * 60)
+    print("Time series / SLO budget (ISSUE 20):")
+    try:
+        import json
+        import os
+
+        from deepspeed_tpu.runtime.config import (
+            SLOAlertsConfig,
+            TimeseriesConfig,
+        )
+
+        tcfg = TimeseriesConfig()
+        acfg = SLOAlertsConfig()
+        print(
+            f"metrics journal ..... {GREEN_OK} telemetry.timeseries — "
+            f"{'on' if tcfg.enabled else 'off'} by default; "
+            f"interval={tcfg.interval_s}s, max_mb={tcfg.max_mb}, "
+            f"retention={tcfg.retention_s or 3600.0}s"
+        )
+        print(
+            f"burn-rate alerts .... serving.fleet.slo_alerts — "
+            f"{'on' if acfg.enabled else 'off'} by default; objective="
+            f"{acfg.objective}, fast {acfg.fast_short_s:.0f}s/"
+            f"{acfg.fast_long_s:.0f}s@{acfg.fast_burn_threshold}x, slow "
+            f"{acfg.slow_short_s:.0f}s/{acfg.slow_long_s:.0f}s@"
+            f"{acfg.slow_burn_threshold}x, backpressure="
+            f"{'on' if acfg.backpressure else 'off'}"
+        )
+        bench_path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "BENCH_pr20.json",
+        )
+        if os.path.exists(bench_path):
+            with open(bench_path, encoding="utf-8") as fh:
+                doc = json.load(fh)
+            jd = doc.get("journal") or {}
+            ar = doc.get("alert_replay") or {}
+            print(
+                f"  snapshot hook ..... "
+                f"{doc.get('snapshot_hook_overhead_pct')}% step overhead "
+                f"(pin <= {doc.get('snapshot_hook_overhead_pct_pin')}%), "
+                f"{jd.get('bytes_per_record')} B/record, "
+                f"{(jd.get('bytes_per_hour_at_1hz') or 0) / 1e6:.2f} "
+                "MB/hour at 1 Hz"
+            )
+            print(
+                f"  alert replay ...... injected violation at 60s: fired "
+                f"t={ar.get('t_fired_s')}s (delay "
+                f"{ar.get('detection_delay_s')}s), resolved "
+                f"t={ar.get('t_resolved_s')}s after 120s recovery"
+            )
+        else:
+            print("  tsdb metrics ...... unmeasured — run bench.py "
+                  "(BENCH_TSDB_ONLY=1)")
+        print(
+            "dashboard ........... python -m deepspeed_tpu.tools."
+            "fleet_dash metrics_tsdb.jsonl [--watch 5] [--diff OLD.jsonl]"
+        )
+        print(
+            "bench trend ......... python -m deepspeed_tpu.tools."
+            "bench_trend --gate BENCH_pr20.json (pinned BENCH_index.json)"
+        )
+    except Exception as e:
+        print(f"time series ......... {RED_NO} ({type(e).__name__}: {e})")
+    print("-" * 60)
     return 0
 
 

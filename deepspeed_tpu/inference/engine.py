@@ -340,7 +340,7 @@ class InferenceEngine:
         return max(S, min(bucket, cap))
 
     def serve(self, serving_config=None, clock=None, tracer=None,
-              heat_tracer=None):
+              heat_tracer=None, journal=None):
         """Continuous-batching server over this engine (serving/scheduler.py):
         a paged KV pool + slot-based decode loop over a fixed set of AOT
         executables (prefill + decode, plus speculative verify / chunked
@@ -355,7 +355,7 @@ class InferenceEngine:
         cfg = serving_config if serving_config is not None else self._serving_config
         return ServingEngine(
             self, cfg, clock=clock if clock is not None else _time.monotonic,
-            tracer=tracer, heat_tracer=heat_tracer,
+            tracer=tracer, heat_tracer=heat_tracer, journal=journal,
         )
 
     def _telemetry_generate(self, duration_s: float, batch: int, prompt_len: int, new_tokens: int, cached: Optional[bool]) -> None:

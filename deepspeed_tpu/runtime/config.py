@@ -537,6 +537,55 @@ class KVHeatConfig(DSConfigModel):
 
 
 @dataclass
+class TimeseriesConfig(DSConfigModel):
+    """telemetry.timeseries section (ISSUE 20 tentpole): the metrics
+    time-series journal (``telemetry/timeseries.py``) — the historical
+    measurement plane the fleet's SLO error-budget engine and capacity
+    dashboard consume. When enabled, a
+    :class:`~deepspeed_tpu.telemetry.timeseries.MetricsJournal` snapshots
+    the whole :class:`~deepspeed_tpu.telemetry.registry.MetricsRegistry`
+    (counters, gauges, full histogram bucket vectors) every ``interval_s``
+    seconds of the engine's injectable clock into a schema-versioned
+    (``dstpu-tsdb-v1``) delta-encoded JSONL ring through the StepTracer
+    machinery — buffered appends, size-capped atomic rotation (``max_mb``
+    → ``<file>.1``), dsan-shimmed locking. Snapshots carry only series
+    whose value changed (absolute values, not diffs — a lost record never
+    corrupts downstream math) and NO wall-clock fields: seeded replays are
+    byte-deterministic. ``path`` "" puts ``metrics_tsdb.jsonl`` under
+    ``telemetry.trace_path``. ``retention_s`` bounds the in-memory query
+    window kept for live ``rate()`` / burn-rate evaluation (0 = auto: the
+    largest SLO-alert window in play, min 1h). Consumed by
+    ``ServingEngine`` (step-cadence snapshot hook + windowed goodput),
+    ``telemetry/slo_budget.py`` (error budget / burn-rate alerts),
+    ``tools/fleet_dash.py`` (capacity/trend dashboard) and bench.py's
+    ``run_tsdb_bench``."""
+
+    enabled: bool = False
+    path: str = ""  # "" = <telemetry.trace_path>/metrics_tsdb.jsonl
+    interval_s: float = 1.0
+    flush_interval: int = 20
+    max_mb: int = 64  # 0 = unbounded
+    retention_s: float = 0.0  # 0 = auto (largest alert window, min 3600)
+
+    def __post_init__(self):
+        if float(self.interval_s) <= 0.0:
+            raise DeepSpeedConfigError(
+                "telemetry.timeseries.interval_s must be > 0, got "
+                f"{self.interval_s}"
+            )
+        if int(self.flush_interval) < 1:
+            raise DeepSpeedConfigError(
+                "telemetry.timeseries.flush_interval must be >= 1, got "
+                f"{self.flush_interval}"
+            )
+        if float(self.retention_s) < 0.0:
+            raise DeepSpeedConfigError(
+                "telemetry.timeseries.retention_s must be >= 0, got "
+                f"{self.retention_s}"
+            )
+
+
+@dataclass
 class TelemetryConfig(DSConfigModel):
     """telemetry section (TPU-native; no reference analog — subsumes the
     reference's scattered observability: timer log lines, flops-profiler
@@ -567,6 +616,8 @@ class TelemetryConfig(DSConfigModel):
     request_trace: RequestTraceConfig = field(default_factory=RequestTraceConfig)
     # ISSUE 16: page-lifetime / session-heat tracing (serving) — see KVHeatConfig
     kv_heat: KVHeatConfig = field(default_factory=KVHeatConfig)
+    # ISSUE 20: metrics time-series journal — see TimeseriesConfig
+    timeseries: TimeseriesConfig = field(default_factory=TimeseriesConfig)
 
 
 @dataclass
@@ -939,8 +990,19 @@ class SLOConfig(DSConfigModel):
 
     classes: Dict[str, Dict[str, float]] = field(default_factory=dict)
     default_class: str = ""  # "" = first declared class
+    # ISSUE 20: sliding window (seconds) for serving_goodput_tokens_per_sec.
+    # 0 keeps the PR-11 cumulative definition (tokens / whole serving span);
+    # > 0 computes goodput over the trailing window — journal-backed when a
+    # MetricsJournal is attached, ring-buffer fallback when not — so a
+    # replica degrading late in a long run visibly moves the gauge.
+    goodput_window_s: float = 0.0
 
     def __post_init__(self):
+        if float(self.goodput_window_s) < 0.0:
+            raise DeepSpeedConfigError(
+                "serving.slo.goodput_window_s must be >= 0, got "
+                f"{self.goodput_window_s}"
+            )
         for name, targets in (self.classes or {}).items():
             if not isinstance(targets, dict):
                 raise DeepSpeedConfigError(
@@ -1081,6 +1143,77 @@ class TieringConfig(DSConfigModel):
 
 
 @dataclass
+class SLOAlertsConfig(DSConfigModel):
+    """serving.fleet.slo_alerts section (ISSUE 20): per-SLO-class error
+    budget + multi-window burn-rate alerting over the metrics time-series
+    journal (``telemetry/slo_budget.py``). The classic SRE construction:
+    with an attainment ``objective`` (e.g. 0.99), the error budget is the
+    ``1 - objective`` miss fraction you may spend; the burn rate over a
+    window is (observed miss fraction) / (budget fraction) — 1.0 spends
+    exactly the budget over the objective period. Two rules evaluate per
+    class, each requiring BOTH a short and a long window over threshold
+    (the fast rule catches cliffs, the long window de-flaps it; the slow
+    rule catches grinds): ``fast`` = 5m/1h at 14.4x, ``slow`` = 6h/3d at
+    1.0x by default. Windows are *virtual-timebase* seconds off the
+    engine's injectable clock — tests and the bench compress them like the
+    PR-16 idle thresholds. Alerts run a ``pending → firing → resolved``
+    state machine (``for_s`` is the dwell before pending promotes to
+    firing), emit ``slo_alert`` journal events and
+    ``slo_error_budget_remaining{slo_class}`` /
+    ``slo_burn_rate{slo_class,window}`` gauges; with ``backpressure`` on,
+    a FIRING alert (never a pending one) drives the FleetRouter's
+    admission shedding in place of the instantaneous
+    ``admit_attainment_floor`` check — shedding reacts to *sustained*
+    burn, not one bad window. Requires ``telemetry.timeseries``."""
+
+    enabled: bool = False
+    objective: float = 0.99
+    fast_short_s: float = 300.0
+    fast_long_s: float = 3600.0
+    fast_burn_threshold: float = 14.4
+    slow_short_s: float = 21600.0
+    slow_long_s: float = 259200.0
+    slow_burn_threshold: float = 1.0
+    for_s: float = 0.0  # dwell before a pending alert promotes to firing
+    backpressure: bool = False  # firing alerts drive fleet admission shedding
+
+    def __post_init__(self):
+        if not 0.0 < float(self.objective) < 1.0:
+            raise DeepSpeedConfigError(
+                "serving.fleet.slo_alerts.objective must be in (0, 1), got "
+                f"{self.objective}"
+            )
+        for key in ("fast_short_s", "fast_long_s", "slow_short_s",
+                    "slow_long_s"):
+            if float(getattr(self, key)) <= 0.0:
+                raise DeepSpeedConfigError(
+                    f"serving.fleet.slo_alerts.{key} must be > 0"
+                )
+        for short, long in (("fast_short_s", "fast_long_s"),
+                            ("slow_short_s", "slow_long_s")):
+            if float(getattr(self, short)) >= float(getattr(self, long)):
+                raise DeepSpeedConfigError(
+                    f"serving.fleet.slo_alerts.{short} must be < {long} "
+                    f"({getattr(self, short)} >= {getattr(self, long)})"
+                )
+        for key in ("fast_burn_threshold", "slow_burn_threshold"):
+            if float(getattr(self, key)) <= 0.0:
+                raise DeepSpeedConfigError(
+                    f"serving.fleet.slo_alerts.{key} must be > 0"
+                )
+        if float(self.for_s) < 0.0:
+            raise DeepSpeedConfigError(
+                f"serving.fleet.slo_alerts.for_s must be >= 0, got "
+                f"{self.for_s}"
+            )
+
+    def max_window_s(self) -> float:
+        """The widest window any rule evaluates — the journal's minimum
+        useful in-memory retention."""
+        return max(float(self.fast_long_s), float(self.slow_long_s))
+
+
+@dataclass
 class FleetConfig(DSConfigModel):
     """serving.fleet section (ISSUE 18): multi-replica router with live
     session migration — DeepSpeed-Inference's multi-replica serving layer
@@ -1124,8 +1257,13 @@ class FleetConfig(DSConfigModel):
     # victim replica per preempt_policy instead of killing the whole fleet
     install_sigterm: bool = False
     preempt_policy: str = "most_loaded"   # most_loaded | first
+    # ISSUE 20: error-budget burn-rate alerting over the metrics journal —
+    # see SLOAlertsConfig
+    slo_alerts: SLOAlertsConfig = field(default_factory=SLOAlertsConfig)
 
     def __post_init__(self):
+        if isinstance(self.slo_alerts, dict):
+            self.slo_alerts = SLOAlertsConfig.from_dict(self.slo_alerts)
         if int(self.replicas) < 1:
             raise DeepSpeedConfigError(
                 f"serving.fleet.replicas must be >= 1, got {self.replicas}"

@@ -55,6 +55,7 @@ from ..resilience.manifest import (
     write_tag,
 )
 from ..telemetry.request_trace import LATENCY_BUCKETS
+from ..telemetry.slo_budget import SLOBudgetEngine
 from ..utils.logging import log_dist
 from .replay import ReplayClock, ReplayItem
 from .request import Request, RequestStatus
@@ -89,7 +90,7 @@ class FleetRouter:
     one plane."""
 
     def __init__(self, engine, serving_config=None, clock=None, tracer=None,
-                 fault_injector=None):
+                 fault_injector=None, journal=None):
         from ..runtime.config import ServingConfig
 
         if serving_config is None:
@@ -125,7 +126,7 @@ class FleetRouter:
                 # fall back to sharing device 0's window (CPU-sim fleets)
                 plc.device_base = base if base + per <= n_dev_avail else 0
             srv = engine.serve(serving_config=rcfg, clock=self.clock,
-                               tracer=tracer)
+                               tracer=tracer, journal=journal)
             if fault_injector is not None:
                 srv.fault_injector = fault_injector
             guard = PreemptionGuard(install=False, grace_window_s=0.0)
@@ -188,10 +189,44 @@ class FleetRouter:
             "fleet_rejections_total",
             "requests shed at the fleet door by the attainment floor",
         )
+        self._g_rep_queue = m.gauge(
+            "fleet_replica_queue_depth", "per-replica admission queue depth",
+            labelnames=("replica",),
+        )
         self._g_replicas.set(len(self.replicas))
         for rep in self.replicas:
             self._g_rep_occ.set(0.0, replica=rep.rid)
             self._g_rep_goodput.set(0.0, replica=rep.rid)
+            self._g_rep_queue.set(0.0, replica=rep.rid)
+
+        # -- ISSUE 20: time-series journal + burn-rate alerting ----------
+        # ONE journal serves the whole fleet: every replica shares this
+        # registry/clock, so per-replica gauges are separate labeled series
+        # in the same file. Explicit param wins, else the engine's
+        # telemetry plane (the replicas already attached it in that case).
+        self.journal = (
+            journal if journal is not None
+            else getattr(getattr(engine, "telemetry", None),
+                         "metrics_journal", None)
+        )
+        if self.journal is not None:
+            # rebind to the FLEET registry: without a shared telemetry
+            # plane each replica carries its own registry and the last
+            # replica's attach would win — the fleet gauges (and the SLO
+            # counters the budget engine reads) live on this one
+            self.journal.bind(m, clock=self.clock)
+        self.slo_budget = None
+        acfg = getattr(self.fcfg, "slo_alerts", None)
+        if acfg is not None and getattr(acfg, "enabled", False):
+            if self.journal is None:
+                raise FleetError(
+                    "serving.fleet.slo_alerts.enabled requires a metrics "
+                    "journal (telemetry.timeseries.enabled or an explicit "
+                    "journal=)"
+                )
+            self.slo_budget = SLOBudgetEngine(
+                self.journal, acfg, registry=m, clock=self.clock
+            )
 
     # -- small accessors ------------------------------------------------
 
@@ -272,7 +307,15 @@ class FleetRouter:
         """PR-11-driven backpressure: shed ONLY when every alive replica
         has enough SLO verdicts to judge AND all of them attain below the
         floor. Raw queue depth never sheds at the fleet door — each
-        replica's own ``max_queue_depth`` still applies after routing."""
+        replica's own ``max_queue_depth`` still applies after routing.
+
+        With ``fleet.slo_alerts.backpressure`` on (ISSUE 20), the burn-rate
+        alert engine REPLACES the instantaneous floor: shed only while an
+        alert is FIRING — a sustained multi-window burn, never a single bad
+        window (and never merely *pending*)."""
+        if (self.slo_budget is not None
+                and getattr(self.fcfg.slo_alerts, "backpressure", False)):
+            return self.slo_budget.firing() and bool(self.alive())
         floor = float(self.fcfg.admit_attainment_floor)
         if floor <= 0.0:
             return False
@@ -307,10 +350,16 @@ class FleetRouter:
             )
             req.t_submit = now
             req.status = RequestStatus.REJECTED
-            req.detail = (
-                f"fleet shedding: attainment < "
-                f"{self.fcfg.admit_attainment_floor} on every replica"
-            )
+            if self.slo_budget is not None and self.slo_budget.firing():
+                req.detail = (
+                    "fleet shedding: sustained error-budget burn "
+                    f"(firing: {', '.join(self.slo_budget.firing_classes())})"
+                )
+            else:
+                req.detail = (
+                    f"fleet shedding: attainment < "
+                    f"{self.fcfg.admit_attainment_floor} on every replica"
+                )
             req.t_finish = now
             self._c_rejections.inc()
             if self.tracer is not None:
@@ -344,6 +393,15 @@ class FleetRouter:
             if rep.alive:
                 emitted += rep.srv.step()
         self._refresh_gauges()
+        # ISSUE 20: journal + burn-rate evaluation on the shared cadence.
+        # A replica's own step-end hook may have won this interval's
+        # snapshot (absolute-value encoding makes the one-tick gauge skew
+        # harmless); maybe_evaluate keys off journal.last_t either way, so
+        # alerts advance exactly once per snapshot.
+        if self.journal is not None:
+            self.journal.maybe_snapshot(self.clock())
+            if self.slo_budget is not None:
+                self.slo_budget.maybe_evaluate()
         return emitted
 
     def _refresh_gauges(self) -> None:
@@ -360,6 +418,7 @@ class FleetRouter:
                 rep.srv.slo_snapshot()["goodput_tokens_per_sec"],
                 replica=rep.rid,
             )
+            self._g_rep_queue.set(float(len(srv.queue)), replica=rep.rid)
 
     def run(self, max_steps: Optional[int] = None) -> List[Request]:
         """Drive :meth:`step` until every alive replica is idle."""
@@ -617,6 +676,18 @@ class FleetRouter:
                 "rejections": self._c_rejections.value(),
             },
             "replicas": reps,
+            # ISSUE 20: burn-rate alert plane (absent when not configured)
+            **(
+                {
+                    "slo_alerts": {
+                        "firing": self.slo_budget.firing(),
+                        "fired_total": self.slo_budget.alerts_fired,
+                        "resolved_total": self.slo_budget.alerts_resolved,
+                        "classes": self.slo_budget.states(),
+                    }
+                }
+                if self.slo_budget is not None else {}
+            ),
         }
 
 

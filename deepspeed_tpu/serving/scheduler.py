@@ -165,7 +165,7 @@ class ServingEngine:
     module grows that touches them."""
 
     def __init__(self, engine, config=None, clock=time.monotonic, fault_injector=None,
-                 tracer=None, heat_tracer=None):
+                 tracer=None, heat_tracer=None, journal=None):
         from ..runtime.config import ServingConfig
 
         if config is None:
@@ -501,8 +501,33 @@ class ServingEngine:
         )
         self._g_goodput = m.gauge(
             "serving_goodput_tokens_per_sec",
-            "tokens from SLO-met requests per wall second since first submit",
+            "tokens from SLO-met requests per second — over the trailing "
+            "serving.slo.goodput_window_s window when set, else over the "
+            "whole span since first submit (PR-11 behavior)",
         )
+        # -- ISSUE 20: journal-visible SLO counters + windowed goodput -----
+        # the monotone per-class counters the burn-rate engine windows over
+        # (the _slo_counts dict is invisible to the metrics journal)
+        self._c_slo_eval = m.counter(
+            "serving_slo_evaluated_total",
+            "SLO-evaluated terminal requests per class",
+            labelnames=("slo_class",),
+        )
+        self._c_slo_met = m.counter(
+            "serving_slo_met_total",
+            "SLO-met terminal requests per class",
+            labelnames=("slo_class",),
+        )
+        self._c_good_tokens = m.counter(
+            "serving_slo_good_tokens_total",
+            "tokens generated by SLO-met requests (windowed goodput source)",
+        )
+        self._goodput_window_s = float(
+            getattr(self._slo, "goodput_window_s", 0.0) or 0.0
+        )
+        # ring-buffer fallback when no journal is attached: (t, tokens) of
+        # each SLO-met completion, trimmed to the window on read
+        self._good_events: Deque[tuple] = deque()
         self._c_tenant_requests = m.counter(
             "serving_tenant_requests_total",
             "terminal requests by tenant and status (tenant cardinality is "
@@ -557,6 +582,17 @@ class ServingEngine:
         )
         if ht is not None:
             self.attach_heat(ht)
+
+        # -- ISSUE 20: metrics time-series journal -------------------------
+        # explicit journal wins, else the engine's telemetry plane provides
+        # one (telemetry.timeseries); the step path pays one None check
+        self._journal = None
+        mj = (
+            journal if journal is not None
+            else getattr(getattr(engine, "telemetry", None), "metrics_journal", None)
+        )
+        if mj is not None:
+            self.attach_journal(mj)
 
         self._prefill_exec = None
         self._decode_exec = None
@@ -694,6 +730,56 @@ class ServingEngine:
         self._heat = None
         self._heat_decode = None
         self._heat_prefill = None
+
+    # ------------------------------------------------------------------
+    # ISSUE 20: metrics time-series journal
+    # ------------------------------------------------------------------
+    def attach_journal(self, journal) -> None:
+        """Attach a :class:`~deepspeed_tpu.telemetry.timeseries.MetricsJournal`:
+        bind it to this engine's registry and injectable clock (replayed
+        timestamps stay virtual) and snapshot on the step cadence.
+        Idempotent for the same journal."""
+        if journal is self._journal:
+            return
+        journal.bind(self.metrics, clock=self.clock)
+        self._journal = journal
+
+    def detach_journal(self) -> None:
+        """Stop snapshotting (the journal and its file survive)."""
+        self._journal = None
+
+    def _goodput_now(self, now: float) -> tuple:
+        """(windowed, cumulative) goodput in tokens/s. Cumulative is the
+        PR-11 whole-span number; windowed divides the trailing
+        ``goodput_window_s`` of SLO-met tokens — journal ``increase()``
+        when attached, the ring-buffer fallback when not — by the
+        *effective* window (capped at the span, so a young engine is not
+        under-reported). With no window configured both are the span
+        number."""
+        if self._t_first_submit is None:
+            return 0.0, 0.0
+        span = max(now - self._t_first_submit, 1e-12)
+        cumulative = self._slo_good_tokens / span
+        w = self._goodput_window_s
+        if w <= 0.0:
+            return cumulative, cumulative
+        if self._journal is not None and self._journal.last_t is not None:
+            good = self._journal.increase(
+                "serving_slo_good_tokens_total", now - w, now
+            )
+            # snapshots trail the live counter by up to interval_s: fold
+            # in the not-yet-journaled tail (those completions are by
+            # definition the freshest, so they belong in any window)
+            live = self._c_good_tokens.value()
+            latest = self._journal.latest("serving_slo_good_tokens_total")
+            good += live - (latest if latest is not None else 0.0)
+        else:
+            ring = self._good_events
+            while ring and ring[0][0] < now - w:
+                ring.popleft()
+            good = float(sum(tok for _t, tok in ring))
+        eff = min(w, span)
+        return good / max(eff, 1e-12), cumulative
 
     def draft_index_bytes(self) -> int:
         """Host bytes held by live slots' incremental n-gram drafter state
@@ -1406,6 +1492,8 @@ class ServingEngine:
             self._tier_pump()
         if self._step_count and self._step_count % 32 == 0:
             self.stats()  # refresh the quantile gauges for textfile scrapes
+        if self._journal is not None:
+            self._journal.maybe_snapshot(self.clock())
         return n_active
 
     def _pages_needed(self, req: Request) -> int:
@@ -1960,9 +2048,15 @@ class ServingEngine:
         if verdict is not None:
             cnt = self._slo_counts.setdefault(req.slo_class, [0, 0])
             cnt[1] += 1
+            self._c_slo_eval.inc(slo_class=req.slo_class)
             if verdict["met"]:
                 cnt[0] += 1
                 self._slo_good_tokens += len(req.tokens)
+                self._c_slo_met.inc(slo_class=req.slo_class)
+                if req.tokens:
+                    self._c_good_tokens.inc(len(req.tokens))
+                    if self._goodput_window_s > 0.0:
+                        self._good_events.append((now, len(req.tokens)))
             self._g_slo.set(cnt[0] / cnt[1], slo_class=req.slo_class)
         ten = self.tenants.setdefault(req.tenant, {
             "requests": 0, "tokens": 0, "slo_met": 0, "slo_evaluated": 0,
@@ -2406,14 +2500,16 @@ class ServingEngine:
             now - self._t_first_submit
             if self._t_first_submit is not None else 0.0
         )
+        windowed, cumulative = self._goodput_now(now)
         return {
             "good_tokens": int(self._slo_good_tokens),
             "met": int(met),
             "evaluated": int(evaluated),
             "attainment": (met / evaluated) if evaluated else None,
-            "goodput_tokens_per_sec": (
-                self._slo_good_tokens / span if span > 0 else 0.0
-            ),
+            # windowed when goodput_window_s is set (ISSUE 20) — fleet
+            # routing then reacts to the recent past, not the whole run
+            "goodput_tokens_per_sec": windowed,
+            "goodput_cumulative_tokens_per_sec": cumulative,
             "span_s": span,
         }
 
@@ -2702,13 +2798,13 @@ class ServingEngine:
         # and would mix engines sharing one plane
         out["by_status"] = dict(self._status_counts)
         now = self.clock()
-        goodput = None
         if self._slo_enabled and self._t_first_submit is not None:
-            span = max(now - self._t_first_submit, 1e-12)
-            goodput = self._slo_good_tokens / span
-            self._g_goodput.set(goodput)
+            windowed, cumulative = self._goodput_now(now)
+            self._g_goodput.set(windowed)
             out["slo"] = {
-                "goodput_tokens_per_sec": goodput,
+                "goodput_tokens_per_sec": windowed,
+                "goodput_cumulative_tokens_per_sec": cumulative,
+                "goodput_window_s": self._goodput_window_s,
                 "classes": {
                     cls: {
                         "met": met, "evaluated": ev,
@@ -2747,6 +2843,17 @@ class ServingEngine:
             }
             if self._heat.encode_error is not None:
                 out["kv_heat"]["encode_error"] = self._heat.encode_error
+        # ISSUE 20: time-series journal health
+        if self._journal is not None:
+            out["timeseries"] = {
+                "path": self._journal.file_path,
+                "snapshots": self._journal.snapshots,
+                "records": self._journal.records_emitted,
+                "rotations": self._journal.rotations,
+                "last_t": self._journal.last_t,
+            }
+            if self._journal.encode_error is not None:
+                out["timeseries"]["encode_error"] = self._journal.encode_error
         out["kv_pages_shared"] = self.allocator.pages_shared
         out["kv_cow_forks"] = self.allocator.cow_forks_total
         # ISSUE 12: the pool's storage dtype + its HBM split (codes vs

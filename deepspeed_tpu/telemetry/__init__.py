@@ -29,13 +29,15 @@ from .exporters import MonitorBridge, PrometheusTextfileExporter
 from .kv_heat import KVHeatLedger, KVHeatTracer
 from .registry import Counter, Gauge, Histogram, MetricsRegistry
 from .request_trace import RequestTracer
+from .timeseries import MetricsJournal
 from .tracer import Span, StepTracer, aggregate_scalars, spans_to_tree
 from .watchdog import AnomalyError, AnomalyWatchdog
 
 __all__ = [
     "AnomalyError", "AnomalyWatchdog",
     "Counter", "Gauge", "Histogram", "KVHeatLedger", "KVHeatTracer",
-    "MetricsRegistry", "MonitorBridge", "PrometheusTextfileExporter",
+    "MetricsJournal", "MetricsRegistry", "MonitorBridge",
+    "PrometheusTextfileExporter",
     "RequestTracer", "Span", "StepTracer", "Telemetry",
     "aggregate_scalars", "device_hbm_stats", "from_config", "introspect",
     "spans_to_tree",
@@ -119,6 +121,20 @@ class Telemetry:
                 max_bytes=int(kh.max_mb) * 2**20,
                 segment_events=int(kh.segment_events),
                 idle_thresholds_s=tuple(kh.idle_thresholds_s),
+                process_index=process_index,
+            )
+        # ISSUE 20: metrics time-series journal — picked up by ServingEngine
+        # / FleetRouter (they drive maybe_snapshot off the engine clock)
+        self.metrics_journal: Optional[MetricsJournal] = None
+        ts = getattr(config, "timeseries", None)
+        if ts is not None and getattr(ts, "enabled", False):
+            self.metrics_journal = MetricsJournal(
+                ts.path or os.path.join(config.trace_path or ".", "metrics_tsdb.jsonl"),
+                registry=self.registry,
+                interval_s=float(ts.interval_s),
+                flush_interval=int(ts.flush_interval),
+                max_bytes=int(ts.max_mb) * 2**20,
+                retention_s=float(ts.retention_s) or 3600.0,
                 process_index=process_index,
             )
         compile_stats.install(self.registry)
@@ -274,6 +290,8 @@ class Telemetry:
             self.request_tracer.flush()
         if self.kv_heat_tracer is not None:
             self.kv_heat_tracer.flush()
+        if self.metrics_journal is not None:
+            self.metrics_journal.flush()
         if self.prometheus is not None:
             self.prometheus.export()
 
@@ -285,6 +303,8 @@ class Telemetry:
             self.request_tracer.close()
         if self.kv_heat_tracer is not None:
             self.kv_heat_tracer.close()
+        if self.metrics_journal is not None:
+            self.metrics_journal.close()
 
 
 def _is_num(v) -> bool:

@@ -1,0 +1,236 @@
+"""``bench_trend`` — fold the committed ``BENCH_*.json`` artifacts into one
+``BENCH_index.json`` trajectory, with a regression gate (ISSUE 20).
+
+    python -m deepspeed_tpu.tools.bench_trend [--root DIR] \
+        [--index BENCH_index.json] [--update] \
+        [--gate CANDIDATE.json [--name NAME] --threshold-pct 10] [--json]
+
+Twenty PRs left ~30 bench artifacts at the repo root, each with its own
+schema (``bench_pr2_comm_v1`` … ``bench_pr18_fleet_v1``). This tool walks
+every ``BENCH_*.json``, pulls out the **headline metrics** — numeric
+leaves whose key matches the curated direction table below (tokens/s,
+goodput, MFU, attainment, overhead pins, latency, blackout) — and writes
+the schema-versioned (``dstpu-benchindex-v1``) index mapping artifact →
+``{metric_path: {value, higher_is_better}}``, PR-ordered where the
+filename carries a PR number. The index is COMMITTED: it is the pinned
+trajectory later re-runs gate against.
+
+``--gate CANDIDATE.json`` re-extracts the candidate's headlines and fails
+(exit 1) when any pinned headline regressed by more than
+``--threshold-pct`` in its "worse" direction (new metrics the pin does not
+know are ignored — adding measurements is never a regression).
+``--update`` rewrites the index in place (deterministic: sorted keys, no
+timestamps — regenerating from unchanged artifacts is byte-identical).
+
+Exit codes: 0 clean, 1 gate regression, 2 unreadable artifact/index or
+usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+SCHEMA = "dstpu-benchindex-v1"
+
+# leaf-key suffix -> higher_is_better; the curated headline vocabulary
+# across every bench schema this repo committed. Order matters only for
+# readability; matching is "key equals or endswith".
+_DIRECTION: Tuple[Tuple[str, bool], ...] = (
+    ("tokens_per_sec_chip", True),
+    ("tokens_per_sec", True),
+    ("goodput_tokens_per_sec", True),
+    ("mfu", True),
+    ("vs_baseline", True),
+    ("slo_attainment", True),
+    ("compression_ratio", True),
+    ("acceptance_rate", True),
+    ("hit_rate", True),
+    ("resident_session_ratio", True),
+    ("resident_sessions", True),
+    ("overhead_pct", False),
+    ("step_latency_ms", False),
+    ("blackout_p99_s", False),
+    ("blackout_s", False),
+    ("ttft_p99_s", False),
+    ("tpot_p99_s", False),
+    ("bytes_per_hour", False),
+    ("restore_stall_ms", False),
+)
+
+
+def _direction_of(key: str) -> Optional[bool]:
+    for suffix, better in _DIRECTION:
+        if key == suffix or key.endswith("_" + suffix) or key.endswith(suffix):
+            return better
+    return None
+
+
+def _walk(node: Any, path: str, out: Dict[str, Tuple[float, bool]]) -> None:
+    if isinstance(node, dict):
+        for k in sorted(node):
+            _walk(node[k], f"{path}.{k}" if path else str(k), out)
+    elif isinstance(node, bool):
+        return
+    elif isinstance(node, (int, float)):
+        leaf = path.rsplit(".", 1)[-1]
+        better = _direction_of(leaf)
+        if better is not None:
+            out[path] = (float(node), better)
+
+
+def extract_headlines(doc: Any) -> Dict[str, Dict[str, Any]]:
+    """Artifact JSON → {dotted_path: {value, higher_is_better}} for every
+    numeric leaf in the headline vocabulary."""
+    found: Dict[str, Tuple[float, bool]] = {}
+    _walk(doc, "", found)
+    return {
+        p: {"value": v, "higher_is_better": b}
+        for p, (v, b) in sorted(found.items())
+    }
+
+
+def _pr_order(name: str) -> Tuple[int, str]:
+    """PR-numbered artifacts sort numerically, the rest after by name."""
+    stem = name[len("BENCH_"):-len(".json")]
+    if stem.startswith("pr") and stem[2:].isdigit():
+        return (int(stem[2:]), name)
+    return (10**6, name)
+
+
+def build_index(root: str) -> Dict[str, Any]:
+    """Scan ``root`` for BENCH_*.json and fold the trajectory."""
+    files = sorted(
+        (os.path.basename(p) for p in glob.glob(os.path.join(root, "BENCH_*.json"))
+         if os.path.basename(p) != "BENCH_index.json"),
+        key=_pr_order,
+    )
+    artifacts: Dict[str, Any] = {}
+    for name in files:
+        with open(os.path.join(root, name)) as fh:
+            try:
+                doc = json.load(fh)
+            except ValueError as e:
+                raise ValueError(f"{name}: unreadable JSON ({e})")
+        artifacts[name] = {
+            "schema": doc.get("schema") if isinstance(doc, dict) else None,
+            "headlines": extract_headlines(doc),
+        }
+    return {
+        "schema": SCHEMA,
+        "order": files,
+        "artifacts": artifacts,
+    }
+
+
+def gate_candidate(index: Dict[str, Any], name: str, candidate: Any,
+                   threshold_pct: float) -> List[str]:
+    """Compare a re-run artifact against its pinned headlines; returns the
+    regression descriptions (empty = pass). Metrics absent from either
+    side are skipped — only pinned, re-measured headlines can regress."""
+    pinned = index.get("artifacts", {}).get(name)
+    if pinned is None:
+        raise KeyError(
+            f"{name} not in index (have {sorted(index.get('artifacts', {}))})"
+        )
+    fresh = extract_headlines(candidate)
+    regressions: List[str] = []
+    for path, pin in pinned["headlines"].items():
+        cur = fresh.get(path)
+        if cur is None:
+            continue
+        va, vb = float(pin["value"]), float(cur["value"])
+        worse = (va - vb) if pin["higher_is_better"] else (vb - va)
+        if worse > max(abs(va) * threshold_pct / 100.0, 1e-12):
+            arrow = "↓" if pin["higher_is_better"] else "↑"
+            regressions.append(
+                f"{name}:{path} {arrow} pinned={va:g} now={vb:g} "
+                f"(>{threshold_pct:g}% worse)"
+            )
+    return regressions
+
+
+def _format_index(index: Dict[str, Any]) -> str:
+    lines = [f"bench_trend  {len(index['order'])} artifacts"]
+    for name in index["order"]:
+        hl = index["artifacts"][name]["headlines"]
+        lines.append(f"\n{name} ({index['artifacts'][name]['schema'] or '-'}):")
+        for path, ent in hl.items():
+            d = "+" if ent["higher_is_better"] else "-"
+            lines.append(f"  [{d}] {path:<58} {ent['value']:g}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="bench_trend",
+        description="fold BENCH_*.json into a pinned trajectory index",
+    )
+    p.add_argument("--root", default=".",
+                   help="directory holding the BENCH_*.json artifacts")
+    p.add_argument("--index", default=None,
+                   help="index path (default <root>/BENCH_index.json)")
+    p.add_argument("--update", action="store_true",
+                   help="(re)write the index from the current artifacts")
+    p.add_argument("--gate", default=None, metavar="CANDIDATE_JSON",
+                   help="gate a re-run artifact against its pinned headlines")
+    p.add_argument("--name", default=None,
+                   help="--gate: artifact name in the index "
+                        "(default: the candidate's basename)")
+    p.add_argument("--threshold-pct", type=float, default=10.0,
+                   help="--gate regression threshold (%% worse than pinned)")
+    p.add_argument("--json", action="store_true", help="emit JSON")
+    args = p.parse_args(argv)
+    index_path = args.index or os.path.join(args.root, "BENCH_index.json")
+    try:
+        if args.update:
+            index = build_index(args.root)
+            with open(index_path, "w") as fh:
+                json.dump(index, fh, indent=1, sort_keys=True)
+                fh.write("\n")
+            print(f"bench_trend: wrote {index_path} "
+                  f"({len(index['order'])} artifacts)")
+            return 0
+        with open(index_path) as fh:
+            index = json.load(fh)
+        if index.get("schema") != SCHEMA:
+            print(
+                f"bench_trend: {index_path}: schema "
+                f"{index.get('schema')!r} != {SCHEMA!r}", file=sys.stderr,
+            )
+            return 2
+        if args.gate is not None:
+            with open(args.gate) as fh:
+                candidate = json.load(fh)
+            name = args.name or os.path.basename(args.gate)
+            try:
+                regressions = gate_candidate(
+                    index, name, candidate, args.threshold_pct
+                )
+            except KeyError as e:
+                print(f"bench_trend: {e.args[0]}", file=sys.stderr)
+                return 2
+            if args.json:
+                print(json.dumps(
+                    {"name": name, "regressions": regressions}, indent=1
+                ))
+            elif regressions:
+                for r in regressions:
+                    print(f"REGRESSED: {r}")
+            else:
+                print(f"bench_trend: {name}: all pinned headlines held")
+            return 1 if regressions else 0
+        print(json.dumps(index, indent=1, sort_keys=True) if args.json
+              else _format_index(index))
+        return 0
+    except (OSError, ValueError) as e:
+        print(f"bench_trend: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

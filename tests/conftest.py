@@ -44,7 +44,7 @@ def pytest_collection_modifyitems(config, items):
     where their files sort."""
     if not _TPU_MODE:
         _hoisted = ("serving", "lint", "resilience", "dsan", "dsmem", "heat",
-                    "tiering", "fleet")
+                    "tiering", "fleet", "tsdb")
         items.sort(
             key=lambda item: 0
             if any(k in item.keywords for k in _hoisted) else 1
